@@ -1,0 +1,500 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Built from scratch on JAX/XLA/Pallas/pjit with the capability set of the
+reference framework (PaddlePaddle, surveyed in /root/repo/SURVEY.md).  The
+tensor type is jax.Array; `paddle_tpu.*` provides the paddle-shaped tensor
+API (reference: python/paddle/tensor/*), with jax.numpy as the kernel
+substrate — the analog of the reference's 287 phi kernels, which XLA both
+implements and fuses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import framework  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .framework import (CPUPlace, TPUPlace, get_device, load, save, seed,  # noqa: F401
+                        set_device)
+from .framework.dtype import convert_dtype
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework.random import key_scope, next_key  # noqa: F401
+from .nn.layer import Parameter  # noqa: F401
+
+__version__ = "0.1.0"
+
+# dtype names (paddle.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+uint8 = jnp.uint8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+bool = jnp.bool_  # noqa: A001
+
+Tensor = jax.Array
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else x
+
+
+# ---------------------------------------------------------------------------
+# creation (reference python/paddle/tensor/creation.py)
+# ---------------------------------------------------------------------------
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    x = jnp.asarray(_arr(data), dtype=convert_dtype(dtype))
+    if place is not None:
+        x = jax.device_put(x, place.device)
+    return x
+
+
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(shape, convert_dtype(dtype))
+
+
+def ones(shape, dtype="float32"):
+    return jnp.ones(shape, convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype="float32"):
+    return jnp.full(shape, fill_value, convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(_arr(x), convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(_arr(x), convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(_arr(x), fill_value, convert_dtype(dtype))
+
+
+def arange(start, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+
+
+def empty(shape, dtype="float32"):
+    return jnp.zeros(shape, convert_dtype(dtype))
+
+
+def rand(shape, dtype="float32"):
+    return jax.random.uniform(next_key(), shape, convert_dtype(dtype))
+
+
+def randn(shape, dtype="float32"):
+    return jax.random.normal(next_key(), shape, convert_dtype(dtype))
+
+
+def randint(low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(next_key(), shape, low, high,
+                              convert_dtype(dtype))
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(next_key(), n).astype(convert_dtype(dtype))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0):
+    return jax.random.uniform(next_key(), shape, convert_dtype(dtype), min, max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return mean + std * jax.random.normal(next_key(), shape)
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(next_key(), _arr(x)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# math / reduction / comparison (reference python/paddle/tensor/math.py)
+# ---------------------------------------------------------------------------
+def _wrap1(fn):
+    def op(x, *a, **k):
+        return fn(_arr(x), *a, **k)
+    op.__name__ = fn.__name__
+    return op
+
+
+def _wrap2(fn):
+    def op(x, y, *a, **k):
+        return fn(_arr(x), _arr(y), *a, **k)
+    op.__name__ = fn.__name__
+    return op
+
+
+abs = _wrap1(jnp.abs)  # noqa: A001
+exp = _wrap1(jnp.exp)
+log = _wrap1(jnp.log)
+log2 = _wrap1(jnp.log2)
+log10 = _wrap1(jnp.log10)
+log1p = _wrap1(jnp.log1p)
+sqrt = _wrap1(jnp.sqrt)
+rsqrt = _wrap1(jax.lax.rsqrt)
+square = _wrap1(jnp.square)
+sin = _wrap1(jnp.sin)
+cos = _wrap1(jnp.cos)
+tan = _wrap1(jnp.tan)
+asin = _wrap1(jnp.arcsin)
+acos = _wrap1(jnp.arccos)
+atan = _wrap1(jnp.arctan)
+sinh = _wrap1(jnp.sinh)
+cosh = _wrap1(jnp.cosh)
+tanh = _wrap1(jnp.tanh)
+floor = _wrap1(jnp.floor)
+ceil = _wrap1(jnp.ceil)
+round = _wrap1(jnp.round)  # noqa: A001
+trunc = _wrap1(jnp.trunc)
+sign = _wrap1(jnp.sign)
+reciprocal = _wrap1(jnp.reciprocal)
+neg = _wrap1(jnp.negative)
+erf = _wrap1(jax.scipy.special.erf)
+sigmoid = _wrap1(jax.nn.sigmoid)
+isnan = _wrap1(jnp.isnan)
+isinf = _wrap1(jnp.isinf)
+isfinite = _wrap1(jnp.isfinite)
+
+add = _wrap2(jnp.add)
+subtract = _wrap2(jnp.subtract)
+multiply = _wrap2(jnp.multiply)
+divide = _wrap2(jnp.divide)
+floor_divide = _wrap2(jnp.floor_divide)
+mod = _wrap2(jnp.mod)
+remainder = _wrap2(jnp.remainder)
+pow = _wrap2(jnp.power)  # noqa: A001
+maximum = _wrap2(jnp.maximum)
+minimum = _wrap2(jnp.minimum)
+fmax = _wrap2(jnp.fmax)
+fmin = _wrap2(jnp.fmin)
+atan2 = _wrap2(jnp.arctan2)
+equal = _wrap2(jnp.equal)
+not_equal = _wrap2(jnp.not_equal)
+greater_than = _wrap2(jnp.greater)
+greater_equal = _wrap2(jnp.greater_equal)
+less_than = _wrap2(jnp.less)
+less_equal = _wrap2(jnp.less_equal)
+logical_and = _wrap2(jnp.logical_and)
+logical_or = _wrap2(jnp.logical_or)
+logical_xor = _wrap2(jnp.logical_xor)
+logical_not = _wrap1(jnp.logical_not)
+bitwise_and = _wrap2(jnp.bitwise_and)
+bitwise_or = _wrap2(jnp.bitwise_or)
+bitwise_xor = _wrap2(jnp.bitwise_xor)
+
+mean = _wrap1(jnp.mean)
+# `sum`/`max`/`min`/`prod` accept paddle-style axis kw
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(_arr(x), axis=axis, dtype=convert_dtype(dtype),
+                   keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(_arr(x), axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(_arr(x), axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False):
+    return jnp.prod(_arr(x), axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(_arr(x), axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(_arr(x), axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmax(_arr(x), axis=axis, keepdims=keepdim).astype(
+        convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(_arr(x), axis=axis, keepdims=keepdim).astype(
+        convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(_arr(x), axis=axis)
+    return jnp.flip(idx, axis=axis) if descending else idx
+
+
+def sort(x, axis=-1, descending=False):
+    y = jnp.sort(_arr(x), axis=axis)
+    return jnp.flip(y, axis=axis) if descending else y
+
+
+def topk(x, k, axis=-1, largest=True):
+    x = _arr(x)
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        vals = -vals
+    if axis not in (-1, _arr(x).ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx
+
+
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(_arr(x), axis=axis, dtype=convert_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(_arr(x), axis=dim, dtype=convert_dtype(dtype))
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(_arr(x), min, max)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(_arr(x), axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(_arr(x), axis=axis, keepdims=keepdim)
+
+
+# linalg-ish
+matmul = nn.functional.matmul
+def mm(x, y):
+    return jnp.matmul(_arr(x), _arr(y))
+
+
+def bmm(x, y):
+    return jnp.matmul(_arr(x), _arr(y))
+
+
+def dot(x, y):
+    return jnp.sum(_arr(x) * _arr(y), axis=-1)
+
+
+def t(x):
+    return jnp.swapaxes(_arr(x), -1, -2)
+
+
+def einsum(eq, *xs):
+    return jnp.einsum(eq, *[_arr(x) for x in xs])
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    return jnp.linalg.norm(_arr(x), ord=p, axis=axis, keepdims=keepdim)
+
+
+def outer(x, y):
+    return jnp.outer(_arr(x), _arr(y))
+
+
+def diag(x, offset=0):
+    return jnp.diag(_arr(x), k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(_arr(x), k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(_arr(x), k=diagonal)
+
+
+# ---------------------------------------------------------------------------
+# manipulation (reference python/paddle/tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+def reshape(x, shape):
+    return jnp.reshape(_arr(x), shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(_arr(x), perm)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(_arr(x), axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(_arr(x), axis)
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate([_arr(x) for x in xs], axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack([_arr(x) for x in xs], axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    x = _arr(x)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sizes = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sizes:
+        known = _np.sum([s for s in sizes if s != -1])
+        sizes[sizes.index(-1)] = total - int(known)
+    offsets = _np.cumsum(sizes)[:-1].tolist()
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.split(_arr(x), chunks, axis=axis)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(_arr(x), repeat_times)
+
+
+def expand(x, shape):
+    return jnp.broadcast_to(_arr(x), shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(_arr(x), shape)
+
+
+def flip(x, axis):
+    return jnp.flip(_arr(x), axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(_arr(x), shifts, axis=axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return nn.functional.flatten(x, start_axis, stop_axis)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(_arr(x), _arr(index), axis=axis)
+
+
+def gather_nd(x, index):
+    x, index = _arr(x), _arr(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(_arr(x), _arr(indices), axis=axis)
+
+
+def scatter(x, index, updates, overwrite=True):
+    x, index, updates = _arr(x), _arr(index), _arr(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(_arr(x), _arr(index), axis=axis)
+
+
+def masked_select(x, mask):
+    return _arr(x)[_arr(mask)]
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.where(_arr(condition))
+    return jnp.where(_arr(condition), _arr(x), _arr(y))
+
+
+def nonzero(x):
+    return jnp.stack(jnp.nonzero(_arr(x)), axis=-1)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    return jnp.unique(_arr(x), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts)
+
+
+def cast(x, dtype):
+    return _arr(x).astype(convert_dtype(dtype))
+
+
+def numel(x):
+    return _arr(x).size
+
+
+def shape(x):
+    return tuple(_arr(x).shape)
+
+
+def is_tensor(x):
+    return isinstance(x, jax.Array)
+
+
+def assign(x, output=None):
+    return jnp.asarray(_arr(x))
+
+
+def clone(x):
+    return jnp.copy(_arr(x))
+
+
+def numpy(x):
+    return _np.asarray(_arr(x))
+
+
+def item(x):
+    return _arr(x).item()
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(_arr(x), _arr(y), rtol=rtol, atol=atol,
+                        equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(_arr(x), _arr(y))
+
+
+# grad/no-grad parity
+no_grad = autograd.no_grad
+grad = autograd.grad
+
+
+def stop_gradient(x):
+    return jax.lax.stop_gradient(_arr(x))
+
+
+# device helpers
+def device_count():
+    return len(jax.devices())
+
+
+def synchronize():
+    """Block until all enqueued device work is done (paddle.device.cuda.
+    synchronize analog)."""
+    for a in jax.live_arrays():
+        a.block_until_ready()

@@ -1,0 +1,87 @@
+"""AMP policy state consulted by functional ops.
+
+TPU-native analog of the reference's per-op white/black cast lists
+(reference: python/paddle/fluid/dygraph/amp/auto_cast.py:33-79 WHITE_LIST/
+BLACK_LIST; tracer-side casting imperative/tracer.cc:223-231, amp_auto_cast.cc).
+
+On TPU the low-precision dtype is bfloat16 by default (fp16 supported for
+parity).  Ops call :func:`cast_for_op` on their matmul-class inputs; the
+active policy decides whether to cast.  Everything is trace-friendly: the
+policy is host-side python state read at trace time, so a jitted train step
+bakes the policy in (the reference does the same — the cast ops are recorded
+into the program).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+# Op categories (mirrors the reference's list semantics).
+WHITE_OPS = {  # always compute in low precision (MXU-bound)
+    "matmul", "linear", "conv2d", "einsum", "attention",
+}
+BLACK_OPS = {  # keep fp32 (numerically sensitive)
+    "softmax", "log_softmax", "layer_norm", "batch_norm", "cross_entropy",
+    "mean", "sum", "exp", "log", "norm", "cumsum",
+}
+
+_tls = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enabled", "level", "dtype")
+
+    def __init__(self, enabled=False, level="O1", dtype=jnp.bfloat16):
+        self.enabled = enabled
+        self.level = level
+        self.dtype = dtype
+
+
+def _get() -> _AmpState:
+    st = getattr(_tls, "amp", None)
+    if st is None:
+        st = _AmpState()
+        _tls.amp = st
+    return st
+
+
+def push(enabled: bool, level: str, dtype) -> _AmpState:
+    prev = _get()
+    _tls.amp = _AmpState(enabled, level, dtype)
+    return prev
+
+
+def pop(prev: _AmpState) -> None:
+    _tls.amp = prev
+
+
+def enabled() -> bool:
+    return _get().enabled
+
+
+def amp_dtype():
+    return _get().dtype
+
+
+def level() -> str:
+    return _get().level
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def cast_for_op(op_name: str, *xs):
+    """Cast inputs per the active policy; returns inputs (possibly cast)."""
+    st = _get()
+    if not st.enabled:
+        return xs if len(xs) > 1 else xs[0]
+    if op_name in BLACK_OPS:
+        out = tuple(x.astype(jnp.float32) if _is_float(x) else x for x in xs)
+    elif op_name in WHITE_OPS or st.level == "O2":
+        # O1: cast white-list ops down.  O2: cast everything not black-listed.
+        out = tuple(x.astype(st.dtype) if _is_float(x) else x for x in xs)
+    else:
+        out = xs
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,133 @@
+"""Autograd (reference: python/paddle/autograd/ — PyLayer py_layer.py:202,
+functional vjp/jvp/jacobian/hessian functional.py:22-1133; engine
+paddle/fluid/imperative/basic_engine.cc).
+
+On TPU, autodiff is JAX's transform — there is no tape/engine to build (the
+reference's BasicEngine/GradNode graph collapses into jax.grad).  This module
+provides the paddle-shaped entry points plus a PyLayer built on
+jax.custom_vjp for user-defined gradients (used by recompute, ZeRO-3 hooks in
+the reference).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "value_and_grad", "vjp", "jvp", "jacobian", "hessian",
+           "PyLayer", "no_grad"]
+
+# functional autograd — direct jax transforms
+vjp = jax.vjp
+jvp = jax.jvp
+jacobian = jax.jacrev
+hessian = jax.hessian
+
+
+def grad(fn: Callable, argnums=0, has_aux: bool = False):
+    return jax.grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+class no_grad:
+    """Context/decorator: stop gradients through the wrapped computation.
+    In functional JAX there is no global tape; this is provided for API parity
+    and wraps outputs in stop_gradient when used as a decorator."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            return jax.tree_util.tree_map(jax.lax.stop_gradient, out)
+        return wrapper
+
+
+class PyLayerContext:
+    """Reference: autograd/py_layer.py:23 PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.attrs: Dict[str, Any] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class _PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        if name == "PyLayer":
+            return
+
+        @jax.custom_vjp
+        def _call(*args):
+            ctx = PyLayerContext()
+            return cls.forward(ctx, *args)
+
+        def _fwd(*args):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *args)
+            # residuals must be JAX pytrees: keep only the saved tensors
+            return out, (ctx._saved, args)
+
+        def _bwd(res, g):
+            saved, args = res
+            ctx = PyLayerContext()
+            ctx._saved = saved
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # pad to the number of primal args (non-tensor args get None→zero)
+            out = []
+            for a, gr in zip(args, list(grads) + [None] * (len(args) - len(grads))):
+                if gr is None:
+                    gr = jax.tree_util.tree_map(jnp.zeros_like, a)
+                out.append(gr)
+            return tuple(out)
+
+        _call.defvjp(_fwd, _bwd)
+        cls._impl = _call
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    """User-defined fwd/bwd (reference autograd/py_layer.py:202).
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return 3 * x ** 2 * grad
+
+    y = Cube.apply(x)
+    """
+
+    @classmethod
+    def apply(cls, *args):
+        return cls._impl(*args)
+
+    @staticmethod
+    def forward(ctx, *args):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError

@@ -1,0 +1,14 @@
+"""Framework substrate: flags, errors, dtypes/devices, RNG, io.
+
+TPU-native replacement for the reference's platform + framework layers
+(SURVEY.md L0/C1-C5): there is no DeviceContext pool or allocator to manage —
+XLA owns streams and buffers — so this layer reduces to configuration,
+diagnostics and identity.
+"""
+from . import dtype, errors, flags, io, random  # noqa: F401
+from .dtype import (CPUPlace, Place, TPUPlace, convert_dtype, get_device,  # noqa: F401
+                    is_compiled_with_tpu, set_device)
+from .errors import EnforceNotMet, enforce  # noqa: F401
+from .flags import define_flag, get_flags, set_flags  # noqa: F401
+from .io import load, save  # noqa: F401
+from .random import seed  # noqa: F401

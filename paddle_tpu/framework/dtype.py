@@ -1,0 +1,101 @@
+"""Dtype & device helpers (reference: phi/common/{data_type,place}.h analog).
+
+On TPU there is one accelerator device class; ``Place`` collapses to the JAX
+device object. We keep a tiny facade for API parity with the reference's
+CPUPlace/Place hierarchy (phi/common/place.h:109).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype names -> jnp dtypes (reference framework.proto VarType :118).
+_DTYPE_MAP = {
+    "float32": jnp.float32, "fp32": jnp.float32,
+    "float64": jnp.float64, "fp64": jnp.float64,
+    "float16": jnp.float16, "fp16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8, "uint8": jnp.uint8,
+    "int16": jnp.int16, "int32": jnp.int32, "int64": jnp.int64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64, "complex128": jnp.complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a string / numpy / jnp dtype to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _DTYPE_MAP[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype {dtype!r}") from None
+    return jnp.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+class Place:
+    """Device identity facade (reference phi/common/place.h)."""
+
+    def __init__(self, device: jax.Device):
+        self._device = device
+
+    @property
+    def device(self) -> jax.Device:
+        return self._device
+
+    def __repr__(self):
+        return f"Place({self._device})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self._device == other._device
+
+
+def CPUPlace() -> Place:
+    return Place(jax.devices("cpu")[0])
+
+
+def TPUPlace(index: int = 0) -> Place:
+    devs = jax.devices()
+    return Place(devs[index])
+
+
+_current_device = None
+
+
+def set_device(device: str):
+    """paddle.set_device analog: 'cpu' | 'tpu' | 'tpu:N'."""
+    global _current_device
+    if device == "cpu":
+        _current_device = CPUPlace()
+    elif device.startswith("tpu"):
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        _current_device = TPUPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    jax.config.update("jax_default_device", _current_device.device)
+    return _current_device
+
+
+def get_device() -> str:
+    if _current_device is None:
+        d = jax.devices()[0]
+    else:
+        d = _current_device.device
+    return f"{d.platform}:{d.id}"
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def to_numpy(x) -> np.ndarray:
+    return np.asarray(x)

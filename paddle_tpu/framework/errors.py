@@ -1,0 +1,57 @@
+"""Enforce-style error machinery.
+
+TPU-native analog of the reference's error taxonomy
+(reference: paddle/fluid/platform/enforce.h PADDLE_ENFORCE_*, phi/core/errors.h).
+Exceptions carry an error class so callers can branch on category the way the
+reference's ``platform::errors::InvalidArgument`` etc. allow.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error (reference: platform/enforce.h EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+def enforce(cond: bool, msg: str = "", exc=InvalidArgumentError) -> None:
+    """PADDLE_ENFORCE analog: raise ``exc`` with ``msg`` when cond is false."""
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg: str = "") -> None:
+    if a != b:
+        raise InvalidArgumentError(f"expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_shape(x, expected_rank=None, msg: str = "") -> None:
+    if expected_rank is not None and len(x.shape) != expected_rank:
+        raise InvalidArgumentError(
+            f"expected rank {expected_rank}, got shape {tuple(x.shape)}. {msg}")

@@ -1,0 +1,80 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's three-tier flag system
+(reference: paddle/fluid/platform/flags.cc `PADDLE_DEFINE_EXPORTED_*`,
+pybind/global_value_getter_setter.cc, env parsing in platform/init.cc:87-109).
+
+Flags are plain python values in a process-global registry; every flag can be
+seeded from the environment as ``FLAGS_<name>`` at import time, and mutated at
+runtime via :func:`set_flags` (the ``paddle.set_flags`` analog).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_registry: Dict[str, Any] = {}
+_defaults: Dict[str, Any] = {}
+
+
+def _coerce(env_value: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return env_value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(env_value)
+    if isinstance(default, float):
+        return float(env_value)
+    return env_value
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Register a flag, seeding from env var ``FLAGS_<name>`` if present."""
+    with _lock:
+        if name in _registry:
+            return
+        value = default
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            value = _coerce(env, default)
+        _registry[name] = value
+        _defaults[name] = default
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    with _lock:
+        if names is None:
+            return dict(_registry)
+        if isinstance(names, str):
+            names = [names]
+        return {n: _registry[n] for n in names}
+
+
+def get_flag(name: str) -> Any:
+    with _lock:
+        return _registry[name]
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise KeyError(f"unknown flag {name!r}; define_flag it first")
+            _registry[name] = value
+
+
+# ---------------------------------------------------------------------------
+# Built-in flags (the subset of the reference's 55 exported flags that makes
+# sense on TPU; reference platform/flags.cc).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Per-step nan/inf scan of outputs/grads (reference "
+            "operator.cc:1252 FLAGS_check_nan_inf).")
+define_flag("benchmark", False, "Synchronize after each step for timing.")
+define_flag("use_pallas_kernels", True,
+            "Use hand-written Pallas kernels where available (vs pure XLA).")
+define_flag("amp_dtype", "bfloat16", "Low-precision dtype for AMP.")
+define_flag("dataloader_use_native", True,
+            "Use the C++ prefetch core for DataLoader when built.")
+define_flag("log_level", 0, "VLOG-style verbosity (higher = chattier).")

@@ -1,0 +1,66 @@
+"""Checkpoint save/load (reference: python/paddle/framework/io.py —
+paddle.save:568 / paddle.load:784, pickle-based state_dicts; static-graph
+save_persistables fluid/io.py:668).
+
+Single-host path: numpy-ified pytrees in a pickle file.  The sharded /
+re-shardable distributed checkpoint (orbax-style, the auto_parallel
+converter analog) lives in paddle_tpu.distributed.checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(obj):
+    def conv(x):
+        if isinstance(x, jax.Array):
+            if jnp.issubdtype(x.dtype, jnp.bfloat16):
+                # numpy has no bf16; stash as fp32 with a marker
+                return _BF16(np.asarray(x.astype(jnp.float32)))
+            return np.asarray(x)
+        return x
+    return jax.tree_util.tree_map(conv, obj)
+
+
+class _BF16:
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+
+def _from_host(obj):
+    def conv(x):
+        if isinstance(x, _BF16):
+            return jnp.asarray(x.arr).astype(jnp.bfloat16)
+        if isinstance(x, np.ndarray):
+            return jnp.asarray(x)
+        return x
+    return jax.tree_util.tree_map(
+        conv, obj, is_leaf=lambda x: isinstance(x, _BF16))
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    """paddle.save analog: pickles a (nested) state_dict to path."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    """paddle.load analog."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return jax.tree_util.tree_map(
+            lambda x: x.arr if isinstance(x, _BF16) else x, obj,
+            is_leaf=lambda x: isinstance(x, _BF16))
+    return _from_host(obj)

@@ -1,0 +1,122 @@
+"""Framework RNG: a global, splittable seed stream.
+
+Design: JAX's functional threefry PRNG is the substrate.  For eager-mode
+ergonomics (the reference's dygraph generators, paddle.seed) we keep a global
+stateful *stream* of keys; for jitted training steps the user threads explicit
+keys (idiomatic JAX).  The distributed RNG-state tracker that tensor
+parallelism needs (reference: fleet/meta_parallel/parallel_layers/random.py:32
+``RNGStatesTracker``) lives in paddle_tpu.distributed.random and builds on the
+same key type.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+class Generator:
+    """Stateful key stream over jax.random (dygraph Generator analog)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._count = 0
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._count = 0
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        """Split off a fresh key (advances the stream)."""
+        self._key, sub = jax.random.split(self._key)
+        self._count += 1
+        return sub
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        seed, count = state
+        self.manual_seed(seed)
+        for _ in range(count):
+            self.next_key()
+
+
+def default_generator() -> Generator:
+    gen = getattr(_state, "generator", None)
+    if gen is None:
+        gen = Generator(0)
+        _state.generator = gen
+    return gen
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed analog: reseed the global generator (and numpy for host-side
+    shuffling in the data pipeline)."""
+    np.random.seed(value % (2 ** 32))
+    return default_generator().manual_seed(value)
+
+
+def next_key() -> jax.Array:
+    """Fresh PRNG key from the global stream (eager-mode convenience)."""
+    return default_generator().next_key()
+
+
+def key_for(seed_value: Optional[int]) -> jax.Array:
+    """Key from an explicit seed, or from the global stream when None."""
+    if seed_value is None:
+        return next_key()
+    return jax.random.key(seed_value)
+
+
+# ---------------------------------------------------------------------------
+# Key scope: trace-safe per-op keys for jitted programs.
+#
+# Inside ``key_scope(step_key)`` every stochastic op (dropout etc.) draws
+# ``fold_in(step_key, n)`` where n is the op's call index — deterministic by
+# program position, so a jitted train step re-traced with the same key yields
+# the same masks (the analog of the reference's counter-based Philox offsets,
+# fused_dropout_common.h GetSeedDataAndIncrement, and the per-op seed attrs on
+# fused_attention_op.cc:292-311).  Outside any scope, ops fall back to the
+# global eager stream.
+# ---------------------------------------------------------------------------
+import contextlib  # noqa: E402
+
+
+class _KeyScope:
+    __slots__ = ("key", "count")
+
+    def __init__(self, key):
+        self.key = key
+        self.count = 0
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    prev = getattr(_state, "key_scope", None)
+    _state.key_scope = _KeyScope(key)
+    try:
+        yield
+    finally:
+        _state.key_scope = prev
+
+
+def op_key() -> jax.Array:
+    """Key for one stochastic op: scoped fold_in under jit, else eager stream."""
+    scope = getattr(_state, "key_scope", None)
+    if scope is not None:
+        k = jax.random.fold_in(scope.key, scope.count)
+        scope.count += 1
+        return k
+    return next_key()

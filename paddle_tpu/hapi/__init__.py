@@ -1,0 +1,2 @@
+"""High-level API (reference: python/paddle/hapi)."""
+from .model import Model  # noqa: F401

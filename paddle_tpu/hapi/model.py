@@ -1,0 +1,218 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py — Model:907,
+fit:1045, evaluate, predict, save/load; Keras-style train loop).
+
+TPU-native: `prepare()` builds ONE jitted train step (forward+backward+update)
+— the whole-program compilation that replaces the reference's dual
+dygraph/static execution paths.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import random as fw_random
+from ..framework.errors import enforce
+from ..io import DataLoader
+from ..metric import Metric
+
+__all__ = ["Model"]
+
+
+def _tuplify(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._loss = None
+        self._optimizer = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_fn = None
+        self._opt_state = None
+        self._amp_level = None
+
+    # -- setup ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _tuplify(metrics) if metrics is not None else []
+        self._amp_level = (amp_configs or {}).get("level") if isinstance(
+            amp_configs, dict) else amp_configs
+
+        net, opt, loss_fn = self.network, self._optimizer, self._loss
+        amp_level = self._amp_level
+
+        def train_step(trainable, rest, opt_state, key, *data):
+            """Differentiate w.r.t. trainable params only; buffers (`rest`)
+            flow through mutable apply."""
+            *inputs, label = data
+
+            def compute_loss(tp):
+                variables = {**rest, **tp}
+                with fw_random.key_scope(key):
+                    if amp_level:
+                        from .. import amp as amp_mod
+                        with amp_mod.auto_cast(level=amp_level):
+                            out, newv = net.apply(variables, *inputs,
+                                                  mutable=True)
+                    else:
+                        out, newv = net.apply(variables, *inputs, mutable=True)
+                return loss_fn(out, label), (out, newv)
+
+            (loss_v, (out, new_vars)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(trainable)
+            new_trainable, new_opt_state = opt.apply_gradients(
+                grads, trainable, opt_state)
+            merged = dict(new_vars)
+            merged.update(new_trainable)
+            return loss_v, out, merged, new_opt_state
+
+        def eval_fn(params, *data):
+            *inputs, label = data
+            out = net.apply(params, *inputs)
+            return loss_fn(out, label) if loss_fn is not None else 0.0, out
+
+        self._train_step = jax.jit(train_step)
+        self._eval_fn = jax.jit(eval_fn)
+
+    # -- per-batch --------------------------------------------------------
+    def _variables(self):
+        return self.network.state_dict()
+
+    def train_batch(self, inputs, labels=None):
+        enforce(self._train_step is not None, "call prepare() first")
+        self.network.train()
+        variables = self._variables()
+        trainable = self.network.trainable_variables()
+        rest = {k: v for k, v in variables.items() if k not in trainable}
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init(trainable)
+        data = [jnp.asarray(np.asarray(x)) for x in
+                (*_tuplify(inputs), *_tuplify(labels))]
+        key = fw_random.next_key()
+        loss, out, new_params, self._opt_state = self._train_step(
+            trainable, rest, self._opt_state, key, *data)
+        self.network.set_state_dict(new_params, strict=False)
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(np.asarray(out), np.asarray(data[-1]))
+                     if hasattr(m, "compute") else np.asarray(out))
+            metrics.append(m.accumulate())
+        return float(loss), metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        params = self._variables()
+        data = [jnp.asarray(np.asarray(x)) for x in
+                (*_tuplify(inputs), *_tuplify(labels))]
+        loss, out = self._eval_fn(params, *data)
+        return float(loss), out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        params = self._variables()
+        return self.network.apply(
+            params, *[jnp.asarray(np.asarray(x)) for x in _tuplify(inputs)])
+
+    # -- loops ------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, shuffle: bool = True,
+            num_workers: int = 0, verbose: int = 1, drop_last: bool = False):
+        if not isinstance(train_data, DataLoader):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            t0 = time.time()
+            for step, batch in enumerate(train_loader):
+                *inputs, label = batch
+                loss, metrics = self.train_batch(inputs, label)
+                history["loss"].append(loss)
+                if verbose and step % log_freq == 0:
+                    m_str = " ".join(
+                        f"{m.name()}: {v if not isinstance(v, list) else v[0]:.4f}"
+                        for m, v in zip(self._metrics, metrics))
+                    print(f"Epoch {epoch+1}/{epochs} step {step} "
+                          f"loss: {loss:.4f} {m_str}")
+            if verbose:
+                print(f"Epoch {epoch+1} done in {time.time()-t0:.1f}s")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+            if save_dir:
+                self.save(f"{save_dir}/epoch_{epoch}")
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 1, num_workers: int = 0):
+        if not isinstance(eval_data, DataLoader):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            *inputs, label = batch
+            loss, out = self.eval_batch(inputs, label)
+            losses.append(loss)
+            for m in self._metrics:
+                m.update(m.compute(np.asarray(out), np.asarray(label)))
+        result = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0):
+        if not isinstance(test_data, DataLoader):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outs = []
+        for batch in loader:
+            inputs = batch[:-1] if isinstance(batch, (tuple, list)) and \
+                len(batch) > 1 else _tuplify(batch)
+            outs.append(np.asarray(self.predict_batch(list(inputs))))
+        return outs
+
+    # -- io ---------------------------------------------------------------
+    def save(self, path: str):
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if self._opt_state is not None:
+            framework.save(self._opt_state, path + ".pdopt")
+
+    def load(self, path: str, reset_optimizer: bool = False):
+        self.network.set_state_dict(framework.load(path + ".pdparams"))
+        if not reset_optimizer:
+            import os
+            if os.path.exists(path + ".pdopt"):
+                self._opt_state = framework.load(path + ".pdopt")
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines, total = [], 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name:40s} {str(p.shape):20s} {n}")
+        out = "\n".join(lines) + f"\nTotal params: {total}"
+        print(out)
+        return {"total_params": total}

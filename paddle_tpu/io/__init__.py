@@ -1,0 +1,406 @@
+"""Data pipeline (reference: python/paddle/io/ + fluid/dataloader/ —
+multiprocess workers dataloader_iter.py:338, worker loop worker.py:255,
+shared-memory transport via mmap_allocator.cc, C++ double-buffer prefetch
+operators/reader/buffered_reader.cc; see SURVEY.md A7).
+
+TPU-native design: python worker processes produce numpy batches over a
+multiprocessing queue; a background prefetch thread stages host→device
+transfers (jax.device_put) ahead of consumption — the buffered_reader analog.
+When the native C++ prefetch core is built (paddle_tpu/lib/), the shared
+memory ring buffer replaces the pickle queue transport.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.errors import enforce
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "Subset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
+    "BatchSampler", "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+]
+
+
+# ---------------------------------------------------------------------------
+# Datasets (reference: python/paddle/io/dataset.py)
+# ---------------------------------------------------------------------------
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        arrs = [np.asarray(t) for t in tensors]
+        enforce(all(a.shape[0] == arrs[0].shape[0] for a in arrs),
+                "all tensors must share dim 0")
+        self.tensors = arrs
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, tuple) else (item,))
+        return tuple(out)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    enforce(sum(lengths) == len(dataset), "lengths must sum to dataset size")
+    perm = np.random.permutation(len(dataset))
+    out, off = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[off:off + n].tolist()))
+        off += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Samplers (reference: python/paddle/io/sampler.py, batch_sampler.py)
+# ---------------------------------------------------------------------------
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle: bool = False,
+                 batch_size: int = 1, drop_last: bool = False):
+        enforce((dataset is None) != (sampler is None),
+                "provide exactly one of dataset/sampler")
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Reference: python/paddle/io/dataloader/batch_sampler.py
+    DistributedBatchSampler — shards sample indices across data-parallel
+    ranks (epoch-seeded shuffle so every rank permutes identically)."""
+
+    def __init__(self, dataset, batch_size: int, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = False,
+                 drop_last: bool = False):
+        from .. import distributed as dist
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else dist.get_world_size()
+        self.local_rank = rank if rank is not None else dist.get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: self.total_size - n]  # pad to even shards
+        indices = indices[self.local_rank: self.total_size: self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# ---------------------------------------------------------------------------
+# Collate
+# ---------------------------------------------------------------------------
+def default_collate_fn(batch: List[Any]):
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    return np.asarray(batch)
+
+
+# ---------------------------------------------------------------------------
+# Worker process loop (reference: fluid/dataloader/worker.py:255 _worker_loop)
+# ---------------------------------------------------------------------------
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
+                 worker_init_fn):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    np.random.seed((np.random.SeedSequence().entropy + worker_id) % (2**31))
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            result_queue.put((batch_id, collate_fn(samples), None))
+        except Exception as e:  # propagate across the process boundary
+            result_queue.put((batch_id, None, repr(e)))
+
+
+class DataLoader:
+    """Reference: paddle.io.DataLoader (fluid/reader.py).
+
+    num_workers=0: synchronous in-process loading.
+    num_workers>0: worker subprocesses (index queue → result queue), batches
+    re-ordered by id, `prefetch_factor` batches in flight per worker.
+    A device-prefetch thread overlaps jax.device_put with consumption.
+    """
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, batch_sampler=None,
+                 num_workers: int = 0, collate_fn=None, use_shared_memory=True,
+                 prefetch_factor: int = 2, worker_init_fn=None,
+                 to_device: bool = True, return_list=True):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.worker_init_fn = worker_init_fn
+        self.to_device = to_device
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if self._iterable_mode:
+            gen = self._iter_iterable()
+        elif self.num_workers == 0:
+            gen = self._iter_single()
+        else:
+            gen = self._iter_multiprocess()
+        if self.to_device:
+            gen = _DevicePrefetcher(gen)
+        return gen
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queue = ctx.Queue()
+        result_queue = ctx.Queue()
+        workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queue, result_queue, self.collate_fn,
+                      wid, self.worker_init_fn),
+                daemon=True)
+            w.start()
+            workers.append(w)
+
+        def shutdown():
+            for _ in workers:
+                try:
+                    index_queue.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1.0)
+                if w.is_alive():
+                    w.terminate()
+
+        try:
+            sampler_iter = enumerate(iter(self.batch_sampler))
+            in_flight = {}
+            reorder = {}
+            next_out = 0
+            # prime
+            for _ in range(self.prefetch_factor * self.num_workers):
+                try:
+                    bid, indices = next(sampler_iter)
+                except StopIteration:
+                    break
+                index_queue.put((bid, indices))
+                in_flight[bid] = True
+            while in_flight:
+                bid, batch, err = result_queue.get()
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                del in_flight[bid]
+                reorder[bid] = batch
+                try:
+                    nbid, indices = next(sampler_iter)
+                    index_queue.put((nbid, indices))
+                    in_flight[nbid] = True
+                except StopIteration:
+                    pass
+                while next_out in reorder:
+                    yield reorder.pop(next_out)
+                    next_out += 1
+        finally:
+            shutdown()
+
+
+class _DevicePrefetcher:
+    """Host→device double buffering (buffered_reader.cc analog): keeps one
+    batch already on device while the consumer works on the previous one."""
+
+    def __init__(self, gen: Iterable, depth: int = 2):
+        self._gen = iter(gen)
+        self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+        self._done = object()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for batch in self._gen:
+                staged = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a) if isinstance(a, np.ndarray) else a,
+                    batch)
+                self._queue.put(staged)
+        except Exception as e:
+            self._queue.put(e)
+            return
+        self._queue.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._done:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item

@@ -1,0 +1,14 @@
+"""paddle_tpu.nn — layers & functional ops (reference: python/paddle/nn)."""
+from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                         ClipGradByValue)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, LayerList, Parameter, ParameterList, Sequential  # noqa: F401
+from .layers import (GELU, SiLU, AdaptiveAvgPool2D, AvgPool2D,  # noqa: F401
+                     BatchNorm1D, BatchNorm2D, BatchNorm3D, BCEWithLogitsLoss,
+                     Conv2D, CrossEntropyLoss, Dropout, Embedding, Flatten,
+                     GroupNorm, Hardsigmoid, Hardswish, L1Loss, LayerNorm,
+                     LeakyReLU, Linear, LogSoftmax, MaxPool2D, Mish, MSELoss,
+                     MultiHeadAttention, NLLLoss, ReLU, ReLU6, RMSNorm,
+                     Sigmoid, SmoothL1Loss, Softmax, Softplus, Tanh,
+                     TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,498 @@
+"""Functional op surface (reference: python/paddle/nn/functional/*).
+
+Everything is a pure function over jax arrays (Parameters accepted via
+__jax_array__).  AMP policy hooks (see paddle_tpu/amp/state.py) are applied at
+the matmul/conv class ops, mirroring the reference tracer's cast insertion
+(imperative/tracer.cc:223-231).  Shape/dtype validation plays the role of the
+reference's infermeta layer (paddle/phi/infermeta/) — enforced in python at
+trace time, for free at runtime.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..amp import state as amp_state
+from ..framework import random as fw_random
+from ..framework.errors import InvalidArgumentError, enforce
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else x
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: phi/kernels/*_kernel.h activation family)
+# ---------------------------------------------------------------------------
+def relu(x):
+    return jnp.maximum(_arr(x), 0)
+
+
+def relu6(x):
+    return jnp.clip(_arr(x), 0, 6)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(_arr(x), approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(_arr(x))
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(_arr(x))
+
+
+def tanh(x):
+    return jnp.tanh(_arr(x))
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    x = _arr(x)
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(_arr(x), alpha)
+
+
+def hardswish(x):
+    x = _arr(x)
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardsigmoid(x):
+    return jnp.clip(_arr(x) / 6.0 + 0.5, 0.0, 1.0)
+
+
+def mish(x):
+    x = _arr(x)
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    x = _arr(x)
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(bx)) / beta)
+
+
+def softmax(x, axis: int = -1):
+    x = amp_state.cast_for_op("softmax", _arr(x))
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    x = amp_state.cast_for_op("log_softmax", _arr(x))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Linear / matmul (MXU path; reference phi/kernels/matmul_kernel.h + F.linear)
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None):
+    """y = x @ W + b with W shaped (in, out) — paddle convention."""
+    x, weight = amp_state.cast_for_op("linear", _arr(x), _arr(weight))
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + _arr(bias).astype(y.dtype)
+    return y
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    x, y = amp_state.cast_for_op("matmul", _arr(x), _arr(y))
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def embedding(ids, weight, padding_idx: Optional[int] = None):
+    """Reference: phi embedding kernel + nn/functional/input.py."""
+    ids = _arr(ids)
+    weight = _arr(weight)
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling (reference phi conv kernels; NCHW paddle layout —
+# XLA's layout assignment re-tiles for the MXU internally)
+# ---------------------------------------------------------------------------
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW"):
+    """weight layout (out_ch, in_ch/groups, kh, kw) — paddle/OIHW."""
+    x, weight = amp_state.cast_for_op("conv2d", _arr(x), _arr(weight))
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "HWIO", "NHWC"))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        b = _arr(bias).astype(y.dtype)
+        y = y + (b[None, :, None, None] if data_format == "NCHW" else b)
+    return y
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    # x: (N, C, L), weight: (O, I, K)
+    y = conv2d(x[..., None, :], _arr(weight)[:, :, None, :], bias=bias,
+               stride=(1, stride), padding=(0, padding if isinstance(padding, int) else padding[0]),
+               dilation=(1, dilation), groups=groups)
+    return y[..., 0, :]
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    x = _arr(x)
+    k, s = _pair(kernel_size), _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, window, strides, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    x = _arr(x)
+    k, s = _pair(kernel_size), _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pads)
+    return summed / counts
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    x = _arr(x)
+    out_h, out_w = _pair(output_size)
+    if data_format == "NCHW":
+        in_h, in_w = x.shape[2], x.shape[3]
+    else:
+        in_h, in_w = x.shape[1], x.shape[2]
+    enforce(in_h % out_h == 0 and in_w % out_w == 0,
+            "adaptive pool requires divisible sizes in this build")
+    return avg_pool2d(x, (in_h // out_h, in_w // out_w),
+                      stride=(in_h // out_h, in_w // out_w),
+                      data_format=data_format)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference phi layer_norm/batch_norm kernels)
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon: float = 1e-5):
+    x = _arr(x)
+    orig_dtype = x.dtype
+    xf = amp_state.cast_for_op("layer_norm", x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    naxes = len(normalized_shape) if normalized_shape else 1
+    axes = tuple(range(xf.ndim - naxes, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        y = y * _arr(weight).astype(y.dtype)
+    if bias is not None:
+        y = y + _arr(bias).astype(y.dtype)
+    return y.astype(orig_dtype)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    x = _arr(x)
+    orig_dtype = x.dtype
+    xf = amp_state.cast_for_op("layer_norm", x)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        y = y * _arr(weight).astype(y.dtype)
+    return y.astype(orig_dtype)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    """Returns (y, new_running_mean, new_running_var)."""
+    x = _arr(x)
+    orig_dtype = x.dtype
+    xf = amp_state.cast_for_op("batch_norm", x)
+    if data_format == "NCHW":
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    if training:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        n = x.size // mean.size
+        unbiased = var * n / max(n - 1, 1)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    y = (xf - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        y = y * _arr(weight).reshape(shape)
+    if bias is not None:
+        y = y + _arr(bias).reshape(shape)
+    return y.astype(orig_dtype), new_rm, new_rv
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    x = _arr(x)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape(n, num_groups, c // num_groups, *x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * _arr(weight).reshape(shape)
+    if bias is not None:
+        y = y + _arr(bias).reshape(shape)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Dropout (counter-based deterministic RNG under key_scope; reference
+# phi dropout kernel + fused_dropout_common.h seed/offset scheme)
+# ---------------------------------------------------------------------------
+def dropout(x, p: float = 0.5, training: bool = True,
+            mode: str = "upscale_in_train", key=None):
+    x = _arr(x)
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" or training else x * (1 - p)
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    if key is None:
+        key = fw_random.op_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype)).astype(x.dtype)
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Losses (reference phi cross_entropy / softmax_with_cross_entropy kernels)
+# ---------------------------------------------------------------------------
+def one_hot(x, num_classes: int, dtype=jnp.float32):
+    return jax.nn.one_hot(_arr(x), num_classes, dtype=dtype)
+
+
+def cross_entropy(logits, label, soft_label: bool = False,
+                  reduction: str = "mean", ignore_index: int = -100,
+                  axis: int = -1, label_smoothing: float = 0.0):
+    """softmax_with_cross_entropy semantics (reference
+    phi/kernels/cross_entropy_kernel.h)."""
+    logits = amp_state.cast_for_op("cross_entropy", _arr(logits))
+    label = _arr(label)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+    else:
+        if label.ndim == logits.ndim:
+            label = jnp.squeeze(label, axis=axis)
+        num_classes = logits.shape[axis]
+        valid = label != ignore_index
+        safe_label = jnp.where(valid, label, 0)
+        picked = jnp.take_along_axis(
+            logp, safe_label[..., None].astype(jnp.int32), axis=axis)[..., 0]
+        if label_smoothing > 0.0:
+            smooth = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = jnp.where(valid, -picked, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def nll_loss(log_probs, label, reduction: str = "mean"):
+    picked = jnp.take_along_axis(
+        _arr(log_probs), _arr(label)[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    loss = -picked
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    d = jnp.square(_arr(input) - _arr(label))
+    if reduction == "mean":
+        return jnp.mean(d)
+    if reduction == "sum":
+        return jnp.sum(d)
+    return d
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    d = jnp.abs(_arr(input) - _arr(label))
+    if reduction == "mean":
+        return jnp.mean(d)
+    if reduction == "sum":
+        return jnp.sum(d)
+    return d
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction: str = "mean"):
+    logit, label = _arr(logit), _arr(label)
+    loss = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0):
+    d = jnp.abs(_arr(input) - _arr(label))
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Attention (XLA reference path; the Pallas fused kernel lives in
+# paddle_tpu/ops/attention.py — this is the semantic baseline it must match,
+# mirroring reference fused/fmha_ref.h:58 FMHARef)
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
+                                 is_causal: bool = False, training: bool = True,
+                                 scale: Optional[float] = None):
+    """q,k,v: (batch, num_heads, seq, head_dim). attn_mask is additive."""
+    q, k = amp_state.cast_for_op("attention", _arr(q), _arr(k))
+    v = _arr(v)
+    head_dim = q.shape[-1]
+    if scale is None:
+        scale = head_dim ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    scores = scores.astype(jnp.float32)
+    if attn_mask is not None:
+        scores = scores + _arr(attn_mask).astype(scores.dtype)
+    if is_causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference: operators/fused_softmax_mask_upper_
+    triangle_op.cu — the GPT attention mask fusion)."""
+    x = _arr(x)
+    ql, kl = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+    xf = x.astype(jnp.float32)
+    xf = jnp.where(causal, xf, jnp.finfo(jnp.float32).min)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Misc tensor ops
+# ---------------------------------------------------------------------------
+def pad(x, paddings, mode: str = "constant", value: float = 0.0):
+    """paddle.nn.functional.pad semantics: a flat list of (before, after)
+    pairs applied to the trailing dims, last dim first — so [l, r, t, b] on a
+    4-D NCHW tensor pads W by (l, r) and H by (t, b).  A full ndim*2 list
+    pads every dim in order."""
+    x = _arr(x)
+    paddings = list(paddings)
+    enforce(len(paddings) % 2 == 0, "paddings must have an even length")
+    npairs = len(paddings) // 2
+    enforce(npairs <= x.ndim, "more padding pairs than tensor dims")
+    if npairs == x.ndim:
+        cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # trailing dims, last dim first (paddle/torch flat-pad convention)
+        cfg = [(0, 0)] * x.ndim
+        for i in range(npairs):
+            cfg[x.ndim - 1 - i] = (paddings[2 * i], paddings[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    return jnp.pad(x, cfg, mode=mode)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(_arr(x), min, max)
+
+
+def normalize(x, p: float = 2.0, axis: int = 1, epsilon: float = 1e-12):
+    x = _arr(x)
+    norm = jnp.maximum(jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True), epsilon)
+    return x / norm
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                data_format="NCHW"):
+    x = _arr(x)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        if size is None:
+            size = (int(h * scale_factor), int(w * scale_factor))
+        method = {"nearest": "nearest", "bilinear": "linear"}[mode]
+        return jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    n, h, w, c = x.shape
+    if size is None:
+        size = (int(h * scale_factor), int(w * scale_factor))
+    method = {"nearest": "nearest", "bilinear": "linear"}[mode]
+    return jax.image.resize(x, (n, size[0], size[1], c), method=method)
+
+
+def flatten(x, start_axis: int = 0, stop_axis: int = -1):
+    x = _arr(x)
+    nd = x.ndim
+    if stop_axis < 0:
+        stop_axis += nd
+    shape = x.shape[:start_axis] + (-1,) + x.shape[stop_axis + 1:]
+    return x.reshape(shape)

@@ -1,0 +1,107 @@
+"""Parameter initializers (reference: python/paddle/nn/initializer/*).
+
+Each initializer is a callable ``init(key, shape, dtype) -> jax.Array`` — the
+idiomatic JAX signature — wrapped in a tiny class for paddle-shaped API parity
+(``nn.initializer.XavierUniform()`` etc.).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels are OIHW (paddle convention, see nn/layers.py Conv2D):
+    # fan_in = in_ch * receptive field, fan_out = out_ch * receptive field.
+    receptive = math.prod(shape[2:])
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        x = self.mean + self.std * jax.random.normal(key, shape, dtype=jnp.float32)
+        return x.astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        x = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope=0.0):
+        self.a = negative_slope
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope=0.0):
+        self.a = negative_slope
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        std = gain / math.sqrt(fan_in)
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+# paddle-style aliases
+constant = Constant
+uniform = Uniform
+normal = Normal

@@ -1,0 +1,494 @@
+"""Layer & Parameter: the module system.
+
+TPU-first design, replacing the reference's dual static/dygraph stacks
+(reference: python/paddle/fluid/dygraph/layers.py ``Layer``; parameter storage
+fluid/framework.py ``Parameter``).  One codepath, two modes:
+
+- **Eager**: call ``layer(x)`` on concrete arrays; every op executes op-by-op
+  (JAX eager).  This is the "dygraph" mode — debugging ergonomics.
+- **Compiled**: ``out, new_state = layer.apply(variables, x)`` is a *pure
+  function* of a flat variables dict — jit it, grad it, shard it.  This is the
+  "static graph" mode; one XLA compilation replaces the reference's entire
+  executor/interpreter stack (reference framework/new_executor/
+  interpretercore.cc — see SURVEY.md A13 for why no interpreter is built).
+
+Parameters are wrappers over jax.Array implementing ``__jax_array__`` so they
+drop into any jnp/lax op unchanged; ``apply`` temporarily rebinds their values
+to the caller-provided pytree (tracers under jit), restoring afterwards.
+Mutable buffers (BN running stats) updated during ``apply`` are collected and
+returned as the updated variables dict.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as fw_random
+from ..framework.dtype import convert_dtype
+from ..framework.errors import InvalidArgumentError, enforce
+from . import initializer as I
+
+
+class Parameter:
+    """A named, trainable tensor. Drops into jnp ops via __jax_array__."""
+
+    __slots__ = ("value", "trainable", "name", "is_bias", "_grad")
+
+    def __init__(self, value, trainable: bool = True, name: str = "",
+                 is_bias: bool = False):
+        self.value = value
+        self.trainable = trainable
+        self.name = name
+        self.is_bias = is_bias
+        self._grad = None
+
+    # -- jax interop ------------------------------------------------------
+    def __jax_array__(self):
+        return self.value
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def size(self):
+        return self.value.size
+
+    # paddle parity: stop_gradient is the inverse of trainable
+    @property
+    def stop_gradient(self):
+        return not self.trainable
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.trainable = not v
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def set_value(self, value):
+        self.value = jnp.asarray(value, dtype=self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def astype(self, dtype):
+        return self.value.astype(dtype)
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.value.dtype}, trainable={self.trainable})")
+
+    # -- arithmetic (delegate to the underlying array) --------------------
+    def _v(self, other):
+        return other.value if isinstance(other, Parameter) else other
+
+    def __add__(self, o): return self.value + self._v(o)
+    def __radd__(self, o): return self._v(o) + self.value
+    def __sub__(self, o): return self.value - self._v(o)
+    def __rsub__(self, o): return self._v(o) - self.value
+    def __mul__(self, o): return self.value * self._v(o)
+    def __rmul__(self, o): return self._v(o) * self.value
+    def __truediv__(self, o): return self.value / self._v(o)
+    def __rtruediv__(self, o): return self._v(o) / self.value
+    def __matmul__(self, o): return self.value @ self._v(o)
+    def __rmatmul__(self, o): return self._v(o) @ self.value
+    def __neg__(self): return -self.value
+    def __getitem__(self, idx): return self.value[idx]
+    def __array__(self, dtype=None):
+        a = np.asarray(self.value)
+        return a.astype(dtype) if dtype is not None else a
+
+    @property
+    def T(self):
+        return self.value.T
+
+    def reshape(self, *shape):
+        return self.value.reshape(*shape)
+
+
+# Thread-local scope used by apply() to collect in-trace buffer mutations.
+_scope = threading.local()
+
+
+def _mutation_sink() -> Optional[Dict[str, Any]]:
+    return getattr(_scope, "sink", None)
+
+
+class Layer:
+    """Base class for all network modules (reference dygraph layers.py:Layer)."""
+
+    def __init__(self, name_scope: Optional[str] = None):
+        # use object.__setattr__ to dodge our own interceptor
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+
+    # -- attribute interception ------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise InvalidArgumentError(
+                    "call super().__init__() before assigning parameters")
+            params[name] = value
+            subs.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            subs[name] = value
+            params.pop(name, None) if params else None
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if subs is not None and name in subs:
+                del subs[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- construction helpers --------------------------------------------
+    def create_parameter(self, shape, dtype="float32", default_initializer=None,
+                         is_bias: bool = False, trainable: bool = True,
+                         attr=None) -> Parameter:
+        """Reference: Layer.create_parameter (dygraph layers.py)."""
+        dtype = convert_dtype(dtype)
+        init = default_initializer
+        if init is None and attr is not None and getattr(attr, "initializer", None):
+            init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(fw_random.next_key(), tuple(shape), dtype)
+        return Parameter(value, trainable=trainable, is_bias=is_bias)
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        self._buffers[name] = jnp.asarray(tensor)
+        self.__dict__.pop(name, None)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Parameter) -> Parameter:
+        self._parameters[name] = parameter
+        return parameter
+
+    # -- traversal --------------------------------------------------------
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p)
+
+    def sublayers(self, include_self: bool = False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix: str = ""
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for name, sub in self._sub_layers.items():
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_parameters(prefix=sp)
+
+    def parameters(self):
+        return [p for _, p in self.named_parameters()]
+
+    def named_buffers(self, prefix: str = ""):
+        for name, b in self._buffers.items():
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for name, sub in self._sub_layers.items():
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_buffers(prefix=sp)
+
+    def buffers(self):
+        return [b for _, b in self.named_buffers()]
+
+    # -- state dict -------------------------------------------------------
+    def state_dict(self, include_buffers: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = OrderedDict()
+        for name, p in self.named_parameters():
+            out[name] = p.value
+        if include_buffers:
+            for name, b in self.named_buffers():
+                out[name] = b
+        return out
+
+    def trainable_variables(self) -> Dict[str, Any]:
+        return OrderedDict((n, p.value) for n, p in self.named_parameters()
+                           if p.trainable)
+
+    def set_state_dict(self, state: Dict[str, Any], strict: bool = True):
+        own_params = dict(self.named_parameters())
+        buf_owners = {}
+        for path, sub in self.named_sublayers(include_self=True):
+            for bname in sub._buffers:
+                full = f"{path}.{bname}" if path else bname
+                buf_owners[full] = (sub, bname)
+        unexpected = []
+        for name, value in state.items():
+            if name in own_params:
+                p = own_params[name]
+                enforce(tuple(value.shape) == p.shape,
+                        f"shape mismatch for {name}: {tuple(value.shape)} vs {p.shape}")
+                p.value = jnp.asarray(value, dtype=p.value.dtype)
+            elif name in buf_owners:
+                sub, bname = buf_owners[name]
+                sub._buffers[bname] = jnp.asarray(value)
+            else:
+                unexpected.append(name)
+        if strict:
+            missing = [k for k in list(own_params) + list(buf_owners)
+                       if k not in state]
+            if unexpected or missing:
+                raise KeyError(
+                    f"state_dict mismatch: unexpected={unexpected}, "
+                    f"missing={missing}")
+        return self
+
+    load_dict = set_state_dict
+
+    # -- train / eval -----------------------------------------------------
+    def train(self):
+        object.__setattr__(self, "training", True)
+        for sub in self._sub_layers.values():
+            sub.train()
+        return self
+
+    def eval(self):
+        object.__setattr__(self, "training", False)
+        for sub in self._sub_layers.values():
+            sub.eval()
+        return self
+
+    def apply_fn(self, fn: Callable[["Layer"], None]):
+        """Apply ``fn`` to self and every sublayer (paddle Layer.apply)."""
+        for sub in self._sub_layers.values():
+            sub.apply_fn(fn)
+        fn(self)
+        return self
+
+    def astype(self, dtype):
+        """Cast all parameters/buffers in place (paddle Layer.to(dtype))."""
+        dtype = convert_dtype(dtype)
+        for _, p in self.named_parameters():
+            if jnp.issubdtype(p.value.dtype, jnp.floating):
+                p.value = p.value.astype(dtype)
+        for path, sub in self.named_sublayers(include_self=True):
+            for bname, b in list(sub._buffers.items()):
+                if jnp.issubdtype(b.dtype, jnp.floating):
+                    sub._buffers[bname] = b.astype(dtype)
+        return self
+
+    to = astype
+
+    # -- hooks ------------------------------------------------------------
+    def register_forward_post_hook(self, hook):
+        handle = len(self._forward_post_hooks)
+        self._forward_post_hooks[handle] = hook
+        return handle
+
+    def register_forward_pre_hook(self, hook):
+        handle = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle] = hook
+        return handle
+
+    # -- call -------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            r = hook(self, args)
+            if r is not None:
+                args = r
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            r = hook(self, args, out)
+            if r is not None:
+                out = r
+        return out
+
+    # -- buffer mutation (jit-safe) ---------------------------------------
+    def _update_buffer(self, name: str, value, full_name_hint: str = ""):
+        """Update a buffer such that apply() can observe it. In eager mode it
+        mutates in place; inside apply() the new (traced) value is recorded in
+        the mutation sink and returned from apply()."""
+        self._buffers[name] = value
+        sink = _mutation_sink()
+        if sink is not None:
+            sink[(id(self), name)] = value
+
+    # -- functional path --------------------------------------------------
+    @contextlib.contextmanager
+    def bind(self, variables: Dict[str, Any]):
+        """Temporarily substitute parameter/buffer values from a flat dict."""
+        own_params = dict(self.named_parameters())
+        buf_owners = {}
+        for path, sub in self.named_sublayers(include_self=True):
+            for bname in sub._buffers:
+                full = f"{path}.{bname}" if path else bname
+                buf_owners[full] = (sub, bname)
+        saved_p, saved_b = {}, {}
+        try:
+            for name, value in variables.items():
+                if name in own_params:
+                    saved_p[name] = own_params[name].value
+                    own_params[name].value = value
+                elif name in buf_owners:
+                    sub, bname = buf_owners[name]
+                    saved_b[name] = sub._buffers[bname]
+                    sub._buffers[bname] = value
+                # silently ignore extras (e.g. optimizer slots)
+            yield
+        finally:
+            for name, value in saved_p.items():
+                own_params[name].value = value
+            for name, (sub, bname) in buf_owners.items():
+                if name in saved_b:
+                    sub._buffers[bname] = saved_b[name]
+
+    def apply(self, variables: Dict[str, Any], *args, mutable: bool = False,
+              **kwargs):
+        """Pure-function forward: ``out = layer.apply(vars, *args)``.
+
+        With ``mutable=True`` returns ``(out, new_variables)`` where
+        new_variables contains updated buffer values (BN running stats etc.).
+        Safe under jax.jit / grad / shard_map.
+        """
+        prev_sink = _mutation_sink()
+        _scope.sink = {} if mutable else None
+        try:
+            with self.bind(variables):
+                out = self(*args, **kwargs)
+                if not mutable:
+                    return out
+                # map (layer id, buffer name) -> full path
+                id_to_path = {}
+                for path, sub in self.named_sublayers(include_self=True):
+                    for bname in sub._buffers:
+                        full = f"{path}.{bname}" if path else bname
+                        id_to_path[(id(sub), bname)] = full
+                new_vars = dict(variables)
+                for key, value in _scope.sink.items():
+                    if key in id_to_path:
+                        new_vars[id_to_path[key]] = value
+                return out, new_vars
+        finally:
+            _scope.sink = prev_sink
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else type(self).__name__ + "()"
+
+
+class Sequential(Layer):
+    """Reference: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    """Reference: paddle.nn.LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, layer: Layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, p: Parameter):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())

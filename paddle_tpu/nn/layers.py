@@ -1,0 +1,440 @@
+"""Layer classes (reference: python/paddle/nn/layer/*.py).
+
+Thin stateful wrappers over paddle_tpu.nn.functional; parameters follow paddle
+shape conventions (Linear weight is (in, out); Conv2D weight is OIHW).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, LayerList, Parameter, ParameterList, Sequential  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# Dense / embedding
+# ---------------------------------------------------------------------------
+class Linear(Layer):
+    """Reference: python/paddle/nn/layer/common.py Linear."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), dtype=dtype, attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_features,), dtype=dtype, is_bias=True, attr=bias_attr)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Embedding(Layer):
+    """Reference: python/paddle/nn/layer/common.py Embedding."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, dtype="float32"):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), dtype=dtype,
+            default_initializer=I.Normal(0.0, 1.0) if weight_attr is None else None,
+            attr=weight_attr)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+
+# ---------------------------------------------------------------------------
+# Conv / pooling
+# ---------------------------------------------------------------------------
+class Conv2D(Layer):
+    """Reference: python/paddle/nn/layer/conv.py Conv2D (NCHW, OIHW)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 dtype="float32"):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k[0] * k[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, k[0], k[1]), dtype=dtype,
+            default_initializer=I.Uniform(-bound, bound), attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_channels,), dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound), attr=bias_attr)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+class LayerNorm(Layer):
+    """Reference: python/paddle/nn/layer/norm.py LayerNorm."""
+
+    def __init__(self, normalized_shape, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self.normalized_shape, dtype=dtype,
+                default_initializer=I.Constant(1.0), attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                self.normalized_shape, dtype=dtype, is_bias=True, attr=bias_attr)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size: int, epsilon: float = 1e-6, dtype="float32"):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), dtype=dtype, default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format="NCHW", dtype="float32"):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), dtype=dtype,
+                default_initializer=I.Constant(1.0), attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (num_features,), dtype=dtype, is_bias=True, attr=bias_attr)
+        self.register_buffer("_mean", jnp.zeros((num_features,), convert_dtype(dtype)))
+        self.register_buffer("_variance", jnp.ones((num_features,), convert_dtype(dtype)))
+
+    def forward(self, x):
+        y, new_mean, new_var = F.batch_norm(
+            x, self._buffers["_mean"], self._buffers["_variance"],
+            self.weight, self.bias, training=self.training,
+            momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if self.training:
+            self._update_buffer("_mean", new_mean)
+            self._update_buffer("_variance", new_var)
+        return y
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups: int, num_channels: int, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            (num_channels,), dtype=dtype, default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_channels,), dtype=dtype, is_bias=True))
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon)
+
+
+# ---------------------------------------------------------------------------
+# Dropout / shaping / activations
+# ---------------------------------------------------------------------------
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train"):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        return F.flatten(x, self.start_axis, self.stop_axis)
+
+
+def _act_layer(fn, name):
+    class _Act(Layer):
+        def __init__(self, *a, **k):
+            super().__init__()
+            self._a, self._k = a, k
+
+        def forward(self, x):
+            return fn(x, *self._a, **self._k)
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _act_layer(F.relu, "ReLU")
+ReLU6 = _act_layer(F.relu6, "ReLU6")
+GELU = _act_layer(F.gelu, "GELU")
+SiLU = _act_layer(F.silu, "SiLU")
+Sigmoid = _act_layer(F.sigmoid, "Sigmoid")
+Tanh = _act_layer(F.tanh, "Tanh")
+LeakyReLU = _act_layer(F.leaky_relu, "LeakyReLU")
+Hardswish = _act_layer(F.hardswish, "Hardswish")
+Hardsigmoid = _act_layer(F.hardsigmoid, "Hardsigmoid")
+Mish = _act_layer(F.mish, "Mish")
+Softplus = _act_layer(F.softplus, "Softplus")
+Softmax = _act_layer(F.softmax, "Softmax")
+LogSoftmax = _act_layer(F.log_softmax, "LogSoftmax")
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+class CrossEntropyLoss(Layer):
+    def __init__(self, reduction: str = "mean", soft_label: bool = False,
+                 ignore_index: int = -100, label_smoothing: float = 0.0):
+        super().__init__()
+        self.reduction, self.soft_label = reduction, soft_label
+        self.ignore_index, self.label_smoothing = ignore_index, label_smoothing
+
+    def forward(self, logits, label):
+        return F.cross_entropy(logits, label, soft_label=self.soft_label,
+                               reduction=self.reduction,
+                               ignore_index=self.ignore_index,
+                               label_smoothing=self.label_smoothing)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, log_probs, label):
+        return F.nll_loss(log_probs, label, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+# ---------------------------------------------------------------------------
+# Transformer family (reference: python/paddle/nn/layer/transformer.py;
+# fused Pallas variants live in paddle_tpu/ops/)
+# ---------------------------------------------------------------------------
+class MultiHeadAttention(Layer):
+    """Reference: nn/layer/transformer.py MultiHeadAttention."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 kdim=None, vdim=None, need_weights: bool = False,
+                 weight_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.dropout = dropout
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr, dtype)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr, dtype)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr, dtype)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr, dtype)
+
+    def _split(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = query if value is None else value
+        q = self._split(self.q_proj(query))
+        k = self._split(self.k_proj(key))
+        v = self._split(self.v_proj(value))
+        if cache is not None:
+            k = jnp.concatenate([cache[0], k], axis=2)
+            v = jnp.concatenate([cache[1], v], axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            training=self.training)
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    """Reference: nn/layer/transformer.py TransformerEncoderLayer; the fused
+    semantic twin is reference operators/fused/fused_attention_op.cc +
+    fused_feedforward_op.cc."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, dtype="float32"):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead,
+            dropout=attn_dropout if attn_dropout is not None else dropout,
+            dtype=dtype)
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype)
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype)
+        self.norm1 = LayerNorm(d_model, dtype=dtype)
+        self.norm2 = LayerNorm(d_model, dtype=dtype)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(
+            act_dropout if act_dropout is not None else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        src = self.self_attn(src, attn_mask=src_mask)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.act_dropout(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        self.layers = LayerList([encoder_layer_fn() for _ in range(num_layers)])
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        for layer in self.layers:
+            src = layer(src, src_mask=src_mask)
+        if self.norm is not None:
+            src = self.norm(src)
+        return src

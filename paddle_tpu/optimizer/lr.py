@@ -1,0 +1,195 @@
+"""LR schedulers (reference: python/paddle/optimizer/lr.py).
+
+Each scheduler is both stateful (``.step()``/``.get_lr()`` — dygraph parity)
+and functional (``sched(step) -> lr`` with a traced step — usable inside a
+jitted train step, which is how the TPU build actually runs).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.step()  # advance to epoch 0, paddle semantics
+
+    def __call__(self, step):
+        """Functional form: lr at `step` (int or traced int array)."""
+        return self._compute(step)
+
+    def _compute(self, step):
+        raise NotImplementedError
+
+    def get_lr(self):
+        return float(self._compute(self.last_epoch))
+
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+
+
+class NoamDecay(LRScheduler):
+    """Reference lr.py NoamDecay (transformer schedule)."""
+
+    def __init__(self, d_model: int, warmup_steps: int, learning_rate: float = 1.0,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        step = jnp.maximum(step, 1).astype(jnp.float32)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int, gamma: float = 0.1,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.step_size, self.gamma = step_size, gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        return self.base_lr * self.gamma ** (jnp.maximum(step, 0) // self.step_size)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones, gamma: float = 0.1,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        ms = jnp.asarray(self.milestones)
+        n = jnp.sum(jnp.maximum(step, 0) >= ms)
+        return self.base_lr * self.gamma ** n
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        return self.base_lr * self.gamma ** jnp.maximum(step, 0)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_lr: float = 0.0001, power: float = 1.0, cycle: bool = False,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.decay_steps, self.end_lr, self.power = decay_steps, end_lr, power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        t = jnp.minimum(step, self.decay_steps) / self.decay_steps
+        return (self.base_lr - self.end_lr) * (1 - t) ** self.power + self.end_lr
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, T_max: int, eta_min: float = 0.0,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.T_max, self.eta_min = T_max, eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        cos = jnp.cos(math.pi * jnp.minimum(step, self.T_max) / self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class LinearWarmup(LRScheduler):
+    """Reference lr.py LinearWarmup — wraps another scheduler or a float."""
+
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
+                 end_lr: float, last_epoch: int = -1, verbose: bool = False):
+        self.inner = learning_rate  # float or LRScheduler
+        self.warmup_steps = warmup_steps
+        self.start_lr, self.end_lr = start_lr, end_lr
+        base = learning_rate if isinstance(learning_rate, float) else learning_rate.base_lr
+        super().__init__(base, last_epoch, verbose)
+
+    def _compute(self, step):
+        step = jnp.maximum(step, 0).astype(jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            step, self.warmup_steps) / max(self.warmup_steps, 1)
+        if isinstance(self.inner, LRScheduler):
+            after = self.inner._compute(step - self.warmup_steps)
+        else:
+            after = jnp.asarray(self.inner, jnp.float32)
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def _compute(self, step):
+        b = jnp.asarray(self.boundaries)
+        idx = jnp.sum(jnp.maximum(step, 0) >= b)
+        return jnp.asarray(self.values)[idx]
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Stateful-only (metric driven — host side by nature)."""
+
+    def __init__(self, learning_rate: float, mode: str = "min", factor: float = 0.1,
+                 patience: int = 10, threshold: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0, verbose: bool = False):
+        self.mode, self.factor, self.patience = mode, factor, patience
+        self.threshold, self.cooldown, self.min_lr = threshold, cooldown, min_lr
+        self._lr = learning_rate
+        self._best = None
+        self._bad = 0
+        self._cool = 0
+        super().__init__(learning_rate, -1, verbose)
+
+    def _compute(self, step):
+        return jnp.asarray(self._lr, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            return
+        m = float(metrics)
+        better = (self._best is None or
+                  (m < self._best - self.threshold if self.mode == "min"
+                   else m > self._best + self.threshold))
+        if better:
+            self._best, self._bad = m, 0
+        elif self._cool > 0:
+            self._cool -= 1
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                self._lr = max(self._lr * self.factor, self.min_lr)
+                self._bad, self._cool = 0, self.cooldown

@@ -1,0 +1,3 @@
+"""paddle_tpu.vision (reference: python/paddle/vision)."""
+from . import datasets, transforms  # noqa: F401
+from . import models  # noqa: F401

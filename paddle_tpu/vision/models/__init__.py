@@ -1,0 +1,8 @@
+"""Model zoo (reference: python/paddle/vision/models/)."""
+from .lenet import LeNet  # noqa: F401
+
+try:  # resnet lands with the conv milestone
+    from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                         resnet152)
+except ImportError:  # pragma: no cover
+    pass

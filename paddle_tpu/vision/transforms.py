@@ -1,0 +1,109 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) — numpy
+host-side implementations for the data pipeline."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "RandomCrop",
+           "RandomHorizontalFlip", "CenterCrop", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1,) + (1,) * (x.ndim - 1)
+        else:
+            shape = (1,) * (x.ndim - 1) + (-1,)
+        return (x - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        if x.dtype == np.uint8:
+            x = x.astype(np.float32) / 255.0
+        if x.ndim == 2:
+            x = x[None]
+        elif x.ndim == 3:
+            x = x.transpose(2, 0, 1)
+        return np.ascontiguousarray(x, np.float32)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, x):
+        return np.asarray(x).transpose(self.order)
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        hwc = x.ndim == 3
+        h, w = (x.shape[0], x.shape[1])
+        th, tw = self.size
+        ys = (np.arange(th) * (h / th)).astype(np.int64)
+        xs = (np.arange(tw) * (w / tw)).astype(np.int64)
+        return x[ys][:, xs] if hwc or x.ndim == 2 else x
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        h, w = x.shape[0], x.shape[1]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        return x[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)]
+            pad += [(0, 0)] * (x.ndim - 2)
+            x = np.pad(x, pad)
+        h, w = x.shape[0], x.shape[1]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return x[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return np.asarray(x)[:, ::-1].copy()
+        return np.asarray(x)

@@ -1,0 +1,25 @@
+"""Test config: force an 8-device CPU mesh (the analog of the reference's
+localhost multi-process distributed tests, SURVEY.md §4) BEFORE jax import."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# numeric-verification tests need exact fp32 matmuls (this XLA CPU build
+# defaults to a bf16-ish fast path)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as pt
+    pt.seed(1234)
+    np.random.seed(1234)
+    yield

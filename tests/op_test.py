@@ -1,0 +1,60 @@
+"""OpTest harness — the analog of the reference's workhorse test base
+(python/paddle/fluid/tests/unittests/op_test.py:289): compare op outputs to a
+numpy reference and analytic gradients to numeric finite differences
+(get_numeric_gradient, op_test.py:120), swept over dtypes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def numeric_grad(fn: Callable, args: Sequence[np.ndarray], wrt: int,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central finite differences of sum(fn(*args)) w.r.t. args[wrt]."""
+    args = [np.asarray(a, np.float64 if np.issubdtype(np.asarray(a).dtype,
+                                                      np.floating) else None)
+            if np.issubdtype(np.asarray(a).dtype, np.floating)
+            else np.asarray(a) for a in args]
+    x = args[wrt].astype(np.float64)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = float(np.sum(np.asarray(
+            fn(*[a if j != wrt else x.reshape(x.shape) for j, a in enumerate(args)]))))
+        flat[i] = orig - eps
+        lo = float(np.sum(np.asarray(
+            fn(*[a if j != wrt else x.reshape(x.shape) for j, a in enumerate(args)]))))
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+def check_output(op_fn: Callable, ref_fn: Callable, args: Sequence,
+                 rtol: float = 1e-5, atol: float = 1e-6):
+    got = np.asarray(op_fn(*args))
+    want = np.asarray(ref_fn(*[np.asarray(a) for a in args]))
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+def check_grad(op_fn: Callable, args: Sequence, wrt=(0,), rtol: float = 5e-3,
+               atol: float = 5e-4, eps: float = 1e-3):
+    """Analytic (jax.grad) vs numeric finite-difference gradients."""
+    def scalar_fn(*xs):
+        return jnp.sum(op_fn(*xs))
+    for i in wrt:
+        analytic = np.asarray(jax.grad(scalar_fn, argnums=i)(
+            *[jnp.asarray(a, jnp.float32) if np.issubdtype(
+                np.asarray(a).dtype, np.floating) else jnp.asarray(a)
+              for a in args]))
+        def np_fn(*xs):
+            return op_fn(*[jnp.asarray(x) for x in xs])
+        numeric = numeric_grad(np_fn, args, i, eps)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch wrt arg {i}")

@@ -1,0 +1,248 @@
+"""Layer system + functional op tests (reference test strategy: SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+from op_test import check_grad, check_output
+
+
+class TestLayerSystem:
+    def test_parameter_registration(self):
+        l = nn.Linear(4, 3)
+        names = [n for n, _ in l.named_parameters()]
+        assert names == ["weight", "bias"]
+        assert l.weight.shape == (4, 3)
+        assert l.bias.shape == (3,)
+
+    def test_nested_state_dict(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+
+    def test_set_state_dict_roundtrip(self):
+        m1, m2 = nn.Linear(4, 3), nn.Linear(4, 3)
+        m2.set_state_dict(m1.state_dict())
+        x = pt.randn((2, 4))
+        np.testing.assert_allclose(np.asarray(m1(x)), np.asarray(m2(x)))
+
+    def test_apply_is_pure(self):
+        m = nn.Linear(4, 3)
+        x = pt.randn((2, 4))
+        eager = m(x)
+        sd = m.state_dict()
+        out = m.apply(sd, x)
+        np.testing.assert_allclose(np.asarray(eager), np.asarray(out))
+        # zero params through apply, eager unchanged afterwards
+        zeros = {k: jnp.zeros_like(v) for k, v in sd.items()}
+        out0 = m.apply(zeros, x)
+        assert float(jnp.abs(out0).sum()) == 0.0
+        np.testing.assert_allclose(np.asarray(m(x)), np.asarray(eager))
+
+    def test_apply_under_jit_and_grad(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        x = pt.randn((16, 4))
+        y = pt.randn((16, 1))
+        sd = m.state_dict()
+
+        @jax.jit
+        def loss_fn(params):
+            return jnp.mean((m.apply(params, x) - y) ** 2)
+
+        g = jax.grad(loss_fn)(sd)
+        assert set(g) == set(sd)
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+    def test_train_eval_mode(self):
+        m = nn.Dropout(0.5)
+        x = jnp.ones((100,))
+        m.eval()
+        np.testing.assert_allclose(np.asarray(m(x)), np.ones(100))
+        m.train()
+        out = np.asarray(m(x))
+        assert (out == 0).any() and (out > 1).any()
+
+    def test_batchnorm_buffers_update(self):
+        bn = nn.BatchNorm2D(3)
+        x = pt.randn((4, 3, 8, 8)) * 2 + 1.0
+        bn.train()
+        _ = bn(x)
+        rm = np.asarray(bn._buffers["_mean"])
+        assert not np.allclose(rm, 0)  # moved toward batch mean
+
+    def test_batchnorm_mutable_apply(self):
+        bn = nn.BatchNorm2D(3)
+        sd = bn.state_dict()
+        x = pt.randn((4, 3, 8, 8)) + 5.0
+
+        @jax.jit
+        def step(variables):
+            out, new_vars = bn.apply(variables, x, mutable=True)
+            return out, new_vars
+
+        _, new_vars = step(sd)
+        assert not np.allclose(np.asarray(new_vars["_mean"]), 0)
+        # stateful buffers untouched by the functional path
+        np.testing.assert_allclose(np.asarray(bn._buffers["_mean"]), 0)
+
+    def test_astype_casts_params(self):
+        m = nn.Linear(4, 3).astype("bfloat16")
+        assert m.weight.dtype == jnp.bfloat16
+
+
+class TestFunctionalOps:
+    def test_linear_matches_numpy(self):
+        x = np.random.randn(5, 4).astype(np.float32)
+        w = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(3).astype(np.float32)
+        check_output(lambda x, w, b: F.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)),
+                     lambda x, w, b: x @ w + b, [x, w, b])
+
+    def test_linear_grad(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        w = np.random.randn(4, 2).astype(np.float32)
+        check_grad(lambda x, w: F.linear(x, w), [x, w], wrt=(0, 1))
+
+    def test_softmax_cross_entropy_matches_numpy(self):
+        logits = np.random.randn(8, 10).astype(np.float32)
+        labels = np.random.randint(0, 10, (8,))
+
+        def ref(logits, labels):
+            m = logits - logits.max(-1, keepdims=True)
+            logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+            return -logp[np.arange(8), labels].mean()
+
+        got = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+        np.testing.assert_allclose(np.asarray(got), ref(logits, labels), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([1, -100, 3, -100])
+        got = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                              ignore_index=-100)
+        keep = F.cross_entropy(jnp.asarray(logits[[0, 2]]),
+                               jnp.asarray(labels[[0, 2]]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(keep), rtol=1e-6)
+
+    def test_layer_norm_grad(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+        w = np.random.rand(6).astype(np.float32) + 0.5
+        b = np.random.randn(6).astype(np.float32)
+        check_grad(lambda x, w, b: F.layer_norm(x, (6,), w, b), [x, w, b],
+                   wrt=(0, 1, 2))
+
+    def test_conv2d_matches_lax_reference(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+        y = F.conv2d(jnp.asarray(x), jnp.asarray(w), stride=1, padding=1)
+        assert y.shape == (2, 4, 8, 8)
+        # against scipy-style direct computation on one output element
+        patch = x[0, :, 0:3, 0:3]
+        np.testing.assert_allclose(float(y[0, 1, 1, 1]),
+                                   float((patch * w[1]).sum()), rtol=1e-4)
+
+    def test_conv2d_grad(self):
+        x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+        w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+        check_grad(lambda x, w: F.conv2d(x, w, padding=1), [x, w], wrt=(0, 1),
+                   eps=1e-2, rtol=1e-2, atol=2e-3)
+
+    def test_pooling(self):
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        y = F.max_pool2d(x, 2)
+        np.testing.assert_allclose(np.asarray(y)[0, 0],
+                                   [[5.0, 7.0], [13.0, 15.0]])
+        y2 = F.avg_pool2d(x, 2)
+        np.testing.assert_allclose(np.asarray(y2)[0, 0],
+                                   [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_batch_norm_eval_matches_formula(self):
+        x = np.random.randn(4, 3, 2, 2).astype(np.float32)
+        rm = np.random.randn(3).astype(np.float32)
+        rv = np.random.rand(3).astype(np.float32) + 0.5
+        y, _, _ = F.batch_norm(jnp.asarray(x), jnp.asarray(rm), jnp.asarray(rv),
+                               training=False)
+        ref = (x - rm[None, :, None, None]) / np.sqrt(rv[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+    def test_embedding_padding_idx(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        ids = np.array([[1, 0, 3]])
+        out = F.embedding(jnp.asarray(ids), jnp.asarray(w), padding_idx=0)
+        np.testing.assert_allclose(np.asarray(out)[0, 1], np.zeros(4))
+        np.testing.assert_allclose(np.asarray(out)[0, 0], w[1])
+
+    def test_attention_matches_reference(self):
+        q = np.random.randn(2, 2, 4, 8).astype(np.float32)
+        k = np.random.randn(2, 2, 4, 8).astype(np.float32)
+        v = np.random.randn(2, 2, 4, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), training=False)
+        # numpy reference
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(8)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_causal_softmax(self):
+        x = np.random.randn(1, 1, 4, 4).astype(np.float32)
+        out = np.asarray(F.softmax_mask_fuse_upper_triangle(jnp.asarray(x)))
+        assert np.allclose(out[0, 0, 0, 1:], 0)
+        np.testing.assert_allclose(out.sum(-1), np.ones((1, 1, 4)), rtol=1e-5)
+
+    def test_activations_grad(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        x = x + 0.25 * np.sign(x)  # keep clear of the relu kink at 0
+        for fn in [F.relu, F.gelu, F.silu, F.sigmoid, F.tanh, F.softplus]:
+            check_grad(fn, [x], eps=1e-2, rtol=1e-2, atol=1e-3)
+
+    def test_dropout_determinism_under_key_scope(self):
+        x = jnp.ones((1000,))
+        with pt.key_scope(jax.random.key(0)):
+            a = F.dropout(x, 0.5)
+        with pt.key_scope(jax.random.key(0)):
+            b = F.dropout(x, 0.5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        mean = float(jnp.mean(a))
+        assert 0.8 < mean < 1.2  # upscale_in_train keeps expectation
+
+
+class TestMultiHeadAttention:
+    def test_shapes_and_cache(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = pt.randn((2, 5, 16))
+        out = mha(x)
+        assert out.shape == (2, 5, 16)
+        # decode with kv cache
+        mha.eval()
+        k0 = jnp.zeros((2, 4, 0, 4))
+        out, (k, v) = mha(x[:, :1], cache=(k0, k0))
+        assert k.shape == (2, 4, 1, 4)
+
+
+class TestTransformerEncoder:
+    def test_forward(self):
+        enc = nn.TransformerEncoder(
+            lambda: nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0), 2)
+        x = pt.randn((2, 6, 16))
+        out = enc(x)
+        assert out.shape == (2, 6, 16)
+
+
+def test_pad_paddle_convention():
+    x = jnp.ones((1, 2, 3, 3))
+    y = F.pad(x, [1, 1, 2, 2])  # W by (1,1), H by (2,2)
+    assert y.shape == (1, 2, 7, 5)
+    y2 = F.pad(jnp.ones((2, 2)), [0, 0, 1, 0, 0, 0, 0, 1][:4])
+    assert y2.shape == (3, 3)
+
+
+def test_conv_initializer_fans():
+    from paddle_tpu.nn.initializer import _fans
+    fan_in, fan_out = _fans((64, 3, 3, 3))  # OIHW
+    assert fan_in == 27 and fan_out == 576

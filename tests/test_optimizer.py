@@ -1,0 +1,267 @@
+"""Optimizer/LR/clip/AMP tests (reference: unittests/test_adam_op.py family)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import amp, nn, optimizer as opt
+
+
+def _quadratic_setup():
+    """Minimize ||Wx - y||^2 over W; convex, any optimizer should descend."""
+    model = nn.Linear(4, 4, bias_attr=False)
+    x = pt.randn((32, 4))
+    y = pt.randn((32, 4))
+
+    def loss_fn(params):
+        return jnp.mean((model.apply(params, x) - y) ** 2)
+
+    return model, loss_fn
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (opt.SGD, dict(learning_rate=0.1)),
+    (opt.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (opt.Adam, dict(learning_rate=0.05)),
+    (opt.AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+    (opt.Lamb, dict(learning_rate=0.05)),
+    (opt.RMSProp, dict(learning_rate=0.01)),
+    (opt.Adagrad, dict(learning_rate=0.1)),
+    (opt.AdamMax, dict(learning_rate=0.05)),
+])
+def test_optimizer_descends(cls, kwargs):
+    model, loss_fn = _quadratic_setup()
+    o = cls(**kwargs)
+    params = model.trainable_variables()
+    state = o.init(params)
+    l0 = float(loss_fn(params))
+    for _ in range(30):
+        grads = jax.grad(loss_fn)(params)
+        params, state = o.apply_gradients(grads, params, state)
+    assert float(loss_fn(params)) < 0.5 * l0
+
+
+def test_adam_matches_reference_formula():
+    """Single-step Adam vs hand-computed update (reference adam_op.cc)."""
+    p = jnp.asarray([1.0, -2.0, 3.0])
+    g = jnp.asarray([0.1, 0.2, -0.3])
+    o = opt.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    state = o.init({"p": p})
+    newp, state = o.apply_gradients({"p": g}, {"p": p}, state)
+    m = 0.1 * np.asarray(g)
+    v = 0.001 * np.asarray(g) ** 2
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    want = np.asarray(p) - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(np.asarray(newp["p"]), want, rtol=1e-6)
+
+
+def test_stateful_step_updates_parameters():
+    model = nn.Linear(3, 2)
+    o = opt.SGD(learning_rate=1.0, parameters=model.parameters())
+    w_before = model.weight.numpy().copy()
+    grads = [jnp.ones_like(p.value) for p in model.parameters()]
+    o.step(grads)
+    np.testing.assert_allclose(model.weight.numpy(), w_before - 1.0, rtol=1e-6)
+
+
+def test_master_weights_bf16():
+    """multi_precision: bf16 params keep an fp32 master copy; tiny updates
+    accumulate instead of being rounded away (reference multi_precision attr)."""
+    p = jnp.asarray([1.0], jnp.bfloat16)
+    o = opt.SGD(learning_rate=1e-4, multi_precision=True)
+    params = {"p": p}
+    state = o.init(params)
+    assert state["master"]["p"].dtype == jnp.float32
+    for _ in range(10):
+        params, state = o.apply_gradients({"p": jnp.ones_like(p)}, params, state)
+    # master tracked 10 * 1e-4 even though single bf16 step would round to no-op
+    np.testing.assert_allclose(float(state["master"]["p"][0]), 1.0 - 1e-3,
+                               rtol=1e-4)
+
+
+def test_grad_clip_by_global_norm():
+    clip = opt.ClipGradByGlobalNorm(1.0)
+    grads = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([0.0])}
+    out = clip(grads)
+    total = float(jnp.sqrt(sum(jnp.sum(v ** 2) for v in out.values())))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_lr_schedules():
+    s = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    assert abs(float(s(5)) - 0.05) < 1e-6
+    assert abs(float(s(20)) - 0.1) < 1e-6
+    c = opt.lr.CosineAnnealingDecay(1.0, T_max=100)
+    assert abs(float(c(0)) - 1.0) < 1e-6
+    assert float(c(100)) < 1e-6
+    n = opt.lr.NoamDecay(d_model=512, warmup_steps=4000)
+    assert float(n(1)) < float(n(4000))
+    # stateful parity
+    st = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    st.step(); st.step()
+    assert abs(st.get_lr() - 0.05) < 1e-9
+
+
+def test_scheduler_inside_optimizer():
+    model, loss_fn = _quadratic_setup()
+    sched = opt.lr.StepDecay(0.1, step_size=5, gamma=0.5)
+    o = opt.SGD(learning_rate=sched)
+    params = model.trainable_variables()
+    state = o.init(params)
+    grads = jax.grad(loss_fn)(params)
+    p1, state = o.apply_gradients(grads, params, state)
+    assert np.isfinite(np.asarray(p1["weight"])).all()
+
+
+class TestAmp:
+    def test_auto_cast_o1_casts_matmul(self):
+        x = jnp.ones((4, 4), jnp.float32)
+        with amp.auto_cast(level="O1"):
+            y = nn.functional.matmul(x, x)
+        assert y.dtype == jnp.bfloat16
+        # black-list op stays fp32
+        with amp.auto_cast(level="O1"):
+            s = nn.functional.softmax(jnp.ones((4,), jnp.bfloat16))
+        assert s.dtype == jnp.float32
+
+    def test_no_cast_outside_context(self):
+        x = jnp.ones((4, 4), jnp.float32)
+        y = nn.functional.matmul(x, x)
+        assert y.dtype == jnp.float32
+
+    def test_grad_scaler_state_machine(self):
+        sc = amp.GradScaler(init_loss_scaling=8.0, incr_every_n_steps=2,
+                            decr_every_n_nan_or_inf=1, incr_ratio=2.0,
+                            decr_ratio=0.5)
+        st = sc.init_state()
+        # two good steps -> scale doubles
+        st = sc.update_state(st, jnp.asarray(False))
+        st = sc.update_state(st, jnp.asarray(False))
+        assert float(st["scale"]) == 16.0
+        # one bad step -> halves
+        st = sc.update_state(st, jnp.asarray(True))
+        assert float(st["scale"]) == 8.0
+
+    def test_grad_scaler_detects_inf(self):
+        sc = amp.GradScaler(init_loss_scaling=4.0)
+        st = sc.init_state()
+        grads = {"w": jnp.asarray([1.0, np.inf])}
+        _, found = sc.unscale_and_check(grads, st)
+        assert bool(found)
+        grads = {"w": jnp.asarray([4.0, 8.0])}
+        unscaled, found = sc.unscale_and_check(grads, st)
+        assert not bool(found)
+        np.testing.assert_allclose(np.asarray(unscaled["w"]), [1.0, 2.0])
+
+    def test_scaled_training_step_bf16(self):
+        model = nn.Linear(4, 4, bias_attr=False)
+        amp.decorate(model, level="O2")
+        assert model.weight.dtype == jnp.bfloat16
+        x = pt.randn((8, 4)).astype(jnp.bfloat16)
+        y = pt.randn((8, 4)).astype(jnp.bfloat16)
+        o = opt.Adam(learning_rate=0.01, multi_precision=True)
+        sc = amp.GradScaler(enable=False)  # bf16: no scaling needed
+        params = model.trainable_variables()
+        state = o.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                with amp.auto_cast(level="O2"):
+                    out = model.apply(p, x)
+                return jnp.mean((out.astype(jnp.float32) -
+                                 y.astype(jnp.float32)) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = o.apply_gradients(grads, params, state)
+            return loss, params, state
+
+        l0, params, state = step(params, state)
+        for _ in range(20):
+            loss, params, state = step(params, state)
+        assert float(loss) < float(l0)
+
+
+def test_pylayer_custom_grad():
+    class Cube(pt.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor
+            return 3 * x ** 2 * g
+
+    x = jnp.asarray(2.0)
+    g = jax.grad(lambda x: Cube.apply(x))(x)
+    np.testing.assert_allclose(float(g), 12.0)
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "model.pdparams")
+    pt.save(model.state_dict(), path)
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict(pt.load(path))
+    x = pt.randn((2, 4))
+    np.testing.assert_allclose(np.asarray(model(x)), np.asarray(model2(x)))
+
+
+def test_save_load_bf16(tmp_path):
+    sd = {"w": jnp.ones((3,), jnp.bfloat16)}
+    path = str(tmp_path / "bf16.pdparams")
+    pt.save(sd, path)
+    back = pt.load(path)
+    assert back["w"].dtype == jnp.bfloat16
+
+
+def test_scheduler_stateful_step_uses_scheduler_epoch():
+    """Stateful path honors the user-driven scheduler (paddle convention),
+    not the optimizer's internal iteration count."""
+    model = nn.Linear(2, 2, bias_attr=False)
+    sched = opt.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+    o = opt.SGD(learning_rate=sched, parameters=model.parameters())
+    g = [jnp.ones_like(p.value) for p in model.parameters()]
+    w0 = model.weight.numpy().copy()
+    o.step(g)  # epoch 0 -> lr 1.0
+    np.testing.assert_allclose(model.weight.numpy(), w0 - 1.0, rtol=1e-6)
+    sched.step()  # user advances an epoch -> lr 0.1
+    w1 = model.weight.numpy().copy()
+    o.step(g)
+    np.testing.assert_allclose(model.weight.numpy(), w1 - 0.1, rtol=1e-5)
+
+
+def test_adamw_decay_param_fun_gets_names():
+    params = {"linear.weight": jnp.ones((2, 2)), "linear.bias": jnp.ones((2,))}
+    seen = []
+    def decay(name):
+        seen.append(name)
+        return "bias" not in name
+    o = opt.AdamW(learning_rate=0.1, weight_decay=0.5,
+                  apply_decay_param_fun=decay)
+    state = o.init(params)
+    g = {k: jnp.zeros_like(v) for k, v in params.items()}
+    newp, _ = o.apply_gradients(g, params, state)
+    assert any("linear.weight" in s for s in seen)
+    # zero grads: only decayed params move
+    assert float(jnp.abs(newp["linear.bias"] - 1.0).max()) < 1e-7
+    assert float(jnp.abs(newp["linear.weight"] - 1.0).max()) > 1e-4
+
+
+def test_grad_scaler_step_pulls_param_grads():
+    model = nn.Linear(2, 2, bias_attr=False)
+    o = opt.SGD(learning_rate=1.0, parameters=model.parameters())
+    sc = amp.GradScaler(init_loss_scaling=4.0)
+    w0 = model.weight.numpy().copy()
+    model.weight._grad = jnp.full((2, 2), 4.0)  # pretend scaled grads
+    sc.step(o)
+    np.testing.assert_allclose(model.weight.numpy(), w0 - 1.0, rtol=1e-6)
+
+
+def test_missing_keys_strict():
+    m = nn.Linear(2, 2)
+    with pytest.raises(KeyError, match="missing"):
+        m.set_state_dict({"weight": jnp.zeros((2, 2))})
