@@ -1,0 +1,119 @@
+"""Benchmark harness: GPT causal-LM pretraining throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- metric: GPT tokens/sec/chip (fwd+bwd+update, bf16 activations, fp32 master
+  weights — the BASELINE.json config #4 single-chip slice).
+- vs_baseline: achieved MFU / 0.45 (the north-star ≥45% MFU target;
+  BASELINE.md records no reference numbers in-tree, so the target ratio is
+  the comparison axis).
+
+Extra diagnostics go to stderr so stdout stays one parseable line.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak matmul TFLOPs per chip by TPU generation (public specs);
+# CPU fallback uses a nominal figure so the script still runs in dev envs.
+_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+
+def _peak_flops_per_sec() -> float:
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    for gen, tf in _PEAK_TFLOPS.items():
+        if gen in kind:
+            return tf * 1e12
+    import os
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if gen in _PEAK_TFLOPS:
+        return _PEAK_TFLOPS[gen] * 1e12
+    return _PEAK_TFLOPS["v5e"] * 1e12
+
+
+def _param_count(params) -> int:
+    return sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
+
+
+def main():
+    on_tpu = jax.devices()[0].platform != "cpu"
+    import paddle_tpu as pt
+    from paddle_tpu.framework import random as fw_random
+    from paddle_tpu.models import GPTForCausalLM, gpt_125m, gpt_tiny
+
+    if on_tpu:
+        cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
+                       attention_dropout=0.0)
+        B, S, steps, warmup = 8, 1024, 10, 3
+    else:  # dev smoke path
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        B, S, steps, warmup = 2, 128, 3, 1
+
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    params = model.state_dict()
+    n_params = _param_count(params)
+
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def train_step(params, opt_state, input_ids, labels, key):
+        def loss_fn(p):
+            with fw_random.key_scope(key):
+                loss, _ = model.apply(p, input_ids, labels=labels)
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = opt.apply_gradients(grads, params, opt_state)
+        return loss, new_params, new_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    key = jax.random.key(0)
+
+    t0 = time.perf_counter()
+    for i in range(warmup):
+        loss, params, opt_state = jitted(params, opt_state, ids, labels,
+                                         jax.random.fold_in(key, i))
+    loss.block_until_ready()
+    print(f"compile+warmup {time.perf_counter()-t0:.1f}s loss={float(loss):.3f}",
+          file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, params, opt_state = jitted(params, opt_state, ids, labels,
+                                         jax.random.fold_in(key, warmup + i))
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * steps / dt
+    # 6ND for fwd+bwd matmul FLOPs + attention term 12*L*h*S^2... use the
+    # standard 6*N approximation plus attention: 6*N + 12*L*H*S per token
+    attn_flops_per_tok = 12 * cfg.num_layers * cfg.hidden_size * S
+    flops_per_tok = 6 * n_params + attn_flops_per_tok
+    mfu = tokens_per_sec * flops_per_tok / _peak_flops_per_sec()
+
+    print(f"params={n_params/1e6:.1f}M step={dt/steps*1e3:.1f}ms "
+          f"tok/s={tokens_per_sec:.0f} mfu={mfu:.3f} loss={float(loss):.3f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "gpt_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

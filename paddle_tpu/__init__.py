@@ -306,8 +306,20 @@ def einsum(eq, *xs):
     return jnp.einsum(eq, *[_arr(x) for x in xs])
 
 
-def norm(x, p=2, axis=None, keepdim=False):
-    return jnp.linalg.norm(_arr(x), ord=p, axis=axis, keepdims=keepdim)
+def norm(x, p="fro", axis=None, keepdim=False):
+    """paddle.norm: with axis=None the input is flattened and the vector
+    p-norm is taken ('fro' ≡ 2-norm of the flattened tensor — the reference
+    docstring's 'NOT REAL MATRIX NORM'); matrix norms only for 2-tuple axis."""
+    x = _arr(x)
+    if axis is None:
+        pv = 2 if p == "fro" else p
+        out = jnp.linalg.norm(x.reshape(-1), ord=pv)
+        if keepdim:
+            out = out.reshape((1,) * x.ndim)
+        return out
+    return jnp.linalg.norm(x, ord=(2 if p == "fro" and not isinstance(axis, (tuple, list)) else p),
+                           axis=(tuple(axis) if isinstance(axis, list) else axis),
+                           keepdims=keepdim)
 
 
 def outer(x, y):
@@ -408,10 +420,13 @@ def take_along_axis(x, indices, axis):
 
 
 def scatter(x, index, updates, overwrite=True):
+    """Reference phi scatter kernel: with overwrite=False the destination rows
+    are zeroed first (ScatterAssignAdd, paddle/phi/kernels/funcs/scatter.h),
+    so result rows are the sum of updates only, not dest + updates."""
     x, index, updates = _arr(x), _arr(index), _arr(updates)
     if overwrite:
         return x.at[index].set(updates)
-    return x.at[index].add(updates)
+    return x.at[index].set(jnp.zeros((), x.dtype)).at[index].add(updates)
 
 
 def index_select(x, index, axis=0):

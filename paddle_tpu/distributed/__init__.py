@@ -1,0 +1,52 @@
+"""paddle_tpu.distributed — the distributed layer (SURVEY.md §1 L8, §2 D1-D16).
+
+What the reference builds with NCCL rings, process groups, and program
+rewrites, this package expresses as ONE SPMD program over a named
+``jax.sharding.Mesh``:
+
+- topology.py   — mesh axes ≙ CommunicateTopology / HybridCommunicateGroup
+- collective.py — lax collectives ≙ operators/collective/* + ProcessGroup
+- parallel.py   — DP ≙ DataParallel + Reducer (batch sharding, XLA allreduce)
+- mp_layers.py  — TP ≙ fleet.meta_parallel.mp_layers (GSPMD annotations)
+- mp_ops.py     — vocab-parallel CE/embedding ≙ c_softmax_with_cross_entropy
+- random.py     — TP RNG ≙ RNGStatesTracker
+- fleet/        — facade ≙ fleet_base.py + DistributedStrategy (+ recompute)
+- pipeline.py   — PP ≙ PipelineLayer + 1F1B (shard_map + ppermute)
+- sharding.py   — ZeRO ≙ sharding stage 1/2/3 (opt-state PartitionSpecs)
+- moe.py        — EP ≙ global_scatter/gather all-to-all dispatch
+- checkpoint.py — sharded save/load ≙ auto_parallel dist_saver/converter
+"""
+from __future__ import annotations
+
+from . import fleet  # noqa: F401
+from .collective import (ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: F401
+                         barrier, broadcast, p2p_push, reduce,
+                         reduce_scatter, scatter, send_recv_permute, split)
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
+                        VocabParallelEmbedding, shard_constraint,
+                        param_sharding, variables_sharding)
+from .mp_ops import (parallel_cross_entropy, parallel_log_softmax,  # noqa: F401
+                     vocab_parallel_embedding)
+from .parallel import (DataParallel, ParallelEnv, get_rank,  # noqa: F401
+                       get_world_size, init_parallel_env, shard_batch,
+                       device_put_sharded_variables)
+from .random import (RNGStatesTracker, get_rng_state_tracker,  # noqa: F401
+                     model_parallel_random_seed)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       get_hybrid_communicate_group, get_mesh,
+                       set_hybrid_communicate_group)
+
+__all__ = [
+    "fleet", "ReduceOp", "all_gather", "all_reduce", "all_to_all", "barrier",
+    "broadcast", "p2p_push", "reduce", "reduce_scatter", "scatter",
+    "send_recv_permute", "split", "ColumnParallelLinear", "RowParallelLinear",
+    "VocabParallelEmbedding", "shard_constraint", "param_sharding",
+    "variables_sharding", "parallel_cross_entropy", "parallel_log_softmax",
+    "vocab_parallel_embedding", "DataParallel", "ParallelEnv", "get_rank",
+    "get_world_size", "init_parallel_env", "shard_batch",
+    "device_put_sharded_variables", "RNGStatesTracker",
+    "get_rng_state_tracker", "model_parallel_random_seed",
+    "CommunicateTopology", "HybridCommunicateGroup",
+    "get_hybrid_communicate_group", "get_mesh",
+    "set_hybrid_communicate_group",
+]

@@ -1,0 +1,179 @@
+"""Collective communication API.
+
+Reference: paddle/fluid/operators/collective/ (143 op files — c_allreduce_*,
+c_allgather, c_reducescatter, alltoall, c_broadcast, partial_send/recv...) and
+the eager ``ProcessGroup`` API (distributed/collective/ProcessGroup.h:53).
+
+TPU-native design: every byte-level transport (NCCL rings, ring_id registry,
+gen_comm_id bootstrap) collapses into XLA collectives over ICI/DCN.  A
+"process group" is a mesh axis name; these functions lower to ``jax.lax``
+collectives and are valid inside ``shard_map``/``pjit``-parallelized code.
+Called outside any mesh axis they are identity (world size 1) — the same
+behavior paddle has when dist is not initialized.
+
+The reference's eager tensor-in-place mutation API is reshaped functional:
+``y = dist.all_reduce(x, group='mp')`` returns the result.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .topology import axis_size
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
+    "all_to_all", "reduce", "scatter", "send_recv_permute", "barrier",
+    "split", "p2p_push",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _in_axis(group: Optional[str]) -> bool:
+    """True when ``group`` names an axis bound in the current trace
+    (inside shard_map over that axis)."""
+    if group is None:
+        return False
+    try:
+        lax.axis_size(group)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else jnp.asarray(x)
+
+
+def all_reduce(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp"):
+    """c_allreduce_{sum,max,min,prod} (reference collective/c_allreduce_op.h).
+    ``group`` is a mesh axis name or tuple of axis names."""
+    x = _arr(x)
+    if not _in_axis(group if isinstance(group, str) else (group or [None])[0]):
+        return x
+    if op == ReduceOp.SUM:
+        return lax.psum(x, group)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, group)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, group)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, group)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(x), group))
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, group: Optional[str] = "dp", axis: int = 0,
+               tiled: bool = True):
+    """c_allgather (reference collective/c_allgather_op.cc): concatenate the
+    per-device shards along ``axis``."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    return lax.all_gather(x, group, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
+                   axis: int = 0):
+    """c_reducescatter (reference collective/c_reducescatter_op.cc)."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    return lax.psum_scatter(x, group, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, src: int = 0, group: Optional[str] = "dp"):
+    """c_broadcast: every device gets src's value.  Implemented as a
+    masked psum (XLA lowers single-source psum patterns to a broadcast)."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    idx = lax.axis_index(group)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, group)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM,
+           group: Optional[str] = "dp"):
+    """c_reduce: full result lands on dst, zeros elsewhere (SPMD shape must
+    be uniform; callers normally follow with work on dst only)."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    total = all_reduce(x, op, group)
+    idx = lax.axis_index(group)
+    return jnp.where(idx == dst, total, jnp.zeros_like(total))
+
+
+def scatter(x, src: int = 0, group: Optional[str] = "dp", axis: int = 0):
+    """Each device keeps its slice of src's tensor."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    n = lax.axis_size(group)
+    x = broadcast(x, src, group)
+    idx = lax.axis_index(group)
+    size = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=axis)
+
+
+def all_to_all(x, group: Optional[str] = "ep", split_axis: int = 0,
+               concat_axis: int = 0):
+    """alltoall (reference collective/alltoall_op.cc; MoE dispatch backbone
+    global_scatter_op.cc)."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    return lax.all_to_all(x, group, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def send_recv_permute(x, perm: Sequence[tuple], group: str = "pp"):
+    """Point-to-point via collective_permute — the ICI-native replacement for
+    the reference's NCCL send/recv pairs (partial_send/recv,
+    pp_utils/p2p_communication.py).  ``perm`` is [(src, dst), ...]."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    return lax.ppermute(x, group, perm=list(perm))
+
+
+def p2p_push(x, offset: int = 1, group: str = "pp"):
+    """Shift along a ring: stage i sends to stage i+offset (mod n) — the 1F1B
+    forward/backward activation hand-off."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    n = lax.axis_size(group)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, group, perm=perm)
+
+
+def split(x, group: str = "mp", axis: int = -1):
+    """c_split: keep this device's slice along ``axis``."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    n = lax.axis_size(group)
+    idx = lax.axis_index(group)
+    ax = axis % x.ndim
+    size = x.shape[ax] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=ax)
+
+
+def barrier(group: Optional[str] = None):
+    """No-op under SPMD: one program, one schedule — XLA's execution model is
+    the barrier (reference collective/barrier_op.cc is an allreduce on a
+    scalar; that trick is unnecessary here)."""
+    return None

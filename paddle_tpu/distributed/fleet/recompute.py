@@ -1,0 +1,56 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference: python/paddle/distributed/fleet/utils/recompute.py —
+``RecomputeFunction(PyLayer)``:199 (saves RNG state, drops activations,
+replays forward in backward) and the public ``recompute(function, *args)``
+API :331.
+
+TPU-native: ``jax.checkpoint`` (rematerialization) is the whole mechanism —
+XLA replays the forward subgraph during the backward pass, and JAX's
+functional PRNG makes the reference's save/restore of RNG state unnecessary
+(the same keys are folded in on replay).  We keep the reference's API shape
+and add checkpoint policies (``preserve_rng_state`` accepted for parity;
+always effectively True).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["recompute", "recompute_wrapper"]
+
+_POLICIES = {
+    None: None,
+    "full": None,  # recompute everything
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              policy: Optional[str] = None, **kwargs):
+    """Run ``function(*args)`` under rematerialization (reference
+    recompute.py:331): activations inside are not stored for backward; they
+    are recomputed, trading FLOPs for HBM — the enabling trick for the 1.3B+
+    configs (BASELINE.json #4).
+
+    ``policy`` selects what XLA may keep: None/'full' recomputes everything;
+    'dots_saveable' keeps matmul outputs (cheaper backward, more memory).
+    """
+    fn = jax.checkpoint(function, policy=_POLICIES.get(policy))
+    return fn(*args, **kwargs)
+
+
+def recompute_wrapper(function: Callable, policy: Optional[str] = None):
+    """Decorator form: a Layer.forward or block fn that always recomputes."""
+    ck = jax.checkpoint(function, policy=_POLICIES.get(policy))
+
+    @functools.wraps(function)
+    def wrapped(*args, **kwargs):
+        return ck(*args, **kwargs)
+
+    return wrapped

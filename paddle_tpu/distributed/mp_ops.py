@@ -1,0 +1,117 @@
+"""Model-parallel functional ops: vocab-parallel loss and embedding.
+
+Reference:
+- `c_softmax_with_cross_entropy` (operators/collective/
+  c_softmax_with_cross_entropy_op.cc; CUDA kernel .cu with three in-kernel
+  allreduces: logit max :123, label-selected logit :165, sum-exp :184) —
+  softmax-CE over vocab-sharded logits without ever materializing the
+  gathered logits.
+- `c_embedding` (collective/c_embedding_op.cc) — lookup on a vocab shard with
+  start_index offset; OOV rows zero, summed across shards.
+
+Both run in two modes:
+- inside ``shard_map`` over the mp axis: the explicit pmax/psum algorithm,
+  token-for-token the reference kernel's communication pattern, riding ICI;
+- outside (GSPMD / serial): numerically-stable global computation with a
+  sharding constraint keeping logits vocab-sharded — XLA derives the same
+  three reductions from the sharded reduce ops.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.errors import enforce
+from .collective import _in_axis
+from .mp_layers import shard_constraint
+
+__all__ = ["parallel_cross_entropy", "vocab_parallel_embedding",
+           "parallel_log_softmax"]
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else jnp.asarray(x)
+
+
+def parallel_cross_entropy(logits, label, mp_axis: str = "mp",
+                           reduction: str = "none",
+                           ignore_index: int = -100):
+    """Softmax cross-entropy over vocab-sharded logits.
+
+    logits: (..., vocab_local) inside shard_map / (..., vocab) otherwise.
+    label: (...,) global vocab indices.
+    """
+    logits = _arr(logits)
+    label = _arr(label)
+    lf = logits.astype(jnp.float32)
+
+    if _in_axis(mp_axis):
+        n = lax.axis_size(mp_axis)
+        idx = lax.axis_index(mp_axis)
+        vocab_local = logits.shape[-1]
+        start = idx * vocab_local
+        # 1) global max (reference .cu:123)
+        gmax = lax.pmax(jnp.max(lf, axis=-1), mp_axis)
+        shifted = lf - gmax[..., None]
+        # 2) global sum-exp (reference .cu:184)
+        sum_exp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), mp_axis)
+        # 3) label-selected logit: only the owning shard contributes
+        #    (reference .cu:165)
+        local_label = label - start
+        in_range = (local_label >= 0) & (local_label < vocab_local)
+        safe = jnp.clip(local_label, 0, vocab_local - 1)
+        picked_local = jnp.take_along_axis(
+            shifted, safe[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        picked = lax.psum(jnp.where(in_range, picked_local, 0.0), mp_axis)
+        loss = jnp.log(sum_exp) - picked
+    else:
+        lf = shard_constraint(lf, *((None,) * (lf.ndim - 1)), mp_axis)
+        gmax = jnp.max(lf, axis=-1)
+        shifted = lf - gmax[..., None]
+        sum_exp = jnp.sum(jnp.exp(shifted), axis=-1)
+        picked = jnp.take_along_axis(
+            shifted, jnp.clip(label, 0, lf.shape[-1] - 1)[..., None]
+            .astype(jnp.int32), axis=-1)[..., 0]
+        loss = jnp.log(sum_exp) - picked
+
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(loss.dtype)), 1.0)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def parallel_log_softmax(logits, mp_axis: str = "mp"):
+    """log_softmax over a vocab-sharded last axis (shard_map mode)."""
+    logits = _arr(logits).astype(jnp.float32)
+    if not _in_axis(mp_axis):
+        return jax.nn.log_softmax(logits, axis=-1)
+    gmax = lax.pmax(jnp.max(logits, axis=-1), mp_axis)
+    shifted = logits - gmax[..., None]
+    sum_exp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), mp_axis)
+    return shifted - jnp.log(sum_exp)[..., None]
+
+
+def vocab_parallel_embedding(ids, table, mp_axis: str = "mp"):
+    """c_embedding semantics: ``table`` is this shard's rows inside
+    shard_map (rows [idx*n_local, (idx+1)*n_local)); OOV ids produce zero
+    rows which psum combines into the full lookup."""
+    ids = _arr(ids)
+    table = _arr(table)
+    if not _in_axis(mp_axis):
+        return jnp.take(table, ids, axis=0)
+    n_local = table.shape[0]
+    idx = lax.axis_index(mp_axis)
+    start = idx * n_local
+    local = ids - start
+    in_range = (local >= 0) & (local < n_local)
+    safe = jnp.clip(local, 0, n_local - 1)
+    rows = jnp.take(table, safe, axis=0)
+    rows = jnp.where(in_range[..., None], rows, jnp.zeros((), rows.dtype))
+    return lax.psum(rows, mp_axis)

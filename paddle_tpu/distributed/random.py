@@ -1,0 +1,132 @@
+"""Tensor-parallel RNG state tracking.
+
+Reference: fleet/meta_parallel/parallel_layers/random.py:32
+``RNGStatesTracker`` + ``get_rng_state_tracker``:82 — Megatron-style seed
+bookkeeping so that (a) dropout on *replicated* activations uses the same
+mask on every mp rank, and (b) dropout on *sharded* activations uses a
+different mask per mp rank (otherwise the "random" mask would be correlated
+across the hidden-dim shards).
+
+TPU-native design: states are threefry keys, not generator snapshots.  A
+named state is a base key; drawing from it folds in a per-state counter and —
+for ``local`` states — the device's mesh-axis index (``lax.axis_index``),
+which is a traced value, so one jitted SPMD program yields per-device
+distinct masks deterministically.  This is the same counter-based scheme the
+reference's fused kernels use (fused_dropout_common.h GetSeedDataAndIncrement)
+lifted to the framework level.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+from jax import lax
+
+from ..framework import random as fw_random
+from ..framework.errors import enforce
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "MODEL_PARALLEL_RNG"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    """Named key streams with scoped activation (reference random.py:32)."""
+
+    def __init__(self):
+        self._states: Dict[str, jax.Array] = {}
+        self._seeds: Dict[str, int] = {}
+        self._counters: Dict[str, int] = {}
+        self._local_axes: Dict[str, Optional[str]] = {}
+        self._tls = threading.local()
+
+    def reset(self):
+        self._states.clear()
+        self._seeds.clear()
+        self._counters.clear()
+        self._local_axes.clear()
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    def add(self, name: str, seed: int, local_axis: Optional[str] = None):
+        """Register a named stream.  ``local_axis``: mesh axis whose index is
+        folded into every draw → per-shard-distinct randomness (the
+        reference's `seed + tp_rank` trick, random.py:42-47)."""
+        enforce(name not in self._states, f"rng state {name!r} already exists")
+        self._states[name] = jax.random.key(seed)
+        self._seeds[name] = seed
+        self._counters[name] = 0
+        self._local_axes[name] = local_axis
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Ops drawing via framework op_key() inside this scope use the named
+        stream (reference rng_state contextmanager, random.py:52)."""
+        enforce(name in self._states, f"unknown rng state {name!r}")
+        prev = getattr(self._tls, "active", None)
+        self._tls.active = name
+        try:
+            yield
+        finally:
+            self._tls.active = prev
+
+    def active_name(self) -> Optional[str]:
+        return getattr(self._tls, "active", None)
+
+    def draw_key(self, name: str, base: Optional[jax.Array] = None) -> jax.Array:
+        """One key from the named stream.
+
+        ``base`` is the (possibly traced) key_scope-derived per-op key: when
+        given, the stream only folds its seed on top, so under jit the
+        per-step entropy stays traced (a concrete key here would be baked
+        into the compiled program as a constant → identical dropout masks
+        every step).  Without a base (eager mode) the stream's own counter
+        provides per-draw variation."""
+        if base is not None:
+            key = jax.random.fold_in(base, self._seeds[name])
+        else:
+            key = jax.random.fold_in(self._states[name], self._counters[name])
+            self._counters[name] += 1
+        axis = self._local_axes[name]
+        if axis is not None:
+            try:
+                key = jax.random.fold_in(key, lax.axis_index(axis))
+            except (NameError, KeyError, ValueError):
+                pass  # outside shard_map: single shard, no offset needed
+        return key
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 100):
+    """Seed the global + model-parallel streams (reference random.py:82
+    model_parallel_random_seed): 'global' is identical across mp ranks,
+    MODEL_PARALLEL_RNG differs per mp rank."""
+    _tracker.reset()
+    fw_random.seed(seed)
+    _tracker.add("global_seed", seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 2718, local_axis="mp")
+
+
+# hook the framework op_key() path: when a tracker scope is active, stochastic
+# ops (F.dropout etc.) draw from the named stream instead of the global one.
+def _tracked_op_key(scope_key=None):
+    name = _tracker.active_name()
+    if name is not None:
+        return _tracker.draw_key(name, base=scope_key)
+    return None
+
+
+fw_random.set_op_key_provider(_tracked_op_key)

@@ -1,0 +1,203 @@
+"""Hybrid-parallel topology: named device meshes.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+``CommunicateTopology`` (:52, cartesian coords over [dp, pp, sharding, mp])
+and ``HybridCommunicateGroup`` (:133, per-axis process groups).
+
+TPU-native design: an axis is a dimension of a ``jax.sharding.Mesh``, not a
+set of NCCL communicators.  A "process group" is just a mesh-axis name that
+collectives reference (``jax.lax.psum(x, 'mp')``) and GSPMD partitions over
+(``PartitionSpec('dp', None)``).  The cartesian-coordinate bookkeeping the
+reference does by hand is what ``Mesh`` *is*; what we keep is the naming
+scheme and the rank/degree query API so fleet-style user code ports 1:1.
+
+Axis order on the physical device list is [dp, pp, sharding, mp] —
+outermost-to-innermost, so mp (highest-bandwidth collectives, per-layer
+all-reduces) lands on adjacent devices (ICI neighbors on a real slice) and dp
+(one all-reduce per step, overlappable) spans the slowest links (DCN between
+slices), matching how the reference lays out nccl rings hierarchically
+(distributed_strategy.proto:292-293 hierarchical allreduce).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..framework.errors import InvalidArgumentError, enforce
+
+__all__ = [
+    "CommunicateTopology", "HybridCommunicateGroup",
+    "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+    "get_mesh", "axis_size", "axis_index",
+]
+
+
+class CommunicateTopology:
+    """Axis-name → degree bookkeeping (reference topology.py:52)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1)):
+        enforce(len(hybrid_group_names) == len(dims),
+                "names and dims must align")
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims)) if self._dims else 1
+        self._coord_array = np.arange(self._world_size).reshape(self._dims)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        """Coordinate dict → linear rank."""
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._coord_array[coord])
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return tuple(int(c) for c in
+                     np.unravel_index(rank, self._dims))
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        ax = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[ax] = index
+        return [int(r) for r in self._coord_array[tuple(sl)].ravel()]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis_name`` (every other
+        coordinate fixed) — the reference's per-axis communicator lists."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._coord_array, ax, -1)
+        return [list(map(int, row)) for row in moved.reshape(-1, self._dims[ax])]
+
+
+# canonical mesh-axis names for the jax Mesh (short forms used in
+# PartitionSpecs throughout the framework)
+_AXIS_SHORT = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+               "model": "mp", "expert": "ep", "sequence": "sp"}
+
+
+class HybridCommunicateGroup:
+    """Builds the jax Mesh and answers per-axis rank/size queries
+    (reference topology.py:133 HybridCommunicateGroup).
+
+    The reference creates one NCCL group per axis per coordinate-slice; here
+    the single Mesh carries all axes and XLA derives every "group" from the
+    PartitionSpec/psum axis names at compile time.
+    """
+
+    def __init__(self, topology: CommunicateTopology,
+                 devices: Optional[Sequence] = None):
+        self._topo = topology
+        if devices is None:
+            devices = jax.devices()
+        n = topology.world_size()
+        enforce(len(devices) >= n,
+                f"need {n} devices for topology, have {len(devices)}")
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(name) for name in names]
+        axis_names = tuple(_AXIS_SHORT.get(name, name) for name in names)
+        dev_array = np.asarray(devices[:n]).reshape(dims)
+        self.mesh = Mesh(dev_array, axis_names)
+        self._axis_names = axis_names
+        # the process this host drives; under single-controller SPMD every
+        # device is visible, so "my rank" is only meaningful per-device —
+        # keep rank 0 semantics for host-side code paths (logging, saving)
+        self.global_rank = 0
+
+    # -- paddle-parity query API ------------------------------------------
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        if self.get_model_parallel_world_size() > 1:
+            return "tensor"
+        if self.get_pipe_parallel_world_size() > 1:
+            return "pipeline"
+        if self.get_sharding_parallel_world_size() > 1:
+            return "sharding"
+        return "data"
+
+    def _dim(self, long_name: str) -> int:
+        try:
+            return self._topo.get_dim(long_name)
+        except ValueError:
+            return 1
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dim("data")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._dim("model")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._dim("pipe")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._dim("sharding")
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self._dim("expert")
+
+    # ranks are per-device under SPMD; expose axis_index helpers for use
+    # inside shard_map'ped code
+    @staticmethod
+    def get_data_parallel_rank():
+        return jax.lax.axis_index("dp")
+
+    @staticmethod
+    def get_model_parallel_rank():
+        return jax.lax.axis_index("mp")
+
+    @staticmethod
+    def get_stage_id():
+        return jax.lax.axis_index("pp")
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return self._axis_names
+
+
+# ---------------------------------------------------------------------------
+# Global registry (the analog of fleet's module-level _HYBRID_PARALLEL_GROUP)
+# ---------------------------------------------------------------------------
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+    return hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def get_mesh() -> Optional[Mesh]:
+    """The active hybrid mesh, or None before fleet.init()."""
+    return _hcg.mesh if _hcg is not None else None
+
+
+def axis_size(name: str) -> int:
+    """Degree of a mesh axis (1 if the axis doesn't exist / no mesh)."""
+    mesh = get_mesh()
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return mesh.shape[name]
+
+
+def axis_index(name: str):
+    """Per-device coordinate on a mesh axis — only valid inside shard_map."""
+    return jax.lax.axis_index(name)

@@ -112,11 +112,37 @@ def key_scope(key):
         _state.key_scope = prev
 
 
+# Optional provider installed by paddle_tpu.distributed.random: when a TP
+# RNGStatesTracker scope is active it derives per-mesh-axis-distinct keys
+# (the reference's RNGStatesTracker, fleet/meta_parallel/parallel_layers/
+# random.py:32).  Receives the (possibly traced) key_scope-derived key so
+# that under jit the per-step variation stays traced — the tracker only
+# *adds* name/axis entropy, it never replaces a traced key with a constant.
+# Returns None when no tracker scope is active.
+_op_key_provider = None
+
+
+def set_op_key_provider(fn):
+    global _op_key_provider
+    _op_key_provider = fn
+
+
 def op_key() -> jax.Array:
-    """Key for one stochastic op: scoped fold_in under jit, else eager stream."""
+    """Key for one stochastic op.
+
+    Precedence: key_scope (traced, per-step) as the base; an active
+    RNGStatesTracker scope folds its named-stream/axis entropy on top; with
+    no key_scope the tracker draws from its own stream; with neither, the
+    global eager stream."""
     scope = getattr(_state, "key_scope", None)
+    scope_k = None
     if scope is not None:
-        k = jax.random.fold_in(scope.key, scope.count)
+        scope_k = jax.random.fold_in(scope.key, scope.count)
         scope.count += 1
-        return k
+    if _op_key_provider is not None:
+        k = _op_key_provider(scope_k)
+        if k is not None:
+            return k
+    if scope_k is not None:
+        return scope_k
     return next_key()

@@ -102,8 +102,8 @@ class Model:
         self.network.set_state_dict(new_params, strict=False)
         metrics = []
         for m in self._metrics:
-            m.update(m.compute(np.asarray(out), np.asarray(data[-1]))
-                     if hasattr(m, "compute") else np.asarray(out))
+            r = m.compute(np.asarray(out), np.asarray(data[-1]))
+            m.update(*(r if isinstance(r, tuple) else (r,)))
             metrics.append(m.accumulate())
         return float(loss), metrics
 
@@ -170,7 +170,8 @@ class Model:
             loss, out = self.eval_batch(inputs, label)
             losses.append(loss)
             for m in self._metrics:
-                m.update(m.compute(np.asarray(out), np.asarray(label)))
+                r = m.compute(np.asarray(out), np.asarray(label))
+                m.update(*(r if isinstance(r, tuple) else (r,)))
         result = {"loss": float(np.mean(losses)) if losses else 0.0}
         for m in self._metrics:
             result[m.name()] = m.accumulate()

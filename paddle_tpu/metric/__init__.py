@@ -22,6 +22,12 @@ class Metric:
     def reset(self):
         raise NotImplementedError
 
+    def compute(self, *args):
+        """Pass-through by default (reference metric/metrics.py:158): the
+        trainer calls ``m.update(*to_tuple(m.compute(out, label)))``;
+        subclasses override compute to preprocess on the accelerator side."""
+        return args
+
     def update(self, *args):
         raise NotImplementedError
 

@@ -1,0 +1,7 @@
+"""Model zoo (reference: python/paddle/vision/models + the GPT/BERT configs
+of BASELINE.json; vision models live in paddle_tpu.vision.models)."""
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,  # noqa: F401
+                  gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b, gpt_6p7b)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny",
+           "gpt_125m", "gpt_350m", "gpt_1p3b", "gpt_6p7b"]

@@ -1,0 +1,280 @@
+"""GPT: decoder-only transformer for causal-LM pretraining — the north-star
+workload (BASELINE.json config #4: GPT-3 1.3B/6.7B hybrid-parallel).
+
+Semantic reference: the fused transformer family the reference builds for
+exactly this model — fused_attention_op.cc:221-357 (pre-LN → QKV GEMM → FMHA
+→ out proj → bias+dropout+residual), fused_feedforward_op.cc, and the
+Megatron TP layers (fleet/meta_parallel/mp_layers.py:30,97,170) this model
+instantiates for the hybrid configs.
+
+TPU-first design:
+- every Linear is Column/RowParallel with GSPMD PartitionSpecs — serial when
+  no mesh, Megatron-TP when fleet.init gives mp>1; no per-rank weight code.
+- attention heads shard over mp (qkv column-split = head split);
+- activations carry (dp, None, mp-on-hidden) constraints at layer borders —
+  the "sequence of sharded GEMMs" layout from the scaling-book recipe;
+- dropout keys are counter-based via framework.random.key_scope, TP-safe via
+  the RNGStatesTracker fold-in (distributed/random.py);
+- optional per-layer recompute (jax.checkpoint) for the 1.3B+ configs;
+- logits tied to the embedding table; loss is the vocab-parallel CE
+  (c_softmax_with_cross_entropy semantics, distributed/mp_ops.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.fleet.recompute import recompute
+from ..distributed.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                     VocabParallelEmbedding, shard_constraint)
+from ..distributed.mp_ops import parallel_cross_entropy
+from ..framework import random as fw_random
+from ..framework.errors import enforce
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, Parameter
+from ..nn.layers import Dropout, LayerNorm
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # padded to a multiple of 128 for the MXU
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None   # default 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    recompute_policy: Optional[str] = None
+    use_pallas_attention: bool = False   # flash-attention kernel (ops/)
+    dtype: str = "float32"               # activation dtype ("bfloat16" on TPU)
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+        enforce(self.hidden_size % self.num_heads == 0,
+                "hidden_size must divide num_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _normal(std):
+    return I.Normal(mean=0.0, std=std)
+
+
+class GPTAttention(Layer):
+    """Causal self-attention, TP over heads (qkv column-split = head split,
+    reference mp_layers.py usage in the fleet GPT; fused semantics ≙
+    fused_attention_op.cc FMHA path)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        std = c.initializer_range
+        # fused qkv: one (h, 3h) GEMM keeps the MXU busy (reference
+        # attn_gemm.h AttnMatMul computes qkv as a single GEMM likewise)
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, gather_output=False,
+            weight_attr=None)
+        self.qkv_proj.weight.set_value(_normal(std)(
+            fw_random.next_key(), (c.hidden_size, 3 * c.hidden_size),
+            self.qkv_proj.weight.dtype))
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, input_is_parallel=True)
+        # GPT-2 style scaled init on residual-out projections
+        self.out_proj.weight.set_value(
+            _normal(std / math.sqrt(2.0 * c.num_layers))(
+                fw_random.next_key(), (c.hidden_size, c.hidden_size),
+                self.out_proj.weight.dtype))
+        self.attn_dropout_p = c.attention_dropout
+        self.resid_dropout = Dropout(c.hidden_dropout)
+
+    def forward(self, x, cache=None):
+        c = self.config
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)                      # (b, s, 3h) mp-sharded
+        qkv = qkv.reshape(b, s, 3, c.num_heads, c.head_dim)
+        qkv = shard_constraint(qkv, "dp", None, None, "mp", None)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = q.transpose(0, 2, 1, 3)                 # (b, heads, s, d)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        if cache is not None:
+            k = jnp.concatenate([cache[0], k], axis=2)
+            v = jnp.concatenate([cache[1], v], axis=2)
+        if c.use_pallas_attention and cache is None:
+            from ..ops import flash_attention
+            out = flash_attention(
+                q, k, v, causal=True, dropout_p=self.attn_dropout_p,
+                training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
+                training=self.training)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
+        out = self.resid_dropout(self.out_proj(out))
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class GPTMLP(Layer):
+    """h → 4h → h, gelu; TP column/row split (reference
+    fused_feedforward_op.cc semantics)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.fc_in = ColumnParallelLinear(
+            c.hidden_size, c.ffn_hidden_size, gather_output=False)
+        self.fc_in.weight.set_value(_normal(c.initializer_range)(
+            fw_random.next_key(), (c.hidden_size, c.ffn_hidden_size),
+            self.fc_in.weight.dtype))
+        self.fc_out = RowParallelLinear(
+            c.ffn_hidden_size, c.hidden_size, input_is_parallel=True)
+        self.fc_out.weight.set_value(
+            _normal(c.initializer_range / math.sqrt(2.0 * c.num_layers))(
+                fw_random.next_key(), (c.ffn_hidden_size, c.hidden_size),
+                self.fc_out.weight.dtype))
+        self.dropout = Dropout(c.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN block (reference fused_attention_op pre_layer_norm=True path +
+    fused_feedforward)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.ln_1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+        self.attn = GPTAttention(c)
+        self.ln_2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+        self.mlp = GPTMLP(c)
+        self._use_recompute = c.use_recompute
+        self._recompute_policy = c.recompute_policy
+
+    def _block(self, x):
+        x = x + self.attn(self.ln_1(x))
+        return x + self.mlp(self.ln_2(x))
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            h, new_cache = self.attn(self.ln_1(x), cache=cache)
+            x = x + h
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
+        if self._use_recompute:
+            return recompute(self._block, x, policy=self._recompute_policy)
+        return self._block(x)
+
+
+class GPTModel(Layer):
+    """Embeddings + decoder stack + final LN."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.wte = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
+        self.wte.weight.set_value(_normal(c.initializer_range)(
+            fw_random.next_key(), (c.vocab_size, c.hidden_size),
+            self.wte.weight.dtype))
+        self.wpe = Parameter(_normal(c.initializer_range)(
+            fw_random.next_key(),
+            (c.max_position_embeddings, c.hidden_size), jnp.float32))
+        self.wpe.pspec = P(None, None)
+        self.drop = Dropout(c.hidden_dropout)
+        from ..nn.layer import LayerList
+        self.h = LayerList([GPTDecoderLayer(c) for _ in range(c.num_layers)])
+        self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_offset: int = 0, caches=None):
+        c = self.config
+        b, s = input_ids.shape
+        pos = jnp.arange(position_offset, position_offset + s)
+        x = self.wte(input_ids) + self.wpe.value[pos]
+        if c.dtype != "float32":
+            x = x.astype(c.dtype)
+        x = self.drop(x)
+        x = shard_constraint(x, "dp", None, None)
+        new_caches = []
+        for i, layer in enumerate(self.h):
+            if caches is not None:
+                x, kv = layer(x, cache=caches[i])
+                new_caches.append(kv)
+            else:
+                x = layer(x)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """LM head tied to the embedding; loss = vocab-parallel softmax CE
+    (c_softmax_with_cross_entropy semantics)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)            # (b, s, h)
+        # tied head: logits = h @ wte.T → vocab-sharded over mp
+        table = self.gpt.wte.weight.value.astype(hidden.dtype)
+        logits = jnp.einsum("bsh,vh->bsv", hidden, table)
+        logits = shard_constraint(logits, "dp", None, "mp")
+        if labels is None:
+            return logits
+        loss = parallel_cross_entropy(
+            logits.astype(jnp.float32), labels, reduction="mean")
+        return loss, logits
+
+    def generate_step(self, input_ids, caches, position_offset: int):
+        """Single decode step with KV caches (reference CacheKV path,
+        fused_attention_op.cc:235)."""
+        hidden, new_caches = self.gpt(
+            input_ids, position_offset=position_offset, caches=caches)
+        table = self.gpt.wte.weight.value.astype(hidden.dtype)
+        logits = jnp.einsum("bsh,vh->bsv", hidden[:, -1:], table)
+        return logits, new_caches
+
+
+# -- standard configs (GPT-3 table; BASELINE.json configs) ------------------
+def gpt_tiny(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                     max_position_embeddings=256, vocab_size=1024, **kw)
+
+
+def gpt_125m(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_350m(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_1p3b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_6p7b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_position_embeddings=2048, **kw)

@@ -407,7 +407,15 @@ def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0):
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                                  is_causal: bool = False, training: bool = True,
                                  scale: Optional[float] = None):
-    """q,k,v: (batch, num_heads, seq, head_dim). attn_mask is additive."""
+    """q,k,v: (batch, num_heads, seq, head_dim). attn_mask is additive.
+
+    is_causal uses *bottom-right* triangle alignment (k = kv_len - q_len):
+    when q_len < kv_len the query block is treated as the suffix of the key
+    sequence, which is the KV-cache decode semantics (reference
+    fused_attention_op.cc:235 CacheKV path).  The reference's non-cache causal
+    mask is top-left aligned, but it only ever runs with q_len == kv_len,
+    where the two conventions coincide.
+    """
     q, k = amp_state.cast_for_op("attention", _arr(q), _arr(k))
     v = _arr(v)
     head_dim = q.shape[-1]

@@ -38,7 +38,7 @@ from . import initializer as I
 class Parameter:
     """A named, trainable tensor. Drops into jnp ops via __jax_array__."""
 
-    __slots__ = ("value", "trainable", "name", "is_bias", "_grad")
+    __slots__ = ("value", "trainable", "name", "is_bias", "_grad", "pspec")
 
     def __init__(self, value, trainable: bool = True, name: str = "",
                  is_bias: bool = False):
@@ -47,6 +47,9 @@ class Parameter:
         self.name = name
         self.is_bias = is_bias
         self._grad = None
+        # GSPMD placement: a jax PartitionSpec over the hybrid-mesh axes
+        # (set by distributed.mp_layers; None → replicated)
+        self.pspec = None
 
     # -- jax interop ------------------------------------------------------
     def __jax_array__(self):
@@ -224,7 +227,13 @@ class Layer:
     def named_parameters(self, prefix: str = ""
                          ) -> Iterator[Tuple[str, Parameter]]:
         for name, p in self._parameters.items():
-            yield (f"{prefix}.{name}" if prefix else name), p
+            full = f"{prefix}.{name}" if prefix else name
+            if not p.name:
+                # lazily assign the dotted path as the parameter's name
+                # (paddle auto-names like "linear_0.w_0"); consumed by
+                # apply_decay_param_fun / exclude_from_weight_decay_fn
+                p.name = full
+            yield full, p
         for name, sub in self._sub_layers.items():
             sp = f"{prefix}.{name}" if prefix else name
             yield from sub.named_parameters(prefix=sp)

@@ -201,11 +201,19 @@ class Optimizer:
             params)
 
     # -- stateful API ------------------------------------------------------
+    def _param_keys(self):
+        """Stable dict keys carrying real parameter names so
+        apply_decay_param_fun / exclude_from_weight_decay_fn see what the
+        user's model calls the parameter, not a list index."""
+        return [p.name if p.name else f"param_{i}"
+                for i, p in enumerate(self._parameters)]
+
     def _ensure_state(self):
         enforce(self._parameters is not None,
                 "stateful step() needs parameters= at construction")
         if self._state is None:
-            values = [p.value for p in self._parameters]
+            values = dict(zip(self._param_keys(),
+                              (p.value for p in self._parameters)))
             self._state = self.init(values)
 
     def step(self, grads=None):
@@ -213,14 +221,15 @@ class Optimizer:
         self._ensure_state()
         if grads is None:
             grads = [p._grad for p in self._parameters]
-        values = [p.value for p in self._parameters]
-        grads = [None if not t.trainable else g
-                 for g, t in zip(grads, self._parameters)]
+        keys = self._param_keys()
+        values = dict(zip(keys, (p.value for p in self._parameters)))
+        grads = dict(zip(keys, (None if not t.trainable else g
+                                for g, t in zip(grads, self._parameters))))
         lr = self.get_lr() if isinstance(self._lr, LRScheduler) else None
         new_values, self._state = self.apply_gradients(
             grads, values, self._state, lr=lr)
-        for p, v in zip(self._parameters, new_values):
-            p.value = v
+        for p, k in zip(self._parameters, keys):
+            p.value = new_values[k]
             p._grad = None
 
     def clear_grad(self):
@@ -310,8 +319,10 @@ class RMSProp(Optimizer):
         self.rho, self.epsilon, self.momentum = rho, epsilon, momentum
 
     def _init_slot(self, p):
-        z = jnp.zeros_like(jnp.asarray(p), jnp.float32)
-        return {"mean_square": z, "momentum": z}
+        # separate arrays per slot: donation-safe (a shared buffer would be
+        # donated twice in a donated train step)
+        return {"mean_square": jnp.zeros_like(jnp.asarray(p), jnp.float32),
+                "momentum": jnp.zeros_like(jnp.asarray(p), jnp.float32)}
 
     def _update(self, g, p, slots, lr, step, wd):
         if wd:
@@ -334,8 +345,8 @@ class Adam(Optimizer):
         self._decoupled = False
 
     def _init_slot(self, p):
-        z = jnp.zeros_like(jnp.asarray(p), jnp.float32)
-        return {"moment1": z, "moment2": z}
+        return {"moment1": jnp.zeros_like(jnp.asarray(p), jnp.float32),
+                "moment2": jnp.zeros_like(jnp.asarray(p), jnp.float32)}
 
     def _update(self, g, p, slots, lr, step, wd):
         if wd and not self._decoupled:
@@ -373,8 +384,8 @@ class AdamMax(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def _init_slot(self, p):
-        z = jnp.zeros_like(jnp.asarray(p), jnp.float32)
-        return {"moment": z, "inf_norm": z}
+        return {"moment": jnp.zeros_like(jnp.asarray(p), jnp.float32),
+                "inf_norm": jnp.zeros_like(jnp.asarray(p), jnp.float32)}
 
     def _update(self, g, p, slots, lr, step, wd):
         if wd:
@@ -393,14 +404,21 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=True):
+        # exclude_from_weight_decay_fn(name) -> True means wd=0 for that param
+        # (reference lamb excludes LayerNorm/bias params; inverted polarity vs
+        # apply_decay_param_fun, which selects params that DO get decay).
+        apply_fn = None
+        if exclude_from_weight_decay_fn is not None:
+            apply_fn = lambda name: not exclude_from_weight_decay_fn(name)
         super().__init__(learning_rate, parameters, lamb_weight_decay,
-                         grad_clip, multi_precision)
+                         grad_clip, multi_precision,
+                         apply_decay_param_fun=apply_fn)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.exclude_fn = exclude_from_weight_decay_fn
 
     def _init_slot(self, p):
-        z = jnp.zeros_like(jnp.asarray(p), jnp.float32)
-        return {"moment1": z, "moment2": z}
+        return {"moment1": jnp.zeros_like(jnp.asarray(p), jnp.float32),
+                "moment2": jnp.zeros_like(jnp.asarray(p), jnp.float32)}
 
     def _update(self, g, p, slots, lr, step, wd):
         t = step.astype(jnp.float32)

@@ -2,7 +2,10 @@
 localhost multi-process distributed tests, SURVEY.md §4) BEFORE jax import."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# explicit override, not setdefault: the driver env may set JAX_PLATFORMS=axon
+# (real TPU) and the multi-device CPU mesh tests must still run on 8 virtual
+# CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -238,8 +238,10 @@ def test_pad_paddle_convention():
     x = jnp.ones((1, 2, 3, 3))
     y = F.pad(x, [1, 1, 2, 2])  # W by (1,1), H by (2,2)
     assert y.shape == (1, 2, 7, 5)
-    y2 = F.pad(jnp.ones((2, 2)), [0, 0, 1, 0, 0, 0, 0, 1][:4])
-    assert y2.shape == (3, 3)
+    # full-length pad list: first dim to last (reference
+    # python/paddle/nn/functional/common.py:1176-1187)
+    y2 = F.pad(jnp.ones((2, 2)), [0, 0, 1, 0])
+    assert y2.shape == (2, 3)
 
 
 def test_conv_initializer_fans():
