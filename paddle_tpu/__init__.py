@@ -23,6 +23,7 @@ from .framework import (CPUPlace, TPUPlace, get_device, load, save, seed,  # noq
 from .framework.dtype import convert_dtype
 from .framework.flags import get_flags, set_flags  # noqa: F401
 from .framework.random import key_scope, next_key  # noqa: F401
+from .nn.initializer import ParamAttr  # noqa: F401
 from .nn.layer import Parameter  # noqa: F401
 
 __version__ = "0.1.0"

@@ -70,7 +70,10 @@ def all_reduce(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp"):
     if op == ReduceOp.AVG:
         return lax.pmean(x, group)
     if op == ReduceOp.PROD:
-        return jnp.exp(lax.psum(jnp.log(x), group))
+        # gather-then-prod: exact for zeros/negatives/ints (an exp-of-
+        # psum-of-logs trick would NaN on non-positive values)
+        gathered = lax.all_gather(x, group, axis=0)
+        return jnp.prod(gathered, axis=0)
     raise ValueError(f"unknown reduce op {op!r}")
 
 
@@ -122,6 +125,10 @@ def scatter(x, src: int = 0, group: Optional[str] = "dp", axis: int = 0):
     if not _in_axis(group):
         return x
     n = lax.axis_size(group)
+    if x.shape[axis] % n:
+        raise ValueError(
+            f"scatter axis {axis} size {x.shape[axis]} not divisible by "
+            f"group size {n}")
     x = broadcast(x, src, group)
     idx = lax.axis_index(group)
     size = x.shape[axis] // n
@@ -168,6 +175,10 @@ def split(x, group: str = "mp", axis: int = -1):
     n = lax.axis_size(group)
     idx = lax.axis_index(group)
     ax = axis % x.ndim
+    if x.shape[ax] % n:
+        raise ValueError(
+            f"split axis {ax} size {x.shape[ax]} not divisible by "
+            f"group size {n}")
     size = x.shape[ax] // n
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=ax)
 

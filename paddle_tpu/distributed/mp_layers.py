@@ -44,17 +44,23 @@ __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "variables_sharding"]
 
 
+def _clean_spec(mesh, spec) -> P:
+    """Drop spec entries naming axes the mesh doesn't have (a TP spec on a
+    pure-DP mesh degrades to replicated on that dim — serial-compatible)."""
+    cleaned = tuple(s if (s is None or all(
+        a in mesh.axis_names for a in ((s,) if isinstance(s, str) else s)))
+        else None for s in spec)
+    return P(*cleaned)
+
+
 def shard_constraint(x, *spec, mesh=None):
     """with_sharding_constraint against the active hybrid mesh; no-op when no
     mesh is registered or the axes aren't in it (serial mode)."""
     mesh = mesh or get_mesh()
     if mesh is None:
         return x
-    cleaned = tuple(s if (s is None or all(
-        a in mesh.axis_names for a in ((s,) if isinstance(s, str) else s)))
-        else None for s in spec)
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(*cleaned)))
+        x, NamedSharding(mesh, _clean_spec(mesh, spec)))
 
 
 def param_sharding(p, mesh=None) -> Optional[NamedSharding]:
@@ -63,10 +69,7 @@ def param_sharding(p, mesh=None) -> Optional[NamedSharding]:
     if mesh is None:
         return None
     spec = getattr(p, "pspec", None) or P()
-    cleaned = tuple(s if (s is None or all(
-        a in mesh.axis_names for a in ((s,) if isinstance(s, str) else s)))
-        else None for s in spec)
-    return NamedSharding(mesh, P(*cleaned))
+    return NamedSharding(mesh, _clean_spec(mesh, spec))
 
 
 def variables_sharding(layer: Layer, mesh=None):
@@ -167,8 +170,8 @@ class VocabParallelEmbedding(Layer):
         self.mp_axis = mp_axis
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
-            default_initializer=(getattr(weight_attr, "initializer", None)
-                                 or I.Normal(std=0.02)))
+            default_initializer=None if getattr(
+                weight_attr, "initializer", None) else I.Normal(std=0.02))
         self.weight.pspec = P(mp_axis, None)
 
     def forward(self, ids):

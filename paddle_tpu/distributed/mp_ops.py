@@ -25,15 +25,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.errors import enforce
-from .collective import _in_axis
+from .collective import _arr, _in_axis
 from .mp_layers import shard_constraint
 
 __all__ = ["parallel_cross_entropy", "vocab_parallel_embedding",
            "parallel_log_softmax"]
-
-
-def _arr(x):
-    return x.__jax_array__() if hasattr(x, "__jax_array__") else jnp.asarray(x)
 
 
 def parallel_cross_entropy(logits, label, mp_axis: str = "mp",

@@ -74,6 +74,9 @@ define_flag("check_nan_inf", False,
 define_flag("benchmark", False, "Synchronize after each step for timing.")
 define_flag("use_pallas_kernels", True,
             "Use hand-written Pallas kernels where available (vs pure XLA).")
+define_flag("pallas_interpret_routing", False,
+            "Also route to Pallas kernels on non-TPU backends (interpret "
+            "mode; slow — for cross-path parity testing only).")
 define_flag("amp_dtype", "bfloat16", "Low-precision dtype for AMP.")
 define_flag("dataloader_use_native", True,
             "Use the C++ prefetch core for DataLoader when built.")

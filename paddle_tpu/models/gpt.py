@@ -37,6 +37,7 @@ from ..framework import random as fw_random
 from ..framework.errors import enforce
 from ..nn import functional as F
 from ..nn import initializer as I
+from ..nn.initializer import ParamAttr
 from ..nn.layer import Layer, Parameter
 from ..nn.layers import Dropout, LayerNorm
 
@@ -62,7 +63,7 @@ class GPTConfig:
         if self.ffn_hidden_size is None:
             self.ffn_hidden_size = 4 * self.hidden_size
         enforce(self.hidden_size % self.num_heads == 0,
-                "hidden_size must divide num_heads")
+                "num_heads must evenly divide hidden_size")
 
     @property
     def head_dim(self) -> int:
@@ -87,17 +88,12 @@ class GPTAttention(Layer):
         # attn_gemm.h AttnMatMul computes qkv as a single GEMM likewise)
         self.qkv_proj = ColumnParallelLinear(
             c.hidden_size, 3 * c.hidden_size, gather_output=False,
-            weight_attr=None)
-        self.qkv_proj.weight.set_value(_normal(std)(
-            fw_random.next_key(), (c.hidden_size, 3 * c.hidden_size),
-            self.qkv_proj.weight.dtype))
-        self.out_proj = RowParallelLinear(
-            c.hidden_size, c.hidden_size, input_is_parallel=True)
+            weight_attr=ParamAttr(initializer=_normal(std)))
         # GPT-2 style scaled init on residual-out projections
-        self.out_proj.weight.set_value(
-            _normal(std / math.sqrt(2.0 * c.num_layers))(
-                fw_random.next_key(), (c.hidden_size, c.hidden_size),
-                self.out_proj.weight.dtype))
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, input_is_parallel=True,
+            weight_attr=ParamAttr(
+                initializer=_normal(std / math.sqrt(2.0 * c.num_layers))))
         self.attn_dropout_p = c.attention_dropout
         self.resid_dropout = Dropout(c.hidden_dropout)
 
@@ -138,16 +134,12 @@ class GPTMLP(Layer):
         super().__init__()
         c = config
         self.fc_in = ColumnParallelLinear(
-            c.hidden_size, c.ffn_hidden_size, gather_output=False)
-        self.fc_in.weight.set_value(_normal(c.initializer_range)(
-            fw_random.next_key(), (c.hidden_size, c.ffn_hidden_size),
-            self.fc_in.weight.dtype))
+            c.hidden_size, c.ffn_hidden_size, gather_output=False,
+            weight_attr=ParamAttr(initializer=_normal(c.initializer_range)))
         self.fc_out = RowParallelLinear(
-            c.ffn_hidden_size, c.hidden_size, input_is_parallel=True)
-        self.fc_out.weight.set_value(
-            _normal(c.initializer_range / math.sqrt(2.0 * c.num_layers))(
-                fw_random.next_key(), (c.ffn_hidden_size, c.hidden_size),
-                self.fc_out.weight.dtype))
+            c.ffn_hidden_size, c.hidden_size, input_is_parallel=True,
+            weight_attr=ParamAttr(initializer=_normal(
+                c.initializer_range / math.sqrt(2.0 * c.num_layers))))
         self.dropout = Dropout(c.hidden_dropout)
 
     def forward(self, x):
@@ -190,10 +182,9 @@ class GPTModel(Layer):
         super().__init__()
         c = config
         self.config = c
-        self.wte = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
-        self.wte.weight.set_value(_normal(c.initializer_range)(
-            fw_random.next_key(), (c.vocab_size, c.hidden_size),
-            self.wte.weight.dtype))
+        self.wte = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size,
+            weight_attr=ParamAttr(initializer=_normal(c.initializer_range)))
         self.wpe = Parameter(_normal(c.initializer_range)(
             fw_random.next_key(),
             (c.max_position_embeddings, c.hidden_size), jnp.float32))

@@ -421,6 +421,26 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0
     head_dim = q.shape[-1]
     if scale is None:
         scale = head_dim ** -0.5
+    # route causal/no-mask attention to the Pallas flash kernel when enabled
+    # (FLAGS_use_pallas_kernels; reference's fused FMHA path)
+    if (is_causal and attn_mask is None
+            and (dropout_p == 0.0 or not training) and q.ndim == 4
+            and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
+            and head_dim % 8 == 0):
+        from ..framework import flags as _flags
+        from ..distributed.topology import get_mesh as _get_mesh
+        # Route to the Pallas flash kernel only on real TPU (interpret mode
+        # on CPU/GPU is for testing, orders of magnitude slower than the
+        # einsum path) and with no hybrid mesh active (a pallas_call is
+        # opaque to the GSPMD partitioner; the sharded flash path goes
+        # through shard_map explicitly).  FLAGS_pallas_interpret_routing
+        # forces routing for cross-path tests on CPU.
+        if (_flags.get_flag("use_pallas_kernels") and _get_mesh() is None
+                and (jax.default_backend() == "tpu"
+                     or _flags.get_flag("pallas_interpret_routing"))):
+            from ..ops.flash_attention import flash_attention as _fa
+            return _fa(q, k, v.astype(q.dtype), causal=True,
+                       scale=scale, dropout_p=0.0)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     scores = scores.astype(jnp.float32)
     if attn_mask is not None:

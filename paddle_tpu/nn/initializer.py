@@ -105,3 +105,18 @@ class KaimingNormal(Initializer):
 constant = Constant
 uniform = Uniform
 normal = Normal
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py
+    ``ParamAttr`` — name/initializer/trainable; regularizer and lr are handled
+    by the optimizer's apply_decay_param_fun / LRScheduler on TPU)."""
+
+    def __init__(self, name=None, initializer=None, trainable=True,
+                 learning_rate=1.0, regularizer=None, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.need_clip = need_clip

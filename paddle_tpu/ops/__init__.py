@@ -1,0 +1,29 @@
+"""paddle_tpu.ops — the fused-kernel family (reference C16:
+paddle/fluid/operators/fused/).
+
+Design stance (SURVEY §7): XLA auto-fuses the elementwise epilogues the
+reference hand-writes in CUDA (bias+dropout+residual+LN —
+fused_dropout_helper.h:110,207; GEMM epilogues — fused_gemm_epilogue_op.cu),
+so those are thin compositions here and the compiler does the fusion.  The
+kernels XLA can NOT derive — online-softmax flash attention — are hand-written
+in Pallas (flash_attention.py).
+
+``FLAGS_use_pallas_kernels`` (framework/flags.py) gates the Pallas paths;
+with the flag off everything lowers through the jnp reference semantics.
+"""
+from ..framework import flags as _flags
+from .flash_attention import flash_attention  # noqa: F401
+from .fused import (fused_bias_dropout_residual_layer_norm,  # noqa: F401
+                    fused_feedforward, rotary_position_embedding)
+
+__all__ = ["flash_attention", "fused_bias_dropout_residual_layer_norm",
+           "fused_feedforward", "rotary_position_embedding",
+           "pallas_enabled"]
+
+
+def pallas_enabled() -> bool:
+    """True when the Pallas kernel family should be used."""
+    try:
+        return bool(_flags.get_flags()["use_pallas_kernels"])
+    except Exception:
+        return True

@@ -1,0 +1,313 @@
+"""Flash attention: the fused FMHA Pallas kernel.
+
+Semantic reference: operators/fused/fused_attention_op.cc:221-357 FMHA path
+(`FMHARef`, fused/fmha_ref.h:58 — QK^T, scale, mask, softmax, PV) and the
+causal-mask fusion `fused_softmax_mask_upper_triangle_op.cu`.  The reference
+materializes the (S, S) probability matrix in HBM; this kernel never does —
+online softmax over KV blocks keeps everything in VMEM (the whole point of a
+TPU-native rewrite: HBM bandwidth is the bottleneck, SURVEY §7 hard-part 2).
+
+Layout: q, k, v are (batch, heads, seq, head_dim), flattened to
+(batch*heads, seq, head_dim) for the kernel; grid = (batch*heads, q blocks);
+each program streams this head's KV blocks with `fori_loop`, carrying the
+running max/denominator (m, l) in fp32 — the standard flash recurrence.
+Backward is recompute-based (no probability tensor saved): a dkdv kernel over
+KV blocks and a dq kernel over Q blocks, both replaying p = exp(qk - lse).
+
+Causal masking is block-skipped: programs never visit KV blocks strictly
+above the diagonal, so the causal fwd does ~half the FLOPs — the fusion
+`fused_softmax_mask_upper_triangle` only saves bandwidth, not compute.
+
+dropout_p > 0 falls back to the XLA path (F.scaled_dot_product_attention):
+attention-prob dropout requires in-kernel RNG which would pin the mask to
+block layout; the training configs that matter (BASELINE #3/#4) run
+attn dropout 0.  On non-TPU backends the kernel runs in interpret mode, so
+the CPU test mesh exercises the same code path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..framework.errors import enforce
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(seq_q: int, seq_k: int):
+    bq = min(128, seq_q)
+    bk = min(128, seq_k)
+    return bq, bk
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                      # (bq, d)
+    num_kv = seq_k // block_k
+    if causal:
+        # visit only blocks intersecting the lower triangle; queries are
+        # bottom-right aligned against the key sequence (decode semantics,
+        # matches F.scaled_dot_product_attention)
+        offset = seq_k - q_ref.shape[1] * pl.num_programs(1)
+        last = (offset + (qi + 1) * block_q + block_k - 1) // block_k
+        num_iter = jnp.minimum(last, num_kv)
+    else:
+        offset = 0
+        num_iter = num_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = offset + qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_iter, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk)
+    grid = (bh, sq // bq)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward (recompute): dkdv over KV blocks, dq over Q blocks
+# ---------------------------------------------------------------------------
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q,
+                 seq_k):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                      # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    num_q = seq_q // block_q
+    if causal:
+        offset = seq_k - seq_q
+        start = jnp.maximum((kj * block_k - offset) // block_q, 0)
+    else:
+        offset = 0
+        start = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = offset + i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                     # (bq, bk)
+        dv_new = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((block_k, k.shape[1]), jnp.float32)
+    dk, dv = lax.fori_loop(start, num_q, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    num_kv = seq_k // block_k
+    if causal:
+        offset = seq_k - q_ref.shape[1] * pl.num_programs(1)
+        last = (offset + (qi + 1) * q.shape[0] + block_k - 1) // block_k
+        num_iter = jnp.minimum(last, num_kv)
+    else:
+        offset = 0
+        num_iter = num_kv
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = offset + qi * q.shape[0] + lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, num_iter, body,
+                       jnp.zeros((q.shape[0], q.shape[1]), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd(scale, causal, res, g):
+    q, k, v, out, lse = res
+    do = g
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dkdv = functools.partial(
+        _dkdv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_q=sq, seq_k=sk)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),   # do
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),         # lse
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),         # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dqk = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_k=sk)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),         # lse
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),         # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_core(q, k, v, scale, causal):
+    out, _ = _flash_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _core_fwd(q, k, v, scale, causal):
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+_flash_attention_core.defvjp(_core_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None, dropout_p: float = 0.0,
+                    training: bool = True):
+    """Fused attention over (batch, heads, seq, head_dim) inputs.
+
+    Matches ``F.scaled_dot_product_attention(..., is_causal=causal)``
+    numerics (bottom-right causal alignment) without materializing the
+    (seq, seq) probabilities."""
+    if dropout_p > 0.0 and training:
+        # prob-dropout needs in-kernel RNG; XLA reference path handles it
+        from ..nn import functional as F
+        return F.scaled_dot_product_attention(
+            q, k, v, is_causal=causal, dropout_p=dropout_p,
+            training=training, scale=scale)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk)
+    enforce(sq % bq == 0 and sk % bk == 0,
+            f"flash_attention needs seq multiples of {bq}/{bk}; pad inputs "
+            f"(got q={sq}, kv={sk})")
+    if scale is None:
+        scale = d ** -0.5
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    out = _flash_attention_core(qf, kf, vf, float(scale), bool(causal))
+    return out.reshape(b, h, sq, d)

@@ -1,0 +1,106 @@
+"""Fused transformer epilogues + rotary embedding.
+
+Reference semantics:
+- fused bias+dropout+residual(+LayerNorm): operators/fused/
+  fused_dropout_helper.h `FusedDropoutHelper`:110 (bias+dropout+residual) and
+  `FusedDropoutLayerNormHelper`:207 (…+LN) — the epilogue of
+  fused_attention_op.cc and fused_feedforward_op.cc.
+- fused_feedforward: operators/fused/fused_feedforward_op.cc —
+  [pre-LN] → GEMM → act(+dropout) → GEMM → bias+dropout+residual[+post-LN].
+- rope: no op in this snapshot (SURVEY §7 spec-vs-snapshot note) —
+  BASELINE.json names it for the Pallas set; standard GPT-NeoX rotary
+  formulation.
+
+TPU-native design: these are *compositions* — XLA's fusion pass emits the
+single fused HBM pass the reference hand-writes in CUDA (cost model: one
+read of x/residual, one write), so a hand kernel would only re-derive what
+the compiler already does.  Kept as named ops for API parity and so the
+fusion boundary is testable (OpTest-style numeric parity in
+tests/test_ops.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as fw_random
+from ..nn import functional as F
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else x
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate: float = 0.0, epsilon: float = 1e-5,
+        training: bool = True, key=None):
+    """out = LayerNorm(residual + dropout(x + bias)) — the reference's
+    FusedDropoutLayerNormHelper (fused_dropout_helper.h:207)."""
+    x = _arr(x)
+    if bias is not None:
+        x = x + _arr(bias).astype(x.dtype)
+    if dropout_rate > 0.0 and training:
+        x = F.dropout(x, dropout_rate, training=True, key=key)
+    y = _arr(residual) + x
+    return F.layer_norm(y, (y.shape[-1],), ln_scale, ln_bias, epsilon)
+
+
+def fused_bias_dropout_residual(x, residual, bias=None,
+                                dropout_rate: float = 0.0,
+                                training: bool = True, key=None):
+    """out = residual + dropout(x + bias) (fused_dropout_helper.h:110)."""
+    x = _arr(x)
+    if bias is not None:
+        x = x + _arr(bias).astype(x.dtype)
+    if dropout_rate > 0.0 and training:
+        x = F.dropout(x, dropout_rate, training=True, key=key)
+    return _arr(residual) + x
+
+
+def fused_feedforward(x, w1, b1, w2, b2, ln_scale=None, ln_bias=None,
+                      activation: str = "gelu", dropout1: float = 0.0,
+                      dropout2: float = 0.0, epsilon: float = 1e-5,
+                      pre_layer_norm: bool = True, training: bool = True):
+    """The fused FFN block (fused_feedforward_op.cc): one jit region —
+    XLA fuses the activation and dropout into the GEMM epilogues."""
+    x = _arr(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, (x.shape[-1],), ln_scale, ln_bias, epsilon)
+    act = {"gelu": F.gelu, "relu": F.relu}[activation]
+    h = act(F.linear(x, w1, b1))
+    if dropout1 > 0.0 and training:
+        h = F.dropout(h, dropout1, training=True)
+    out = F.linear(h, w2, None)
+    out = fused_bias_dropout_residual(out, residual, b2, dropout2, training)
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (out.shape[-1],), ln_scale, ln_bias, epsilon)
+    return out
+
+
+def rotary_position_embedding(q, k, position_ids=None, base: float = 10000.0):
+    """GPT-NeoX-style rotary embedding on (batch, heads, seq, head_dim)
+    q/k; rotates the first/second halves of head_dim."""
+    q, k = _arr(q), _arr(k)
+    b, h, s, d = q.shape
+    if position_ids is None:
+        pos = jnp.arange(s)[None, :]                     # (1, s)
+    else:
+        pos = _arr(position_ids)
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = pos[..., None].astype(jnp.float32) * inv_freq  # (b|1, s, d/2)
+    cos = jnp.cos(angles)[:, None, :, :]                 # (b|1, 1, s, d/2)
+    sin = jnp.sin(angles)[:, None, :, :]
+
+    def rot(x):
+        x1, x2 = x[..., : d // 2], x[..., d // 2:]
+        xf1 = x1.astype(jnp.float32)
+        xf2 = x2.astype(jnp.float32)
+        r1 = xf1 * cos - xf2 * sin
+        r2 = xf2 * cos + xf1 * sin
+        return jnp.concatenate([r1, r2], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)

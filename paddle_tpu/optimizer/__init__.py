@@ -202,11 +202,22 @@ class Optimizer:
 
     # -- stateful API ------------------------------------------------------
     def _param_keys(self):
-        """Stable dict keys carrying real parameter names so
+        """Stable, unique dict keys carrying real parameter names so
         apply_decay_param_fun / exclude_from_weight_decay_fn see what the
-        user's model calls the parameter, not a list index."""
-        return [p.name if p.name else f"param_{i}"
-                for i, p in enumerate(self._parameters)]
+        user's model calls the parameter, not a list index.  Keys are
+        snapshotted at first use: name collisions (two models with the same
+        architecture) get a #i suffix, and late name assignment can't change
+        the pytree structure mid-training."""
+        if getattr(self, "_param_key_list", None) is None:
+            keys, seen = [], set()
+            for i, p in enumerate(self._parameters):
+                k = p.name if p.name else f"param_{i}"
+                if k in seen:
+                    k = f"{k}#{i}"
+                seen.add(k)
+                keys.append(k)
+            self._param_key_list = keys
+        return self._param_key_list
 
     def _ensure_state(self):
         enforce(self._parameters is not None,
