@@ -1,0 +1,172 @@
+"""Pipeline parallelism: GSPMD-vectorized micro-batch schedule.
+
+Reference: fleet/meta_parallel/pp_layers.py ``PipelineLayer``:132 (stage
+partitioning, ``SegmentLayers``:63), ``PipelineParallel`` 1F1B schedule
+(pipeline_parallel.py:80-152) with NCCL p2p (p2p_communication.py:216), and
+the static-graph twin ``PipelineOptimizer`` (fluid/optimizer.py:4314,
+schedule modes F-then-B :5013 and 1F1B :5043).
+
+TPU-native design (SURVEY §7 hard-part 1, option b): there is no NCCL-style
+p2p on ICI, and host-driven per-stage programs would re-create the executor
+zoo this framework deliberately collapses.  Instead the whole pipeline is ONE
+jitted SPMD program:
+
+- layer parameters are stacked on a leading *stage* axis sharded over the
+  ``pp`` mesh axis — each device holds its stage's weights;
+- one "tick" applies ALL stages in parallel via ``jax.vmap`` over the stage
+  axis — on device s that computes stage s on its current micro-batch;
+- the activation buffer rolls by one stage between ticks (``jnp.roll`` on
+  the pp-sharded axis → XLA emits exactly the ``collective_permute`` that
+  p2p_communication.py's send/recv pairs perform);
+- ``lax.scan`` runs M + S - 1 ticks (fill + steady + drain) — the F-then-B
+  schedule; the backward of the scan replays ticks in reverse, giving the
+  B-phases.  Per-stage activation memory is bounded by ``jax.checkpoint``
+  around the stage body (the role 1F1B's early backwards play in the
+  reference; remat is the TPU-native lever for the same peak-memory goal).
+
+The bubble fraction is (S-1)/(M+S-1), identical to the reference's F-then-B.
+"""
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.errors import enforce
+from .mp_layers import _clean_spec
+from .topology import get_mesh
+
+__all__ = ["gpipe_spmd", "stack_stage_params", "unstack_stage_params",
+           "split_microbatches", "merge_microbatches", "pipeline_stage_specs"]
+
+
+def split_microbatches(batch, num_microbatches: int):
+    """(B, ...) → (M, B/M, ...) for every leaf."""
+    def _split(x):
+        b = x.shape[0]
+        enforce(b % num_microbatches == 0,
+                f"batch {b} not divisible by {num_microbatches} microbatches")
+        return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    return jax.tree_util.tree_map(_split, batch)
+
+
+def merge_microbatches(mb):
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]), mb)
+
+
+def stack_stage_params(params: Dict[str, Any], layer_re: str,
+                       num_stages: int) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Group per-layer parameters into stage-stacked arrays.
+
+    ``layer_re`` must capture the layer index, e.g. r"gpt\\.h\\.(\\d+)\\.(.*)".
+    Returns (stacked, rest): stacked maps each per-layer suffix to an array
+    of shape (num_stages, layers_per_stage, ...); rest holds all non-layer
+    params (embeddings, final LN, head).  ≙ the reference's SegmentLayers
+    uniform cut (pp_layers.py:63).
+    """
+    pat = re.compile(layer_re)
+    by_layer: Dict[int, Dict[str, Any]] = {}
+    rest: Dict[str, Any] = {}
+    for name, v in params.items():
+        m = pat.match(name)
+        if m:
+            idx = int(m.group(1))
+            by_layer.setdefault(idx, {})[m.group(2)] = v
+        else:
+            rest[name] = v
+    n_layers = len(by_layer)
+    enforce(n_layers > 0, f"no params matched layer pattern {layer_re!r}")
+    enforce(n_layers % num_stages == 0,
+            f"{n_layers} layers not divisible into {num_stages} stages")
+    per = n_layers // num_stages
+    suffixes = by_layer[0].keys()
+    stacked = {}
+    for suf in suffixes:
+        leaves = [by_layer[i][suf] for i in range(n_layers)]
+        arr = jnp.stack(leaves).reshape(num_stages, per, *leaves[0].shape)
+        stacked[suf] = arr
+    return stacked, rest
+
+
+def unstack_stage_params(stacked: Dict[str, Any], name_fmt: str
+                         ) -> Dict[str, Any]:
+    """Inverse of stack_stage_params: (S, L, ...) arrays → flat per-layer
+    dict with names ``name_fmt.format(i=<layer index>, suffix=<suffix>)``."""
+    out = {}
+    for suf, arr in stacked.items():
+        s, l = arr.shape[0], arr.shape[1]
+        flat = arr.reshape(s * l, *arr.shape[2:])
+        for i in range(s * l):
+            out[name_fmt.format(i=i, suffix=suf)] = flat[i]
+    return out
+
+
+def pipeline_stage_specs(stacked: Dict[str, Any], pp_axis: str = "pp",
+                         mesh=None) -> Optional[Dict[str, NamedSharding]]:
+    """NamedShardings putting the stage axis on ``pp`` (leading dim),
+    remaining dims replicated/TP-inherited is left to GSPMD propagation."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return None
+    return {k: NamedSharding(mesh, _clean_spec(mesh, (pp_axis,)))
+            for k in stacked}
+
+
+def gpipe_spmd(stage_fn: Callable, stage_params, microbatches, *,
+               pp_axis: str = "pp", remat: bool = True):
+    """Run the micro-batch pipeline; returns last-stage outputs (M, ...).
+
+    stage_fn(stage_param_slice, x) -> y — applies ONE stage (its chunk of
+    layers) to one micro-batch activation; input/output shapes must match
+    (uniform trunk), the transformer-decoder property.
+
+    stage_params: pytree with a leading stage axis S on every leaf (from
+    stack_stage_params), ideally placed P('pp', ...).
+    microbatches: (M, mb, ...) activations entering stage 0.
+    """
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    enforce(len(leaves) > 0, "empty stage params")
+    num_stages = leaves[0].shape[0]
+    m = microbatches.shape[0]
+    enforce(m >= 1, "need at least one microbatch")
+    mesh = get_mesh()
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+    vstage = jax.vmap(body, in_axes=(0, 0))
+
+    def constrain(buf):
+        if mesh is not None and pp_axis in mesh.axis_names:
+            spec = (pp_axis,) + (None,) * (buf.ndim - 1)
+            return jax.lax.with_sharding_constraint(
+                buf, NamedSharding(mesh, P(*spec)))
+        return buf
+
+    buf0 = jnp.zeros((num_stages,) + microbatches.shape[1:],
+                     microbatches.dtype)
+
+    def tick(buf, t):
+        # stage s receives what stage s-1 produced last tick (ppermute);
+        # stage 0 receives micro-batch t (zeros after the last one — those
+        # ticks only drain the tail stages)
+        shifted = jnp.roll(buf, 1, axis=0)
+        idx = jnp.clip(t, 0, m - 1)
+        inp = lax.dynamic_index_in_dim(microbatches, idx, axis=0,
+                                       keepdims=False)
+        inp = jnp.where(t < m, inp, jnp.zeros_like(inp))
+        shifted = shifted.at[0].set(inp)
+        shifted = constrain(shifted)
+        out = vstage(stage_params, shifted)
+        out = constrain(out)
+        return out, out[num_stages - 1]
+
+    _, taps = lax.scan(tick, buf0, jnp.arange(m + num_stages - 1))
+    # micro-batch j exits the last stage at tick j + S - 1
+    return taps[num_stages - 1:]

@@ -1,0 +1,146 @@
+"""ZeRO-style parameter/gradient/optimizer-state sharding.
+
+Reference: fleet/meta_parallel/sharding/sharding_stage2.py:43 (grad shard +
+bucketed reduce), sharding_stage3.py:50 (param shard with pre/post forward
+hooks), dygraph ZeRO-1 `DygraphShardingOptimizer`
+(dygraph_optimizer/dygraph_sharding_optimizer.py:28), static
+sharding_optimizer.py:45, and the public facade
+`paddle.distributed.sharding.group_sharded_parallel`
+(distributed/sharding/group_sharded.py).
+
+TPU-native design (SURVEY A3; PAPERS.md "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" — the XLA-native form of this exact
+component): sharding is a *placement decision*, not a runtime.  Optimizer
+slots/master weights get a PartitionSpec with the ``sharding`` (or dp) axis
+on their largest evenly-divisible unsharded dim; GSPMD then:
+
+- reduce-scatters gradients into the sharded update (stage-2 semantics),
+- runs the weight update on 1/N of the state per device (stage-1/ZeRO-1),
+- all-gathers fresh params for the next forward when params are sharded too
+  (stage-3 semantics).
+
+The reference's bucketing, hooks, and offload logic have no analog to write:
+the compiler schedules the collectives.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.errors import enforce
+from .mp_layers import _clean_spec, param_sharding
+from .topology import get_mesh
+
+__all__ = ["shard_spec_for_leaf", "shard_optimizer_state",
+           "shard_params_stage3", "group_sharded_parallel"]
+
+
+def shard_spec_for_leaf(leaf, base_spec: Optional[P], axis: str, axis_size: int
+                        ) -> Optional[P]:
+    """Insert ``axis`` on the first dim that is (a) not already sharded in
+    base_spec and (b) evenly divisible by axis_size.  None → leave
+    replicated (small leaf, e.g. a scalar step counter or LN bias)."""
+    if leaf is None or not hasattr(leaf, "shape") or leaf.ndim == 0:
+        return None
+    base = tuple(base_spec) if base_spec is not None else ()
+    base = base + (None,) * (leaf.ndim - len(base))
+    for d in range(leaf.ndim):
+        if base[d] is None and leaf.shape[d] % axis_size == 0 \
+                and leaf.shape[d] >= axis_size:
+            new = list(base)
+            new[d] = axis
+            return P(*new)
+    return P(*base) if any(s is not None for s in base) else None
+
+
+def _apply_specs(tree, spec_fn, mesh):
+    def _place(path, leaf):
+        if leaf is None:
+            return None
+        spec = spec_fn(path, leaf)
+        if spec is None:
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, _clean_spec(mesh, spec)))
+    return jax.tree_util.tree_map_with_path(_place, tree)
+
+
+def shard_optimizer_state(opt_state, params_layer=None, mesh=None,
+                          axis: str = "dp"):
+    """ZeRO-1/2: place every slot/master leaf sharded over ``axis``
+    (composing with the parameter's own TP spec when the param pytree is a
+    state_dict of a Layer built from mp_layers).
+
+    ≙ DygraphShardingOptimizer's param-to-rank assignment — here the
+    "assignment" is a PartitionSpec and XLA emits the reduce-scatter +
+    sharded update.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return opt_state
+    n = mesh.shape[axis]
+
+    # param name -> TP base spec (so slots inherit the mp split too)
+    base_specs: Dict[str, P] = {}
+    if params_layer is not None:
+        for name, p in params_layer.named_parameters():
+            if getattr(p, "pspec", None) is not None:
+                base_specs[name] = p.pspec
+
+    def _spec(path, leaf):
+        # path like ('slots', '<param name>', 'moment1') or
+        # ('master', '<param name>'); step stays replicated
+        keys = [getattr(k, "key", None) for k in path]
+        if keys and keys[0] == "step":
+            return None
+        pname = keys[1] if len(keys) > 1 else None
+        base = base_specs.get(pname)
+        return shard_spec_for_leaf(leaf, base, axis, n)
+
+    return _apply_specs(opt_state, _spec, mesh)
+
+
+def shard_params_stage3(layer, mesh=None, axis: str = "dp"):
+    """Stage-3: parameters themselves sharded over the dp/sharding axis
+    (≙ sharding_stage3.py:50).  GSPMD all-gathers just-in-time per layer in
+    the forward — the reference's pre-forward hook, compiler-derived."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return layer
+    n = mesh.shape[axis]
+    for name, p in layer.named_parameters():
+        spec = shard_spec_for_leaf(p.value, getattr(p, "pspec", None), axis, n)
+        if spec is not None:
+            p.pspec = spec
+            p.value = jax.device_put(
+                p.value, NamedSharding(mesh, _clean_spec(mesh, spec)))
+    return layer
+
+
+def group_sharded_parallel(model, optimizer, level: str = "os",
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = False):
+    """Public facade (≙ paddle.distributed.sharding.group_sharded_parallel):
+    level 'os' = optimizer-state sharding (stage 1/2 — on TPU the grad
+    reduce-scatter comes with it), 'os_g' same (alias), 'p_g_os' adds
+    parameter sharding (stage 3).  Returns (model, optimizer, scaler)."""
+    enforce(level in ("os", "os_g", "p_g_os"), f"unknown level {level!r}")
+    mesh = get_mesh()
+    if mesh is None:
+        return model, optimizer, scaler
+    axis = "sharding" if "sharding" in mesh.axis_names else "dp"
+    if level == "p_g_os":
+        shard_params_stage3(model, mesh, axis)
+
+    # wrap the optimizer's init so freshly-built states come out sharded
+    orig_init = optimizer.init
+
+    def sharded_init(params):
+        state = orig_init(params)
+        return shard_optimizer_state(state, params_layer=model, mesh=mesh,
+                                     axis=axis)
+
+    optimizer.init = sharded_init
+    return model, optimizer, scaler
