@@ -1,19 +1,26 @@
 """Test config: force an 8-device CPU mesh (the analog of the reference's
-localhost multi-process distributed tests, SURVEY.md §4) BEFORE jax import."""
-import os
+localhost multi-process distributed tests, SURVEY.md §4).
 
-# explicit override, not setdefault: the driver env may set JAX_PLATFORMS=axon
-# (real TPU) and the multi-device CPU mesh tests must still run on 8 virtual
-# CPU devices.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+Env vars (JAX_PLATFORMS / XLA_FLAGS) are NOT reliable here: the driver's site
+hook overrides them after the shell exports, so the forcing must happen
+in-process via jax.config BEFORE the first backend touch.  Verified: this
+yields ``cpu / 8 devices`` even when the default platform is a real TPU.
+"""
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:  # backend already initialized by an earlier import
+    from jax.extend import backend as _jex_backend
+    _jex_backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+assert len(jax.devices()) >= 8 and jax.devices()[0].platform == "cpu", (
+    f"tests need an 8-device CPU mesh; have {jax.devices()}")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
-import jax  # noqa: E402
 
 # numeric-verification tests need exact fp32 matmuls (this XLA CPU build
 # defaults to a bf16-ish fast path)
