@@ -1,0 +1,41 @@
+"""Virtual CPU device mesh forcing (test/dryrun infrastructure).
+
+The reference validates distributed code without a cluster by simulating
+multi-node as localhost multi-process (test_dist_base.py:782); the TPU-native
+analog is a multi-device CPU mesh in ONE process.  Env-var forcing
+(JAX_PLATFORMS / XLA_FLAGS) is unreliable when a site hook overrides them
+after the shell exports, so this forces the mesh in-process via jax.config —
+which must happen before the first backend touch, with a backend reset as the
+fallback when something already initialized it.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["force_virtual_cpu_mesh"]
+
+
+def force_virtual_cpu_mesh(n: int) -> None:
+    """Make ``jax.devices()`` an ``n``-device virtual CPU mesh.
+
+    Safe to call at any point; if an adequate CPU mesh already exists it is
+    a no-op, and an initialized non-CPU backend is reset (never silently
+    accepted — its devices would route Pallas kernels off interpret mode).
+    """
+    def _update():
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+
+    try:
+        # must run before the first backend touch — even len(jax.devices())
+        # counts as one, so don't probe first
+        _update()
+    except RuntimeError:
+        devs = jax.devices()
+        if len(devs) >= n and devs[0].platform == "cpu":
+            return  # an adequate CPU mesh already exists
+        from jax.extend import backend as jex_backend
+        jex_backend.clear_backends()
+        _update()
+    assert len(jax.devices()) >= n and jax.devices()[0].platform == "cpu", (
+        f"could not build a {n}-device CPU mesh; have {jax.devices()}")

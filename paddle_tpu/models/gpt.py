@@ -101,12 +101,16 @@ class GPTAttention(Layer):
         c = self.config
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)                      # (b, s, 3h) mp-sharded
-        qkv = qkv.reshape(b, s, 3, c.num_heads, c.head_dim)
-        qkv = shard_constraint(qkv, "dp", None, None, "mp", None)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        q = q.transpose(0, 2, 1, 3)                 # (b, heads, s, d)
-        k = k.transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
+        # head-major column order (head0: q|k|v, head1: q|k|v, ...): the mp
+        # sharding of the fused dim then factors onto `heads`, the outer
+        # reshape factor, so GSPMD propagates it through the reshape instead
+        # of involuntarily rematerializing (a (3, heads, ...) factorization
+        # would need mp | 3)
+        qkv = qkv.reshape(b, s, c.num_heads, 3, c.head_dim)
+        qkv = shard_constraint(qkv, "dp", None, "mp", None, None)
+        q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)   # (b, heads, s, d)
+        k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
         if cache is not None:
             k = jnp.concatenate([cache[0], k], axis=2)
             v = jnp.concatenate([cache[1], v], axis=2)

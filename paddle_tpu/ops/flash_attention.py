@@ -38,15 +38,38 @@ from ..framework.errors import enforce
 
 _NEG_INF = -1e30
 
+# Mosaic requires the last two block dims to be (multiple of 8, multiple of
+# 128) or equal to the array dims, so per-row statistics (lse, delta) can't be
+# 2D (bh, seq) blocks of shape (1, bq).  Like the upstream TPU flash kernel,
+# they travel as (bh, seq, _LANES) with the value broadcast across the 128
+# lanes; kernels slice lanes back down to the KV-block width elementwise.
+_LANES = 128
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _stat_tile(x, width):
+    """Widen a (rows, _LANES) lane-broadcast statistic to (rows, width)."""
+    if width <= _LANES:
+        return x[:, :width]
+    assert width % _LANES == 0, (width, _LANES)
+    return jnp.tile(x, (1, width // _LANES))
+
+
 def _block_sizes(seq_q: int, seq_k: int):
-    bq = min(128, seq_q)
-    bk = min(128, seq_k)
-    return bq, bk
+    # swept on v5e at (8, 12, 2048, 64): 512/512 gives 2.5x over 128/128
+    # (small blocks starve the MXU when the contraction dim is only 64).
+    # Fall back to the largest power-of-two block that divides the sequence
+    # so every multiple of 128 stays supported; the resulting widths are
+    # always either <=128 or a multiple of _LANES, which _stat_tile needs.
+    def pick(seq):
+        for b in (512, 256, 128):
+            if seq % b == 0:
+                return b
+        return seq
+    return pick(seq_q), pick(seq_k)
 
 
 # ---------------------------------------------------------------------------
@@ -55,7 +78,9 @@ def _block_sizes(seq_q: int, seq_k: int):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_q, block_k, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                      # (bq, d)
+    # dots stay in the input dtype (bf16 on the fast path) with fp32
+    # accumulation — casting inputs to fp32 would run the MXU at 1/4 rate
+    q = q_ref[0]                                          # (bq, d)
     num_kv = seq_k // block_k
     if causal:
         # visit only blocks intersecting the lower triangle; queries are
@@ -70,8 +95,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -85,7 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=1)
         acc_new = acc * alpha[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -95,7 +120,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     m, l, acc = lax.fori_loop(0, num_iter, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse = m + jnp.log(l_safe)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, scale, causal):
@@ -116,15 +142,15 @@ def _flash_fwd(q, k, v, scale, causal):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
-    return out, lse
+    return out, lse[:, :, 0]  # keep the compact (bh, sq) form as residual
 
 
 # ---------------------------------------------------------------------------
@@ -134,8 +160,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q,
                  seq_k):
     kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                      # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                          # (bk, d)
+    v = v_ref[0]
     num_q = seq_q // block_q
     if causal:
         offset = seq_k - seq_q
@@ -146,10 +172,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        # lane-broadcast stats: every lane holds the row's value, so widening
+        # to block_k lanes gives an elementwise-ready (bq, bk) tile
+        lse = _stat_tile(lse_ref[0, pl.ds(i * block_q, block_q), :], block_k)
+        delta = _stat_tile(
+            delta_ref[0, pl.ds(i * block_q, block_q), :], block_k)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -158,14 +187,16 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                     # (bq, bk)
-        dv_new = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        dv_new = dv + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_new = dk + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     z = jnp.zeros((block_k, k.shape[1]), jnp.float32)
@@ -177,10 +208,10 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                scale, causal, block_q, block_k, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = _stat_tile(lse_ref[0], block_k)     # lane-broadcast → (bq, bk)
+    delta = _stat_tile(delta_ref[0], block_k)
     num_kv = seq_k // block_k
     if causal:
         offset = seq_k - q_ref.shape[1] * pl.num_programs(1)
@@ -191,8 +222,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         num_iter = num_kv
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -201,12 +232,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (q.shape[0], block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     dq = lax.fori_loop(0, num_iter, body,
                        jnp.zeros((q.shape[0], q.shape[1]), jnp.float32))
@@ -220,6 +252,9 @@ def _flash_bwd(scale, causal, res, g):
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # broadcast per-row stats across lanes for Mosaic-legal block layouts
+    lse_b = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
+    delta_b = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
 
     dkdv = functools.partial(
         _dkdv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
@@ -232,8 +267,8 @@ def _flash_bwd(scale, causal, res, g):
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # v
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),   # do
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),         # lse
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),         # delta
+            pl.BlockSpec((1, sq, _LANES), lambda b, j: (b, 0, 0)),   # lse
+            pl.BlockSpec((1, sq, _LANES), lambda b, j: (b, 0, 0)),   # delta
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
@@ -244,7 +279,7 @@ def _flash_bwd(scale, causal, res, g):
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_b, delta_b)
 
     dqk = functools.partial(
         _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
@@ -257,13 +292,13 @@ def _flash_bwd(scale, causal, res, g):
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),         # lse
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),         # delta
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),   # lse
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),   # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_b, delta_b)
     return dq, dk, dv
 
 

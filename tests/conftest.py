@@ -1,26 +1,14 @@
 """Test config: force an 8-device CPU mesh (the analog of the reference's
-localhost multi-process distributed tests, SURVEY.md §4).
+localhost multi-process distributed tests, SURVEY.md §4) in-process, BEFORE
+any test touches a backend — see paddle_tpu.framework.vmesh for why env vars
+don't work here."""
+from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
 
-Env vars (JAX_PLATFORMS / XLA_FLAGS) are NOT reliable here: the driver's site
-hook overrides them after the shell exports, so the forcing must happen
-in-process via jax.config BEFORE the first backend touch.  Verified: this
-yields ``cpu / 8 devices`` even when the default platform is a real TPU.
-"""
-import jax
-
-try:
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-except RuntimeError:  # backend already initialized by an earlier import
-    from jax.extend import backend as _jex_backend
-    _jex_backend.clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-assert len(jax.devices()) >= 8 and jax.devices()[0].platform == "cpu", (
-    f"tests need an 8-device CPU mesh; have {jax.devices()}")
+force_virtual_cpu_mesh(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+import jax  # noqa: E402
 
 # numeric-verification tests need exact fp32 matmuls (this XLA CPU build
 # defaults to a bf16-ish fast path)

@@ -323,3 +323,38 @@ class TestShardBatch:
         xs = dist.shard_batch(x)
         assert xs.sharding.spec == P("dp", None)
         np.testing.assert_allclose(np.asarray(xs), x)
+
+
+class TestGPTShardingHygiene:
+    def test_no_activation_all_gather_in_train_step(self):
+        """The dp×mp train step must not all-gather activations: the fused
+        qkv reshape is head-major precisely so GSPMD keeps the mp sharding
+        through it (regression for the involuntary-full-rematerialization
+        XLA warning the round-3 dryrun logged)."""
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        pt.seed(5)
+        model = GPTForCausalLM(gpt_tiny(hidden_dropout=0.0,
+                                        attention_dropout=0.0))
+        model.eval()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        fleet.distributed_model(model)
+        params = model.state_dict()
+
+        B, S = 8, 32
+        rng = np.random.RandomState(0)
+        ids = dist.shard_batch(rng.randint(0, 1024, (B, S)).astype(np.int32))
+
+        def step(p, ids):
+            return jax.grad(lambda q: model.apply(q, ids, labels=ids)[0])(p)
+
+        txt = jax.jit(step).lower(params, ids).compile().as_text()
+        cfg = model.config
+        # the remat signature: an all-gather materializing the full fused-qkv
+        # activation, flat or factored
+        bad_shapes = [f"[{B},{S},{3 * cfg.hidden_size}]",
+                      f"[{B},{S},{cfg.num_heads},3,{cfg.head_dim}]"]
+        offending = [l for l in txt.splitlines() if "all-gather" in l
+                     and any(s in l for s in bad_shapes)]
+        assert not offending, offending[:3]
