@@ -97,11 +97,24 @@ def fleet_initialized() -> bool:
 
 
 def distributed_model(model):
-    """Place the model's parameters on the hybrid mesh per their specs
-    (reference fleet_base.py:932 wrap selection :1027-1062 — here a single
-    GSPMD program covers all of ShardingParallel/DataParallel/TensorParallel;
-    PipelineParallel wrapping lives in distributed.pipeline)."""
+    """Wrap/place the model for the hybrid mesh (reference fleet_base.py:932
+    wrap selection :1027-1062).  Sharding/DP/TP collapse into one GSPMD
+    program, so those cases just place parameters per their specs; with
+    pp_degree > 1 and a pipeline-capable model this returns the
+    PipelineParallel-style wrapper (GPTPipeline) whose ``train_batch``
+    runs the 1F1B schedule."""
     enforce(fleet_initialized(), "call fleet.init() first")
+    mesh = get_mesh()
+    pp = int(mesh.shape.get("pp", 1)) if mesh is not None else 1
+    if pp > 1:
+        enforce(hasattr(model, "build_pipeline"),
+                f"pp_degree={pp} but {type(model).__name__} has no "
+                "build_pipeline — a non-pipeline model under a pp mesh "
+                "would silently replicate the whole computation across "
+                "the pp axis (reference raises likewise)")
+        micro = int((_strategy.pipeline_configs or {}).get(
+            "accumulate_steps", pp)) if _strategy else pp
+        return model.build_pipeline(pp, micro)
     return device_put_sharded_variables(model)
 
 

@@ -41,8 +41,9 @@ from ..framework.errors import enforce
 from .mp_layers import _clean_spec
 from .topology import get_mesh
 
-__all__ = ["gpipe_spmd", "stack_stage_params", "unstack_stage_params",
-           "split_microbatches", "merge_microbatches", "pipeline_stage_specs"]
+__all__ = ["gpipe_spmd", "one_f_one_b_spmd", "stack_stage_params",
+           "unstack_stage_params", "split_microbatches", "merge_microbatches",
+           "pipeline_stage_specs", "stacked_stage_specs"]
 
 
 def split_microbatches(batch, num_microbatches: int):
@@ -109,13 +110,10 @@ def unstack_stage_params(stacked: Dict[str, Any], name_fmt: str
 
 def pipeline_stage_specs(stacked: Dict[str, Any], pp_axis: str = "pp",
                          mesh=None) -> Optional[Dict[str, NamedSharding]]:
-    """NamedShardings putting the stage axis on ``pp`` (leading dim),
-    remaining dims replicated/TP-inherited is left to GSPMD propagation."""
-    mesh = mesh or get_mesh()
-    if mesh is None:
-        return None
-    return {k: NamedSharding(mesh, _clean_spec(mesh, (pp_axis,)))
-            for k in stacked}
+    """NamedShardings putting the stage axis on ``pp`` (leading dim) with
+    every other dim replicated — the TP-less special case of
+    :func:`stacked_stage_specs`."""
+    return stacked_stage_specs(stacked, {}, pp_axis=pp_axis, mesh=mesh)
 
 
 def gpipe_spmd(stage_fn: Callable, stage_params, microbatches, *,
@@ -170,3 +168,210 @@ def gpipe_spmd(stage_fn: Callable, stage_params, microbatches, *,
     _, taps = lax.scan(tick, buf0, jnp.arange(m + num_stages - 1))
     # micro-batch j exits the last stage at tick j + S - 1
     return taps[num_stages - 1:]
+
+
+def stacked_stage_specs(stacked: Dict[str, Any],
+                        layer0_pspecs: Dict[str, Any],
+                        pp_axis: str = "pp", mesh=None):
+    """NamedShardings for stage-stacked params composing pp with TP.
+
+    ``layer0_pspecs`` maps each suffix to the per-layer param's PartitionSpec
+    (e.g. a ColumnParallelLinear weight's ``P(None, 'mp')``); the stacked
+    leaf (S, L, ...) gets ``P(pp, None, *per_layer_spec)`` — stage axis on
+    the pp mesh axis, TP axes intact.  ≙ the reference's per-stage parameter
+    placement (pp_layers.py) combined with mp_layers' weight splits."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return None
+    out = {}
+    for suf in stacked:
+        per = tuple(layer0_pspecs.get(suf) or ())
+        out[suf] = NamedSharding(
+            mesh, _clean_spec(mesh, (pp_axis, None) + per))
+    return out
+
+
+def one_f_one_b_spmd(stage_fn: Callable, stage_params, microbatches,
+                     post_fn: Callable, post_params, post_aux, *,
+                     pp_axis: str = "pp", batch_axis: str = "dp",
+                     has_aux: bool = False, aux_weight: float = 1.0):
+    """1F1B pipeline schedule as ONE SPMD program with a hand-scheduled,
+    interleaved backward — the TPU-native rendering of the reference's
+    defining schedule (pipeline_parallel.py:80-152 forward_backward_pipeline:
+    warmup / steady 1F1B / cooldown) and its static twin
+    (fluid/optimizer.py:5043 schedule mode '1F1B').
+
+    Why not ``jax.grad`` over the gpipe scan: that saves every tick's rolled
+    activation buffer — O((M+S)·S) residual memory, exactly the peak the
+    reference adopted 1F1B to avoid.  Here the backward wave runs *inside*
+    the same ``lax.scan``, offset so stage s starts micro-batch j's backward
+    as soon as the cotangent arrives; forward inputs are stashed in a ring
+    of depth 2S (a stage's stash lifetime is ≤ 2(S-1)+1 ticks) and each
+    backward tick recomputes its stage forward via ``jax.vjp`` (activation
+    recompute, ≙ the reference pairing recompute with pp).  Peak activation
+    memory is O(S · 2S · mb) — independent of M, the 1F1B property.
+
+    Like gpipe_spmd, stages are vectorized over the pp mesh axis (vmap +
+    roll ≙ the p2p send/recv pairs of p2p_communication.py:216); the
+    cotangent buffer rolls the opposite direction.
+
+    Args:
+      stage_fn(p_slice, x, mb_idx, stage_idx) -> y: applies one stage to one
+        micro-batch; ``mb_idx``/``stage_idx`` are traced scalars for RNG
+        folding (ignore them for deterministic stages).  x and y must have
+        identical shape/dtype (uniform trunk).
+      stage_params: pytree, every leaf with leading stage axis S.
+      microbatches: (M, mb, ...) activations entering stage 0.
+      post_fn(q, y, aux) -> scalar: per-micro-batch loss contribution on the
+        LAST stage's output (ln_f + head + CE for GPT); must already include
+        the 1/M factor so the returned per-micro-batch losses sum to the
+        batch loss.
+      post_params: pytree q (grads for every leaf are accumulated, zeros for
+        unused leaves — tied embeddings just appear in both post and embed
+        grads and sum outside).
+      post_aux: pytree of (M, ...) leaves indexed by exiting micro-batch
+        (labels).
+      has_aux: when True, stage_fn returns ``(y, aux)`` with ``aux`` a scalar
+        per-stage loss term (MoE load-balance loss); the scheduler sums aux
+        over every (stage, micro-batch) and differentiates it with cotangent
+        ``aux_weight`` alongside the activation cotangents.
+
+    Returns:
+      ``(losses (M,), stage_grads, post_grads, d_microbatches)`` — or with
+      ``has_aux``, ``(losses, aux_total, stage_grads, post_grads,
+      d_microbatches)``.  Total loss = sum(losses) + aux_weight · aux_total;
+      d_microbatches is the cotangent w.r.t. the pipeline inputs, to be fed
+      into the embedding's backward outside.
+    """
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    enforce(len(leaves) > 0, "empty stage params")
+    S = leaves[0].shape[0]
+    M = microbatches.shape[0]
+    enforce(M >= 1, "need at least one microbatch")
+    K = 2 * S                       # stash ring depth ≥ max lifetime 2S-1
+    T = M + 2 * S - 1
+    mesh = get_mesh()
+    stage_ids = jnp.arange(S)
+
+    def constrain(x, *spec):
+        if mesh is None:
+            return x
+        full = spec + (None,) * (x.ndim - len(spec))
+        return lax.with_sharding_constraint(
+            x, NamedSharding(mesh, _clean_spec(mesh, full)))
+
+    if has_aux:
+        stage_fn_a = stage_fn
+    else:
+        def stage_fn_a(p, x, mb_idx, stage_idx):
+            return stage_fn(p, x, mb_idx, stage_idx), jnp.zeros(
+                (), jnp.float32)
+
+    vfwd = jax.vmap(stage_fn_a, in_axes=(0, 0, 0, 0))
+
+    def _stage_vjp(p, x, mb_idx, stage_idx, g, aux_ct):
+        _, pull = jax.vjp(
+            lambda pp_, xx: stage_fn_a(pp_, xx, mb_idx, stage_idx), p, x)
+        return pull((g, aux_ct))    # (dp, dx)
+
+    vbwd = jax.vmap(_stage_vjp, in_axes=(0, 0, 0, 0, 0, 0))
+    vloss = jax.value_and_grad(post_fn, argnums=(0, 1))
+
+    mb_shape = microbatches.shape[1:]
+    zeros_mb = jnp.zeros(mb_shape, microbatches.dtype)
+    fbuf0 = jnp.zeros((S,) + mb_shape, microbatches.dtype)
+    gbuf0 = jnp.zeros((S,) + mb_shape, jnp.float32)
+    stash0 = jnp.zeros((S, K) + mb_shape, microbatches.dtype)
+    acc_stage0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), stage_params)
+    acc_post0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), post_params)
+    losses0 = jnp.zeros((M,), jnp.float32)
+    dinp0 = jnp.zeros_like(microbatches, shape=(M,) + mb_shape,
+                           dtype=jnp.float32)
+
+    def tick(carry, t):
+        (fbuf, gbuf, pending, stash, acc_s, acc_p, losses, dinp,
+         aux_acc) = carry
+
+        # ---- forward wave: roll down one stage, feed micro-batch t ----
+        shifted = jnp.roll(fbuf, 1, axis=0)
+        f_in = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        f_in = jnp.where(t < M, f_in, zeros_mb)
+        shifted = shifted.at[0].set(f_in)
+        shifted = constrain(shifted, pp_axis, batch_axis)
+        f_mb = t - stage_ids                        # (S,)
+        f_valid = (f_mb >= 0) & (f_mb < M)
+
+        # stash this tick's stage inputs (ring slot = mb index mod K)
+        def put(row, x, r, v):
+            cur = lax.dynamic_index_in_dim(row, r, axis=0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                row, jnp.where(v, x, cur), r, axis=0)
+        stash = jax.vmap(put)(stash, shifted, jnp.mod(f_mb, K), f_valid)
+        stash = constrain(stash, pp_axis, None, batch_axis)
+
+        out, aux_s = vfwd(stage_params, shifted, jnp.maximum(f_mb, 0),
+                          stage_ids)
+        out = constrain(out, pp_axis, batch_axis)
+        aux_acc = aux_acc + jnp.sum(jnp.where(f_valid, aux_s, 0.0))
+
+        # ---- loss + cotangent seed at the exit stage ----
+        e = t - (S - 1)
+        e_valid = (e >= 0) & (e < M)
+        e_c = jnp.clip(e, 0, M - 1)
+        aux_e = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, e_c, 0, keepdims=False),
+            post_aux)
+        loss_e, (dq, dy) = vloss(post_params, out[S - 1], aux_e)
+        cur_l = lax.dynamic_index_in_dim(losses, e_c, 0, keepdims=False)
+        losses = lax.dynamic_update_index_in_dim(
+            losses, jnp.where(e_valid, loss_e, cur_l), e_c, 0)
+        acc_p = jax.tree_util.tree_map(
+            lambda a, d: a + jnp.where(e_valid, d.astype(a.dtype), 0), acc_p, dq)
+        new_pending = jnp.where(e_valid, dy.astype(jnp.float32),
+                                jnp.zeros_like(gbuf0[0]))
+
+        # ---- backward wave: roll up one stage, seed at the last stage ----
+        gshift = jnp.roll(gbuf, -1, axis=0)
+        gshift = gshift.at[S - 1].set(pending)
+        gshift = constrain(gshift, pp_axis, batch_axis)
+        b_mb = t - 2 * S + 1 + stage_ids            # (S,)
+        b_valid = (b_mb >= 0) & (b_mb < M)
+        b_c = jnp.clip(b_mb, 0, M - 1)
+
+        def take(row, r):
+            return lax.dynamic_index_in_dim(row, r, axis=0, keepdims=False)
+        x_saved = jax.vmap(take)(stash, jnp.mod(b_c, K))
+        aux_ct = jnp.where(b_valid, jnp.float32(aux_weight), 0.0)
+        dp, dx = vbwd(stage_params, x_saved, b_c, stage_ids,
+                      gshift.astype(microbatches.dtype), aux_ct)
+
+        def acc(a, d):
+            mask = b_valid.reshape((S,) + (1,) * (d.ndim - 1))
+            return a + jnp.where(mask, d.astype(a.dtype), 0)
+        acc_s = jax.tree_util.tree_map(acc, acc_s, dp)
+        bmask = b_valid.reshape((S,) + (1,) * (dx.ndim - 1))
+        gbuf_new = jnp.where(bmask, dx.astype(jnp.float32), 0)
+        gbuf_new = constrain(gbuf_new, pp_axis, batch_axis)
+
+        # stage 0's dx is the cotangent w.r.t. pipeline input b_mb[0]
+        b0 = b_mb[0]
+        b0_valid = (b0 >= 0) & (b0 < M)
+        b0_c = jnp.clip(b0, 0, M - 1)
+        cur_d = lax.dynamic_index_in_dim(dinp, b0_c, 0, keepdims=False)
+        dinp = lax.dynamic_update_index_in_dim(
+            dinp, jnp.where(b0_valid, dx[0].astype(jnp.float32), cur_d),
+            b0_c, 0)
+
+        return (out, gbuf_new, new_pending, stash, acc_s, acc_p, losses,
+                dinp, aux_acc), None
+
+    carry0 = (fbuf0, gbuf0, jnp.zeros_like(gbuf0[0]), stash0, acc_stage0,
+              acc_post0, losses0, dinp0, jnp.zeros((), jnp.float32))
+    carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+    _, _, _, _, acc_stage, acc_post, losses, dinp, aux_total = carry
+    if has_aux:
+        return losses, aux_total, acc_stage, acc_post, dinp
+    return losses, acc_stage, acc_post, dinp

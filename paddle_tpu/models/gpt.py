@@ -58,6 +58,18 @@ class GPTConfig:
     recompute_policy: Optional[str] = None
     use_pallas_attention: bool = False   # flash-attention kernel (ops/)
     dtype: str = "float32"               # activation dtype ("bfloat16" on TPU)
+    # MoE (BASELINE config #5, ERNIE-MoE style): 0 experts = dense FFN.
+    # moe_every=2 alternates dense/MoE like GShard; 1 = every layer (needed
+    # for the homogeneous-trunk pipeline path).
+    moe_num_experts: int = 0
+    moe_gate: str = "gshard"
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
+    moe_every: int = 2
+
+    def is_moe_layer(self, index: int) -> bool:
+        return (self.moe_num_experts > 0
+                and index % self.moe_every == self.moe_every - 1)
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -152,31 +164,56 @@ class GPTMLP(Layer):
 
 class GPTDecoderLayer(Layer):
     """Pre-LN block (reference fused_attention_op pre_layer_norm=True path +
-    fused_feedforward)."""
+    fused_feedforward).  With ``config.is_moe_layer(index)`` the FFN is a
+    capacity-bucketed MoELayer over the ``ep`` mesh axis (ERNIE-MoE)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, index: int = 0):
         super().__init__()
         c = config
         self.ln_1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
         self.attn = GPTAttention(c)
         self.ln_2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
-        self.mlp = GPTMLP(c)
+        self._is_moe = c.is_moe_layer(index)
+        if self._is_moe:
+            from ..distributed.moe import MoELayer
+            self.mlp = MoELayer(
+                c.hidden_size, c.ffn_hidden_size, c.moe_num_experts,
+                gate=c.moe_gate, capacity_factor=c.moe_capacity_factor,
+                dropout_p=c.hidden_dropout,
+                weight_attr=ParamAttr(
+                    initializer=_normal(c.initializer_range)),
+                out_weight_attr=ParamAttr(initializer=_normal(
+                    c.initializer_range / math.sqrt(2.0 * c.num_layers))))
+        else:
+            self.mlp = GPTMLP(c)
         self._use_recompute = c.use_recompute
         self._recompute_policy = c.recompute_policy
 
     def _block(self, x):
-        x = x + self.attn(self.ln_1(x))
-        return x + self.mlp(self.ln_2(x))
+        """Returns (x, aux): MoE aux losses are collected INSIDE so they
+        cross the jax.checkpoint boundary as a real remat output instead of
+        leaking a tracer through the thread-local side channel."""
+        from ..distributed.moe import collect_aux_losses
+        with collect_aux_losses() as aux_items:
+            x = x + self.attn(self.ln_1(x))
+            x = x + self.mlp(self.ln_2(x))
+        aux = sum(aux_items) if aux_items else jnp.zeros((), jnp.float32)
+        return x, aux
 
     def forward(self, x, cache=None):
+        from ..distributed.moe import _record_aux
         if cache is not None:
             h, new_cache = self.attn(self.ln_1(x), cache=cache)
             x = x + h
             x = x + self.mlp(self.ln_2(x))
             return x, new_cache
         if self._use_recompute:
-            return recompute(self._block, x, policy=self._recompute_policy)
-        return self._block(x)
+            x, aux = recompute(self._block, x, policy=self._recompute_policy)
+        else:
+            x, aux = self._block(x)
+        if self._is_moe:
+            _record_aux(aux)
+        return x
 
 
 class GPTModel(Layer):
@@ -195,7 +232,8 @@ class GPTModel(Layer):
         self.wpe.pspec = P(None, None)
         self.drop = Dropout(c.hidden_dropout)
         from ..nn.layer import LayerList
-        self.h = LayerList([GPTDecoderLayer(c) for _ in range(c.num_layers)])
+        self.h = LayerList([GPTDecoderLayer(c, i)
+                            for i in range(c.num_layers)])
         self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
 
     def forward(self, input_ids, position_offset: int = 0, caches=None):
@@ -230,7 +268,9 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(config)
 
     def forward(self, input_ids, labels=None):
-        hidden = self.gpt(input_ids)            # (b, s, h)
+        from ..distributed.moe import collect_aux_losses
+        with collect_aux_losses() as aux_losses:
+            hidden = self.gpt(input_ids)        # (b, s, h)
         # tied head: logits = h @ wte.T → vocab-sharded over mp
         table = self.gpt.wte.weight.value.astype(hidden.dtype)
         logits = jnp.einsum("bsh,vh->bsv", hidden, table)
@@ -239,7 +279,15 @@ class GPTForCausalLM(Layer):
             return logits
         loss = parallel_cross_entropy(
             logits.astype(jnp.float32), labels, reduction="mean")
+        if aux_losses:
+            loss = loss + self.config.moe_aux_weight * sum(aux_losses)
         return loss, logits
+
+    def build_pipeline(self, num_stages: int, num_microbatches: int):
+        """Pipeline-parallel wrapper (used by fleet.distributed_model when
+        pp_degree > 1; ≙ fleet_base.py:1027 selecting PipelineParallel)."""
+        from .gpt_pipeline import GPTPipeline
+        return GPTPipeline(self, num_stages, num_microbatches)
 
     def generate_step(self, input_ids, caches, position_offset: int):
         """Single decode step with KV caches (reference CacheKV path,
@@ -252,24 +300,29 @@ class GPTForCausalLM(Layer):
 
 
 # -- standard configs (GPT-3 table; BASELINE.json configs) ------------------
+# kwargs override the size defaults (e.g. gpt_tiny(num_layers=4))
+def _cfg(defaults: Dict[str, Any], kw: Dict[str, Any]) -> GPTConfig:
+    return GPTConfig(**{**defaults, **kw})
+
+
 def gpt_tiny(**kw) -> GPTConfig:
-    return GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
-                     max_position_embeddings=256, vocab_size=1024, **kw)
+    return _cfg(dict(hidden_size=128, num_layers=2, num_heads=4,
+                     max_position_embeddings=256, vocab_size=1024), kw)
 
 
 def gpt_125m(**kw) -> GPTConfig:
-    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+    return _cfg(dict(hidden_size=768, num_layers=12, num_heads=12), kw)
 
 
 def gpt_350m(**kw) -> GPTConfig:
-    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+    return _cfg(dict(hidden_size=1024, num_layers=24, num_heads=16), kw)
 
 
 def gpt_1p3b(**kw) -> GPTConfig:
-    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
-                     max_position_embeddings=2048, **kw)
+    return _cfg(dict(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048), kw)
 
 
 def gpt_6p7b(**kw) -> GPTConfig:
-    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
-                     max_position_embeddings=2048, **kw)
+    return _cfg(dict(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_position_embeddings=2048), kw)

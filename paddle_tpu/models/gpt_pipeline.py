@@ -1,0 +1,190 @@
+"""GPT × pipeline parallelism: the full mp×pp×dp hybrid composition.
+
+This is the north-star workload's missing piece (BASELINE config #4): the
+reference composes it as ``fleet.distributed_model`` → ``PipelineParallel``
+wrapping ``PipelineLayer`` stage cuts (pp_layers.py:132) driven by the 1F1B
+``train_batch`` loop (pipeline_parallel.py:152), with tied-embedding grad
+sync (``allreduce_shared_weight_gradients``, pipeline_parallel.py:147).
+
+TPU-native rendering:
+- the decoder trunk's per-layer params are stage-stacked (S, L, ...) and
+  placed ``P('pp', None, <TP spec>)`` — pp × mp composed on one mesh;
+- embeddings / final LN / head stay OUTSIDE the pipeline (they are shared,
+  not staged): the tied ``wte`` is used by both the embed front and the loss
+  head, and because the whole schedule is ONE SPMD program its gradient
+  contributions simply add — the reference's shared-weight allreduce has no
+  analog to write;
+- the schedule is ``one_f_one_b_spmd`` (distributed/pipeline.py): forward
+  and backward waves interleaved inside one ``lax.scan``, input stash +
+  per-tick ``jax.vjp`` recompute, peak activation memory independent of the
+  micro-batch count (the 1F1B property);
+- dp shards every micro-batch's batch dim; mp shards heads/ffn inside each
+  stage via the mp_layers specs the model already carries.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..distributed import pipeline as pp_mod
+from ..distributed.mp_layers import _clean_spec, shard_constraint
+from ..distributed.mp_ops import parallel_cross_entropy
+from ..distributed.topology import get_mesh
+from ..framework import random as fw_random
+from ..framework.errors import enforce
+
+LAYER_RE = r"gpt\.h\.(\d+)\.(.*)"
+_NAME_FMT = "gpt.h.{i}.{suffix}"
+
+
+class GPTPipeline:
+    """Pipeline-parallel training wrapper around ``GPTForCausalLM``.
+
+    State layout: ``{"stacked": {suffix: (S, L, ...)}, "rest": {name: ...}}``
+    — convert with :meth:`split_state` / :meth:`merge_state` (the analog of
+    the reference's per-stage param partition, ``SegmentLayers`` uniform cut).
+    """
+
+    def __init__(self, model, num_stages: int, num_microbatches: int):
+        c = model.config
+        enforce(num_stages >= 1, "num_stages must be >= 1")
+        enforce(c.num_layers % num_stages == 0,
+                f"{c.num_layers} layers not divisible by {num_stages} stages")
+        enforce(c.moe_num_experts == 0 or c.moe_every == 1,
+                "pipeline needs a homogeneous trunk: MoE models must use "
+                "moe_every=1 so every layer has the same parameter set")
+        self.model = model
+        self.config = c
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.layers_per_stage = c.num_layers // num_stages
+        self.template = model.gpt.h[0]
+
+    # -- state management --------------------------------------------------
+    def split_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        stacked, rest = pp_mod.stack_stage_params(
+            params, LAYER_RE, self.num_stages)
+        return {"stacked": stacked, "rest": rest}
+
+    def merge_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        flat = pp_mod.unstack_stage_params(state["stacked"], _NAME_FMT)
+        return {**flat, **state["rest"]}
+
+    def state_shardings(self, mesh=None) -> Optional[Dict[str, Any]]:
+        """NamedShardings: stacked params P('pp', None, <TP spec>); rest
+        params keep their own pspecs (wte stays vocab-parallel, replicated
+        over pp — the tied embedding lives outside the stage cut)."""
+        mesh = mesh or get_mesh()
+        if mesh is None:
+            return None
+        layer0 = {name: getattr(p, "pspec", None)
+                  for name, p in self.template.named_parameters()}
+        stacked_specs = pp_mod.stacked_stage_specs(layer0, layer0, mesh=mesh)
+        rest_specs = {}
+        for name, p in self.model.named_parameters():
+            if name.startswith("gpt.h."):
+                continue
+            rest_specs[name] = NamedSharding(
+                mesh, _clean_spec(mesh, tuple(getattr(p, "pspec", None) or ())))
+        return {"stacked": stacked_specs, "rest": rest_specs}
+
+    def place_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        shardings = self.state_shardings()
+        if shardings is None:
+            return state
+        return jax.tree_util.tree_map(
+            jax.device_put, state, shardings,
+            is_leaf=lambda x: not isinstance(x, dict))
+
+    # -- pipeline pieces ---------------------------------------------------
+    def _embed_fn(self, rest, ids_mb, mb_idx, key):
+        c = self.config
+        s = ids_mb.shape[1]
+        with fw_random.key_scope(
+                jax.random.fold_in(jax.random.fold_in(key, 1), mb_idx)):
+            x = self.model.gpt.wte.apply(
+                {"weight": rest["gpt.wte.weight"]}, ids_mb)
+            x = x + rest["gpt.wpe"][:s]
+            if c.dtype != "float32":
+                x = x.astype(c.dtype)
+            x = self.model.gpt.drop(x)
+        return shard_constraint(x, "dp", None, None)
+
+    def _make_stage_fn(self, key):
+        template = self.template
+        L = self.layers_per_stage
+        n_layers = self.config.num_layers
+        M = self.num_microbatches
+        from ..distributed.moe import collect_aux_losses
+
+        def stage_fn(pslice, x, mb_idx, stage_idx):
+            def body(h, inp):
+                pl, li = inp
+                # key unique per (micro-batch, global layer): deterministic
+                # dropout, distinct across layers AND micro-batches —
+                # ≙ the per-op Philox seed/offset attrs of
+                # fused_attention_op.cc:292-311
+                gl = stage_idx * L + li
+                k = jax.random.fold_in(
+                    jax.random.fold_in(key, 2), mb_idx * n_layers + gl)
+                with collect_aux_losses() as aux_items, fw_random.key_scope(k):
+                    h = template.apply(pl, h)
+                aux = (sum(aux_items) if aux_items
+                       else jnp.zeros((), jnp.float32))
+                return h, aux
+            h, auxes = lax.scan(body, x, (pslice, jnp.arange(L)))
+            # per micro-batch MoE aux, scaled 1/M so the scheduler's total
+            # is the mean over micro-batches of the per-layer sum
+            return h, jnp.sum(auxes) / M
+
+        return stage_fn
+
+    def _post_fn(self, rest, y, labels_mb):
+        ln = self.model.gpt.ln_f
+        h = ln.apply({"weight": rest["gpt.ln_f.weight"],
+                      "bias": rest["gpt.ln_f.bias"]}, y)
+        table = rest["gpt.wte.weight"].astype(h.dtype)
+        logits = jnp.einsum("bsh,vh->bsv", h, table)
+        logits = shard_constraint(logits, "dp", None, "mp")
+        loss = parallel_cross_entropy(
+            logits.astype(jnp.float32), labels_mb, reduction="mean")
+        return loss / self.num_microbatches
+
+    # -- training ----------------------------------------------------------
+    def loss_and_grads(self, state, input_ids, labels, key):
+        """Mean causal-LM loss over the batch + grads in state layout."""
+        M = self.num_microbatches
+        ids_mb = pp_mod.split_microbatches(input_ids, M)
+        labels_mb = pp_mod.split_microbatches(labels, M)
+        rest, stacked = state["rest"], state["stacked"]
+
+        def embed_all(rest_):
+            return jax.vmap(
+                lambda i, idx: self._embed_fn(rest_, idx, i, key)
+            )(jnp.arange(M), ids_mb)
+
+        acts, embed_pull = jax.vjp(embed_all, rest)
+        aux_w = float(self.config.moe_aux_weight)
+        losses, aux_total, dstacked, dpost, dinp = pp_mod.one_f_one_b_spmd(
+            self._make_stage_fn(key), stacked, acts,
+            self._post_fn, rest, labels_mb, has_aux=True, aux_weight=aux_w)
+        (drest_embed,) = embed_pull(dinp.astype(acts.dtype))
+        # tied wte: head (post) and embedding contributions sum here — the
+        # whole of pipeline_parallel.py:147's shared-weight grad allreduce
+        grads_rest = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(a.dtype), dpost, drest_embed)
+        loss = jnp.sum(losses) + aux_w * aux_total
+        return loss, {"stacked": dstacked, "rest": grads_rest}
+
+    def train_batch(self, state, opt, opt_state, input_ids, labels, key):
+        """One 1F1B train step (≙ PipelineParallel.train_batch,
+        pipeline_parallel.py:152). Jit-compatible; compose under jax.jit
+        with donated state for the perf path."""
+        loss, grads = self.loss_and_grads(state, input_ids, labels, key)
+        new_state, new_opt_state = opt.apply_gradients(
+            grads, state, opt_state)
+        return loss, new_state, new_opt_state

@@ -397,18 +397,23 @@ class Layer:
                     sub._buffers[bname] = saved_b[name]
 
     def apply(self, variables: Dict[str, Any], *args, mutable: bool = False,
-              **kwargs):
+              method: Optional[str] = None, **kwargs):
         """Pure-function forward: ``out = layer.apply(vars, *args)``.
 
         With ``mutable=True`` returns ``(out, new_variables)`` where
         new_variables contains updated buffer values (BN running stats etc.).
+        ``method`` names an alternative entry point (e.g. a layer's
+        ``forward_with_aux``) to call instead of ``forward``.
         Safe under jax.jit / grad / shard_map.
         """
         prev_sink = _mutation_sink()
         _scope.sink = {} if mutable else None
         try:
             with self.bind(variables):
-                out = self(*args, **kwargs)
+                if method is None:
+                    out = self(*args, **kwargs)
+                else:
+                    out = getattr(self, method)(*args, **kwargs)
                 if not mutable:
                     return out
                 # map (layer id, buffer name) -> full path
