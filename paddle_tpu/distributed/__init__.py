@@ -25,6 +25,11 @@ from .collective import (ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: 
 from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         VocabParallelEmbedding, shard_constraint,
                         param_sharding, variables_sharding)
+from .checkpoint import (save_sharded, load_sharded,  # noqa: F401
+                         AsyncSaveHandle)
+from .moe import (MoELayer, ExpertFFN, global_scatter,  # noqa: F401
+                  global_gather, limit_by_capacity, switch_gating,
+                  gshard_gating, collect_aux_losses)
 from .mp_ops import (parallel_cross_entropy, parallel_log_softmax,  # noqa: F401
                      vocab_parallel_embedding)
 from .parallel import (DataParallel, ParallelEnv, get_rank,  # noqa: F401
@@ -41,7 +46,10 @@ __all__ = [
     "broadcast", "p2p_push", "reduce", "reduce_scatter", "scatter",
     "send_recv_permute", "split", "ColumnParallelLinear", "RowParallelLinear",
     "VocabParallelEmbedding", "shard_constraint", "param_sharding",
-    "variables_sharding", "parallel_cross_entropy", "parallel_log_softmax",
+    "variables_sharding", "save_sharded", "load_sharded", "AsyncSaveHandle",
+    "MoELayer", "ExpertFFN", "global_scatter",
+    "global_gather", "limit_by_capacity", "switch_gating", "gshard_gating",
+    "collect_aux_losses", "parallel_cross_entropy", "parallel_log_softmax",
     "vocab_parallel_embedding", "DataParallel", "ParallelEnv", "get_rank",
     "get_world_size", "init_parallel_env", "shard_batch",
     "device_put_sharded_variables", "RNGStatesTracker",

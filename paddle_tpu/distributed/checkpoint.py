@@ -1,0 +1,274 @@
+"""Sharded checkpoint with resharding-on-load.
+
+Reference capability being matched:
+- per-rank shard save/load for hybrid-parallel training
+  (hybrid_parallel_pp_save_load.py test family; each rank persists only its
+  own parameter/optimizer shards);
+- cross-config conversion — load a checkpoint written under one parallel
+  layout into a different one
+  (auto_parallel/dist_saver.py + converter.py, auto_parallel_autoconvert.py).
+
+TPU-native design (tensorstore/orbax-style, self-contained):
+- every leaf is written as one file PER ADDRESSABLE SHARD (only
+  replica_id==0 shards, so replicated axes are written once; on multi-host
+  each host writes exactly its own shards — no gather to host 0, which is
+  what breaks the pickle path at 1.3B+);
+- a JSON manifest records the tree structure, dtypes, global shapes and
+  every shard's index window;
+- load builds each array with ``jax.make_array_from_callback`` against the
+  TARGET sharding: each device's window is stitched from whichever saved
+  shard files overlap it (numpy memmap reads touch only the needed bytes).
+  The saved and target layouts are fully decoupled — dp=4,mp=2 checkpoints
+  load into dp=2,mp=4 (or single-device) without a conversion pass;
+- ``save_sharded(..., use_async=True)`` returns immediately and flushes
+  device-to-host copies + file writes on a background thread (async
+  checkpointing for the elastic/preemption path).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+
+__all__ = ["save_sharded", "load_sharded", "AsyncSaveHandle"]
+
+_MANIFEST = "manifest.json"          # single-host name (kept for reading)
+
+
+def _manifest_name() -> str:
+    # one manifest per process: multi-host saves must not overwrite each
+    # other's shard lists; load merges every manifest-p*.json it finds
+    return f"manifest-p{jax.process_index()}.json"
+
+
+def _flatten(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+def _index_to_json(index, shape) -> List[List[int]]:
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _leaf_dir(path: str, name: str) -> str:
+    return os.path.join(path, name.replace("/", "__"))
+
+
+class AsyncSaveHandle:
+    """Returned by ``save_sharded(use_async=True)``; ``wait()`` blocks until
+    every shard is durably on disk (join before preemption exit)."""
+
+    def __init__(self, thread: threading.Thread, errors: list):
+        self._thread = thread
+        self._errors = errors
+
+    def wait(self) -> None:
+        self._thread.join()
+        if self._errors:
+            raise self._errors[0]
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+
+def save_sharded(state, path: str, *, use_async: bool = False
+                 ) -> Optional[AsyncSaveHandle]:
+    """Write ``state`` (pytree of jax/numpy arrays) as a sharded checkpoint.
+
+    Each process writes only its addressable replica-0 shards, so the
+    aggregate across hosts is exactly one copy of every element.
+    """
+    os.makedirs(path, exist_ok=True)
+    leaves = _flatten(state)
+    manifest: Dict[str, Any] = {"version": 1, "leaves": {}}
+    work: List[Tuple[str, List[Dict[str, Any]]]] = []
+    proc = jax.process_index()
+
+    for name, leaf in leaves:
+        arr = jnp.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
+        entry: Dict[str, Any] = {
+            "shape": list(arr.shape),
+            "dtype": str(np.dtype(arr.dtype)) if arr.dtype != jnp.bfloat16
+                     else "bfloat16",
+            "shards": [],
+        }
+        shard_specs = []
+        for i, shard in enumerate(arr.addressable_shards):
+            if shard.replica_id != 0:
+                continue
+            # process index in the name: hosts share the directory and must
+            # never collide on shard files
+            fname = f"shard-p{proc}-{i}.npy"
+            idx = _index_to_json(shard.index, arr.shape)
+            entry["shards"].append({"file": fname, "index": idx})
+            # device→host copy happens NOW, synchronously: the caller may
+            # donate these buffers to the next jitted step the moment we
+            # return, so only file I/O may be deferred to the thread
+            data = np.asarray(shard.data)
+            if data.dtype == jnp.bfloat16:
+                data = data.view(np.uint16)  # npy has no bf16: raw bits
+            shard_specs.append({"file": fname, "data": data})
+        manifest["leaves"][name] = entry
+        work.append((name, shard_specs))
+
+    def _write():
+        for name, shard_specs in work:
+            d = _leaf_dir(path, name)
+            os.makedirs(d, exist_ok=True)
+            for spec in shard_specs:
+                np.save(os.path.join(d, spec["file"]), spec["data"])
+        with open(os.path.join(path, _manifest_name()), "w") as f:
+            json.dump(manifest, f, indent=1)
+
+    if not use_async:
+        _write()
+        return None
+    errors: list = []
+
+    def _run():
+        try:
+            _write()
+        except Exception as e:  # surfaced by handle.wait()
+            errors.append(e)
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    return AsyncSaveHandle(t, errors)
+
+
+def _read_window(leaf_dir: str, entry: Dict[str, Any], window) -> np.ndarray:
+    """Assemble one index window from the saved shard files (memmap reads
+    touch only the overlapping byte ranges) — the resharding core."""
+    shape = entry["shape"]
+    dtype = entry["dtype"]
+    np_dtype = np.uint16 if dtype == "bfloat16" else np.dtype(dtype)
+    win = []
+    for sl, dim in zip(window, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        win.append((start, stop))
+    out = np.empty([b - a for a, b in win], np_dtype)
+    filled = 0
+    for shard in entry["shards"]:
+        idx = shard["index"]
+        # overlap of the saved shard window with the requested window
+        inter = [(max(a, c), min(b, d)) for (a, b), (c, d) in zip(win, idx)]
+        if any(a >= b for a, b in inter):
+            continue
+        mm = np.load(os.path.join(leaf_dir, shard["file"]), mmap_mode="r")
+        src = tuple(slice(a - c, b - c)
+                    for (a, b), (c, d) in zip(inter, idx))
+        dst = tuple(slice(a - wa, b - wa)
+                    for (a, b), (wa, _) in zip(inter, win))
+        out[dst] = mm[src]
+        filled += int(np.prod([b - a for a, b in inter]))
+    enforce(filled == out.size,
+            f"checkpoint window {win} only {filled}/{out.size} covered — "
+            f"missing shard files?")
+    if dtype == "bfloat16":
+        return out.view(jnp.bfloat16)
+    return out
+
+
+def load_sharded(path: str, template=None):
+    """Load a sharded checkpoint.
+
+    ``template``: a pytree matching the saved structure whose leaves carry
+    the TARGET placement — jax.Arrays, ShapeDtypeStructs with ``.sharding``,
+    or NamedShardings.  Each leaf is materialized directly into that
+    sharding, reading only the slices every device needs (resharding-on-load;
+    ≙ auto_parallel converter).  With ``template=None`` returns a nested
+    dict of host numpy arrays (names split on '/').
+    """
+    import glob as _glob
+    names = sorted(_glob.glob(os.path.join(path, "manifest-p*.json")))
+    if os.path.exists(os.path.join(path, _MANIFEST)):
+        names.append(os.path.join(path, _MANIFEST))
+    enforce(names, f"no manifest found under {path!r}")
+    leaves: Dict[str, Any] = {}
+    for mpath in names:  # union of every process's shard lists
+        with open(mpath) as f:
+            part = json.load(f)["leaves"]
+        for lname, entry in part.items():
+            if lname in leaves:
+                leaves[lname]["shards"].extend(entry["shards"])
+            else:
+                leaves[lname] = entry
+
+    if template is None:
+        out: Dict[str, Any] = {}
+        for name, entry in leaves.items():
+            full = _read_window(
+                _leaf_dir(path, name), entry,
+                tuple(slice(0, d) for d in entry["shape"]))
+            node = out
+            parts = name.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = full
+        return out
+
+    tpl_leaves = _flatten(template)
+    tpl_names = {n for n, _ in tpl_leaves}
+    missing = tpl_names - set(leaves)
+    enforce(not missing, f"checkpoint missing leaves: {sorted(missing)[:5]}")
+
+    restored = {}
+    for name, tpl in tpl_leaves:
+        entry = leaves[name]
+        d = _leaf_dir(path, name)
+        shape = tuple(entry["shape"])
+        dtype = (jnp.bfloat16 if entry["dtype"] == "bfloat16"
+                 else np.dtype(entry["dtype"]))
+        sharding = getattr(tpl, "sharding", None)
+        if sharding is None and hasattr(tpl, "spec"):
+            sharding = tpl  # a NamedSharding itself
+        if isinstance(sharding, jax.sharding.SingleDeviceSharding):
+            # leave single-device leaves uncommitted so they can mix with
+            # mesh-sharded arrays in one jitted computation
+            sharding = None
+        tshape = tuple(getattr(tpl, "shape", shape))
+        enforce(tshape == shape,
+                f"{name}: template shape {tshape} != saved {shape}")
+        if sharding is None:
+            restored[name] = jnp.asarray(
+                _read_window(d, entry, tuple(slice(0, s) for s in shape)))
+        else:
+            restored[name] = jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx, d=d, e=entry: _read_window(d, e, idx))
+    # rebuild the template's tree structure with restored leaves
+    flat_tpl, treedef = jax.tree_util.tree_flatten_with_path(template)
+    ordered = []
+    for pathkeys, _ in flat_tpl:
+        parts = []
+        for k in pathkeys:
+            parts.append(str(k.key) if hasattr(k, "key")
+                         else str(getattr(k, "idx", k)))
+        ordered.append(restored["/".join(parts)])
+    return jax.tree_util.tree_unflatten(treedef, ordered)

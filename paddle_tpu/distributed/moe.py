@@ -1,0 +1,303 @@
+"""Mixture-of-Experts with expert parallelism (BASELINE config #5).
+
+Reference semantics:
+- capacity-bucketed dispatch `global_scatter` / `global_gather`
+  (operators/collective/global_scatter_op.cc:20, global_gather_op.cc) — an
+  all-to-all that routes each token to the rank owning its assigned expert,
+  bounded per-expert by a static capacity;
+- `_limit_by_capacity` (distributed/models/moe/utils.py:131) — drop tokens
+  beyond an expert's capacity;
+- gate networks (incubate gshard/switch gates) with load-balancing aux loss.
+
+TPU-native design: the ragged send/recv of global_scatter maps badly onto
+XLA's static shapes, but its *semantics* — at most C tokens per expert,
+overflow dropped — are exactly the GShard dispatch formulation: one-hot
+(token, expert, slot) masks turned into einsums.  The MoE layer is therefore
+pure SPMD: tokens stay sharded over dp, the stacked expert weights are
+sharded over the ``ep`` mesh axis, and GSPMD inserts the all-to-alls that
+global_scatter/global_gather perform by hand (they ride ICI).  The
+shard_map-level ``global_scatter``/``global_gather`` primitives are also
+provided for API parity and for custom dispatch experiments.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.errors import enforce
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from .collective import all_to_all
+from .mp_layers import shard_constraint
+
+__all__ = ["switch_gating", "gshard_gating", "limit_by_capacity",
+           "global_scatter", "global_gather", "MoELayer", "ExpertFFN",
+           "collect_aux_losses"]
+
+
+# ---------------------------------------------------------------------------
+# Aux-loss collection: MoE gate losses arise deep inside the network but
+# belong in the training loss.  A trace-safe collection scope (the analog of
+# the reference gathering gate losses from every MoELayer before the loss
+# is formed) — a plain thread-local list of traced scalars.
+# ---------------------------------------------------------------------------
+import contextlib  # noqa: E402
+import threading  # noqa: E402
+
+_aux_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def collect_aux_losses():
+    """``with collect_aux_losses() as aux: ...`` — every MoELayer forward
+    inside appends its load-balance loss to ``aux`` (a list of scalars)."""
+    prev = getattr(_aux_ctx, "items", None)
+    _aux_ctx.items = []
+    try:
+        yield _aux_ctx.items
+    finally:
+        _aux_ctx.items = prev
+
+
+def _record_aux(value) -> bool:
+    items = getattr(_aux_ctx, "items", None)
+    if items is None:
+        return False
+    items.append(value)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Gating
+# ---------------------------------------------------------------------------
+def limit_by_capacity(mask, capacity: int):
+    """Zero out tokens beyond each expert's capacity and return their slot
+    positions (first-come order along the token axis) — semantics of
+    _limit_by_capacity (moe/utils.py:131) + prune_gate_by_capacity.
+
+    mask: (T, E) one-hot-ish {0,1}.  Returns (kept_mask, positions) with
+    positions ∈ [0, capacity) valid only where kept_mask is 1.
+    """
+    positions = jnp.cumsum(mask, axis=0) * mask - mask  # 0-based slot
+    kept = mask * (positions < capacity)
+    return kept, (positions * kept).astype(jnp.int32)
+
+
+def _one_hot_dispatch(mask, positions, capacity: int):
+    """(T, E) kept mask + slots → (T, E, C) dispatch tensor."""
+    slot_oh = jax.nn.one_hot(positions, capacity, dtype=mask.dtype)
+    return mask[:, :, None] * slot_oh
+
+
+def switch_gating(logits, capacity: int):
+    """Top-1 (Switch) gating with capacity.
+
+    Returns (dispatch (T,E,C), combine (T,E,C), aux_loss scalar).
+    aux = E * Σ_e frac_tokens_e · mean_prob_e (the Switch load-balance loss;
+    ≙ the reference switch gate's balance term).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+    density = mask.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = E * jnp.sum(density * density_proxy)
+    kept, pos = limit_by_capacity(mask, capacity)
+    dispatch = _one_hot_dispatch(kept, pos, capacity)
+    gate = jnp.sum(probs * mask, axis=-1)
+    combine = gate[:, None, None] * dispatch
+    return dispatch, combine, aux
+
+
+def gshard_gating(logits, capacity: int):
+    """Top-2 (GShard) gating with capacity; second choices queue behind all
+    first choices (the reference gshard gate ordering)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    density = mask1.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = E * jnp.sum(density * density_proxy)
+
+    kept1, pos1 = limit_by_capacity(mask1, capacity)
+    # second choices are placed after every first choice of that expert
+    first_counts = jnp.sum(kept1, axis=0, keepdims=True)      # (1, E)
+    pos2_raw = jnp.cumsum(mask2, axis=0) * mask2 - mask2 + first_counts
+    kept2 = mask2 * (pos2_raw < capacity)
+    pos2 = (pos2_raw * kept2).astype(jnp.int32)
+
+    gate1 = jnp.sum(probs * mask1, axis=-1)
+    gate2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(gate1 + gate2, 1e-9)
+    gate1, gate2 = gate1 / denom, gate2 / denom
+
+    d1 = _one_hot_dispatch(kept1, pos1, capacity)
+    d2 = _one_hot_dispatch(kept2, pos2, capacity)
+    dispatch = d1 + d2
+    combine = gate1[:, None, None] * d1 + gate2[:, None, None] * d2
+    return dispatch, combine, aux
+
+
+_GATES: Dict[str, Callable] = {"switch": switch_gating,
+                               "gshard": gshard_gating}
+
+
+# ---------------------------------------------------------------------------
+# shard_map-level dispatch primitives (API parity with the reference ops)
+# ---------------------------------------------------------------------------
+def global_scatter(x, group: str = "ep"):
+    """Capacity-bucketed expert dispatch across the ``group`` axis — the
+    static-shape rendering of global_scatter_op.cc.  Call INSIDE shard_map.
+
+    x: (E, C, ...) — this rank's tokens bucketed by destination expert
+    (E = total experts).  Returns (E_local·world, C, ...) reshaped as
+    (world, E_local, C, ...) → flattened to (world·C rows per local expert):
+    concretely (E_local, world·C, ...) — every token now sits on the rank
+    owning its expert, grouped by source rank.
+    """
+    world = lax.axis_size(group)
+    e = x.shape[0]
+    enforce(e % world == 0, f"experts {e} not divisible by ep world {world}")
+    y = all_to_all(x, group, split_axis=0, concat_axis=0)
+    # (world * e_local, C, ...) with source-rank major order
+    e_local = e // world
+    y = y.reshape(world, e_local, *y.shape[1:])
+    y = jnp.moveaxis(y, 0, 1)                 # (e_local, world, C, ...)
+    return y.reshape(e_local, world * y.shape[2], *y.shape[3:])
+
+
+def global_gather(x, group: str = "ep"):
+    """Inverse of global_scatter (≙ global_gather_op.cc): return expert
+    outputs to the token's source rank.  Call INSIDE shard_map."""
+    world = lax.axis_size(group)
+    e_local = x.shape[0]
+    c = x.shape[1] // world
+    y = x.reshape(e_local, world, c, *x.shape[2:])
+    y = jnp.moveaxis(y, 1, 0)                 # (world, e_local, C, ...)
+    y = y.reshape(world * e_local, c, *y.shape[3:])
+    return all_to_all(y, group, split_axis=0, concat_axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Expert + layer
+# ---------------------------------------------------------------------------
+class ExpertFFN(Layer):
+    """E stacked FFN experts, weights sharded over the ``ep`` mesh axis.
+    ≙ the reference's per-rank expert list (moe/moe_layer.py experts), laid
+    out as one (E, ...) tensor so a single einsum feeds every expert."""
+
+    def __init__(self, num_experts: int, hidden_size: int, ffn_size: int,
+                 ep_axis: str = "ep", weight_attr=None,
+                 out_weight_attr=None, act=F.gelu):
+        super().__init__()
+        self.num_experts = num_experts
+        self.act = act
+        # separate in/out initializers: GPT-style residual scaling applies
+        # only to the output projection (matches the dense GPTMLP fc_in /
+        # fc_out split)
+        out_weight_attr = out_weight_attr or weight_attr
+        init1 = getattr(weight_attr, "initializer", None) or I.Normal(std=0.02)
+        init2 = (getattr(out_weight_attr, "initializer", None)
+                 or I.Normal(std=0.02))
+        self.w1 = self.create_parameter(
+            (num_experts, hidden_size, ffn_size),
+            attr=weight_attr, default_initializer=init1)
+        self.w1.pspec = P(ep_axis, None, None)
+        self.b1 = self.create_parameter((num_experts, 1, ffn_size),
+                                        is_bias=True)
+        self.b1.pspec = P(ep_axis, None, None)
+        self.w2 = self.create_parameter(
+            (num_experts, ffn_size, hidden_size),
+            attr=out_weight_attr, default_initializer=init2)
+        self.w2.pspec = P(ep_axis, None, None)
+        self.b2 = self.create_parameter((num_experts, 1, hidden_size),
+                                        is_bias=True)
+        self.b2.pspec = P(ep_axis, None, None)
+
+    def forward(self, x):
+        """x: (E, C, H) expert inputs → (E, C, H)."""
+        w1 = self.w1.value.astype(x.dtype)
+        w2 = self.w2.value.astype(x.dtype)
+        h = jnp.einsum("ech,ehf->ecf", x, w1) + self.b1.value.astype(x.dtype)
+        h = self.act(h)
+        return (jnp.einsum("ecf,efh->ech", h, w2)
+                + self.b2.value.astype(x.dtype))
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer (≙ incubate.distributed.models.moe.MoELayer).
+
+    Forward: gate → capacity-limited dispatch einsum → expert FFN (ep-sharded)
+    → combine einsum.  The dispatched activations are shard-constrained
+    P('ep', None, None) so GSPMD emits the global_scatter/global_gather
+    all-to-alls between the token-sharded and expert-sharded layouts.
+
+    The load-balancing aux loss reaches the training loss via an enclosing
+    :func:`collect_aux_losses` scope (what GPTForCausalLM does), or via the
+    second output of :meth:`forward_with_aux` — both stay inside the trace.
+    """
+
+    def __init__(self, hidden_size: int, ffn_size: int, num_experts: int,
+                 *, gate: str = "gshard", capacity_factor: float = 2.0,
+                 ep_axis: str = "ep", weight_attr=None,
+                 out_weight_attr=None, gate_weight_attr=None,
+                 dropout_p: float = 0.0):
+        super().__init__()
+        enforce(gate in _GATES, f"unknown gate {gate!r}; use {list(_GATES)}")
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        self.gate_type = gate
+        self.ep_axis = ep_axis
+        self.dropout_p = float(dropout_p)
+        ginit = (getattr(gate_weight_attr, "initializer", None)
+                 or I.Normal(std=0.02))
+        self.gate_weight = self.create_parameter(
+            (hidden_size, num_experts), attr=gate_weight_attr,
+            default_initializer=ginit)
+        self.gate_weight.pspec = P(None, None)
+        self.experts = ExpertFFN(num_experts, hidden_size, ffn_size,
+                                 ep_axis=ep_axis, weight_attr=weight_attr,
+                                 out_weight_attr=out_weight_attr)
+
+    def capacity(self, tokens: int) -> int:
+        k = 2 if self.gate_type == "gshard" else 1
+        return max(1, int(math.ceil(
+            tokens * self.capacity_factor * k / self.num_experts)))
+
+    def forward_with_aux(self, x) -> Tuple[Any, Any]:
+        """x: (B, S, H) → (out (B, S, H), aux_loss scalar)."""
+        b, s, h = x.shape
+        tokens = b * s
+        xt = x.reshape(tokens, h)
+        cap = self.capacity(tokens)
+        logits = xt.astype(jnp.float32) @ self.gate_weight.value.astype(
+            jnp.float32)
+        dispatch, combine, aux = _GATES[self.gate_type](logits, cap)
+        dispatch = dispatch.astype(x.dtype)
+        expert_in = jnp.einsum("tec,th->ech", dispatch, xt)
+        expert_in = shard_constraint(expert_in, self.ep_axis, None, None)
+        expert_out = self.experts(expert_in)
+        expert_out = shard_constraint(expert_out, self.ep_axis, None, None)
+        out = jnp.einsum("ech,tec->th", expert_out, combine.astype(x.dtype))
+        out = out.reshape(b, s, h)
+        if self.dropout_p > 0.0:
+            # residual dropout, matching the dense FFN's trailing dropout
+            out = F.dropout(out, p=self.dropout_p, training=self.training)
+        return out, aux
+
+    def forward(self, x):
+        out, aux = self.forward_with_aux(x)
+        _record_aux(aux)
+        return out
