@@ -1,17 +1,29 @@
 """Benchmark harness: GPT causal-LM pretraining throughput on one chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-- metric: GPT tokens/sec/chip (fwd+bwd+update, bf16 activations, fp32 master
-  weights — the BASELINE.json config #4 single-chip slice).
+- metric: GPT-125M tokens/sec/chip (fwd+bwd+update; bf16 activations via
+  amp O1, flash-attention Pallas kernel, S=2048 — the BASELINE.json config
+  #4 single-chip slice).
 - vs_baseline: achieved MFU / 0.45 (the north-star ≥45% MFU target;
   BASELINE.md records no reference numbers in-tree, so the target ratio is
   the comparison axis).
 
-Extra diagnostics go to stderr so stdout stays one parseable line.
+Timing methodology (IMPORTANT, round-4 fix): on the tunneled TPU platform
+``block_until_ready`` returns at dispatch, not completion — a host readback
+(``float(loss)``) is the only true synchronization.  The timed region ends
+with that readback; steps chain donated state so device execution
+serializes.  The r03 number (53.7k tok/s) predates this fix.
+
+Extra diagnostics go to stderr so stdout stays one parseable line:
+- flash-vs-XLA attention check,
+- an honest GPT-1.3B slice measurement: time L=2 and L=6 layer slices of
+  the 1.3B config (remat + bf16), difference out the per-layer cost, and
+  report the composed full-24-layer estimate labelled as an estimate.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -32,7 +44,6 @@ def _peak_flops_per_sec() -> float:
     for gen, tf in _PEAK_TFLOPS.items():
         if gen in kind:
             return tf * 1e12
-    import os
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     if gen in _PEAK_TFLOPS:
         return _PEAK_TFLOPS[gen] * 1e12
@@ -43,29 +54,24 @@ def _param_count(params) -> int:
     return sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
 
 
-def main():
-    on_tpu = jax.devices()[0].platform != "cpu"
-    import paddle_tpu as pt
-    from paddle_tpu.framework import random as fw_random
-    from paddle_tpu.models import GPTForCausalLM, gpt_125m, gpt_tiny
+def _flops_per_token(n_params: int, cfg, S: int) -> float:
+    # 6N for fwd+bwd matmuls + causal attention term 12*L*h*S per token
+    return 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S // 2
 
-    if on_tpu:
-        cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
-                       attention_dropout=0.0)
-        B, S, steps, warmup = 8, 1024, 10, 3
-    else:  # dev smoke path
-        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
-        B, S, steps, warmup = 2, 128, 3, 1
+
+def _build(cfg, B, S, lr=1e-4):
+    """(jitted step, params, opt_state, ids, labels, key) for one config."""
+    import paddle_tpu as pt
+    from paddle_tpu import amp as amp_mod
+    from paddle_tpu.framework import random as fw_random
+    from paddle_tpu.models import GPTForCausalLM
 
     pt.seed(0)
     model = GPTForCausalLM(cfg)
     model.train()
     params = model.state_dict()
-    n_params = _param_count(params)
-
-    opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
+    opt = pt.optimizer.AdamW(learning_rate=lr, weight_decay=0.01)
     opt_state = opt.init(params)
-
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
@@ -73,43 +79,108 @@ def main():
     def train_step(params, opt_state, input_ids, labels, key):
         def loss_fn(p):
             with fw_random.key_scope(key):
-                loss, _ = model.apply(p, input_ids, labels=labels)
+                with amp_mod.auto_cast(level="O1", dtype="bfloat16"):
+                    loss, _ = model.apply(p, input_ids, labels=labels)
             return loss
         loss, grads = jax.value_and_grad(loss_fn)(params)
         new_params, new_state = opt.apply_gradients(grads, params, opt_state)
         return loss, new_params, new_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
-    key = jax.random.key(0)
+    return jitted, model, params, opt_state, ids, labels
 
+
+def _timed_steps(jitted, params, opt_state, ids, labels, steps, warmup):
+    """Seconds per step with host-readback synchronization."""
+    key = jax.random.key(0)
     t0 = time.perf_counter()
     for i in range(warmup):
         loss, params, opt_state = jitted(params, opt_state, ids, labels,
                                          jax.random.fold_in(key, i))
-    loss.block_until_ready()
-    print(f"compile+warmup {time.perf_counter()-t0:.1f}s loss={float(loss):.3f}",
-          file=sys.stderr)
+    _ = float(loss)                       # true sync (see module docstring)
+    warm_t = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for i in range(steps):
         loss, params, opt_state = jitted(params, opt_state, ids, labels,
                                          jax.random.fold_in(key, warmup + i))
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
+    final_loss = float(loss)              # sync INSIDE the timed region
+    dt = (time.perf_counter() - t0) / steps
+    return dt, final_loss, warm_t
 
-    tokens_per_sec = B * S * steps / dt
-    # 6ND for fwd+bwd matmul FLOPs + attention term 12*L*h*S^2... use the
-    # standard 6*N approximation plus attention: 6*N + 12*L*H*S per token
-    attn_flops_per_tok = 12 * cfg.num_layers * cfg.hidden_size * S
-    flops_per_tok = 6 * n_params + attn_flops_per_tok
-    mfu = tokens_per_sec * flops_per_tok / _peak_flops_per_sec()
 
-    print(f"params={n_params/1e6:.1f}M step={dt/steps*1e3:.1f}ms "
-          f"tok/s={tokens_per_sec:.0f} mfu={mfu:.3f} loss={float(loss):.3f}",
-          file=sys.stderr)
+def _bench_config(cfg, B, S, steps, warmup, tag):
+    jitted, model, params, opt_state, ids, labels = _build(cfg, B, S)
+    n_params = _param_count(params)
+    dt, loss, warm_t = _timed_steps(jitted, params, opt_state, ids, labels,
+                                    steps, warmup)
+    tok_s = B * S / dt
+    mfu = tok_s * _flops_per_token(n_params, cfg, S) / _peak_flops_per_sec()
+    print(f"[{tag}] params={n_params / 1e6:.1f}M B={B} S={S} "
+          f"compile+warmup={warm_t:.1f}s step={dt * 1e3:.1f}ms "
+          f"tok/s={tok_s:.0f} mfu={mfu:.3f} loss={loss:.3f}",
+          file=sys.stderr, flush=True)
+    return tok_s, mfu
+
+
+def _bench_1p3b_slice(S=2048, B=4):
+    """Honest 1.3B methodology: full 1.3B + fp32 Adam does not fit one v5e
+    chip, so measure 2- and 6-layer slices (remat on), difference out the
+    per-layer cost, and compose an ESTIMATE for the 24-layer model."""
+    from paddle_tpu.models import gpt_1p3b
+    times = {}
+    for L in (2, 6):
+        cfg = gpt_1p3b(num_layers=L, hidden_dropout=0.0,
+                       attention_dropout=0.0, use_recompute=True,
+                       use_pallas_attention=True, dtype="bfloat16")
+        jitted, model, params, opt_state, ids, labels = _build(cfg, B, S)
+        dt, loss, _ = _timed_steps(jitted, params, opt_state, ids, labels,
+                                   steps=5, warmup=2)
+        times[L] = dt
+        print(f"[1.3b-slice L={L}] step={dt * 1e3:.1f}ms loss={loss:.3f}",
+              file=sys.stderr, flush=True)
+    per_layer = (times[6] - times[2]) / 4
+    est = times[2] + 22 * per_layer
+    tok_s = B * S / est
+    # full-model params for the MFU estimate
+    from paddle_tpu.models import GPTForCausalLM
+    cfg24 = gpt_1p3b()
+    n24 = (cfg24.vocab_size * cfg24.hidden_size
+           + cfg24.max_position_embeddings * cfg24.hidden_size
+           + cfg24.num_layers * 12 * cfg24.hidden_size ** 2)
+    mfu = tok_s * _flops_per_token(n24, cfg24, S) / _peak_flops_per_sec()
+    print(f"[1.3b-estimate] per_layer={per_layer * 1e3:.1f}ms "
+          f"est_step={est * 1e3:.0f}ms est_tok/s={tok_s:.0f} "
+          f"est_mfu={mfu:.3f} (ESTIMATE composed from measured slices)",
+          file=sys.stderr, flush=True)
+
+
+def main():
+    if os.environ.get("BENCH_CPU", "0") == "1":  # local smoke, no TPU probe
+        from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
+        force_virtual_cpu_mesh(1)
+    on_tpu = jax.devices()[0].platform != "cpu"
+    from paddle_tpu.models import gpt_125m, gpt_tiny
+
+    if on_tpu:
+        cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
+                       attention_dropout=0.0, use_pallas_attention=True,
+                       max_position_embeddings=2048)
+        tok_s, mfu = _bench_config(cfg, B=8, S=2048, steps=10, warmup=3,
+                                   tag="gpt-125m")
+        if os.environ.get("BENCH_SKIP_SLICE", "0") != "1":
+            try:
+                _bench_1p3b_slice()
+            except Exception as e:  # diagnostics must not kill the headline
+                print(f"[1.3b-slice] failed: {e!r}", file=sys.stderr)
+    else:  # dev smoke path
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        tok_s, mfu = _bench_config(cfg, B=2, S=128, steps=3, warmup=1,
+                                   tag="smoke")
+
     print(json.dumps({
         "metric": "gpt_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
     }))

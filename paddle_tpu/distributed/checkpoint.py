@@ -105,7 +105,10 @@ def save_sharded(state, path: str, *, use_async: bool = False
     """
     os.makedirs(path, exist_ok=True)
     leaves = _flatten(state)
-    manifest: Dict[str, Any] = {"version": 1, "leaves": {}}
+    # world count recorded so load merges EXACTLY p0..p{world-1} and never
+    # picks up stale manifests from an earlier save with more processes
+    manifest: Dict[str, Any] = {"version": 1,
+                                "world": jax.process_count(), "leaves": {}}
     work: List[Tuple[str, List[Dict[str, Any]]]] = []
     proc = jax.process_index()
 
@@ -205,13 +208,22 @@ def load_sharded(path: str, template=None):
     ≙ auto_parallel converter).  With ``template=None`` returns a nested
     dict of host numpy arrays (names split on '/').
     """
-    import glob as _glob
-    names = sorted(_glob.glob(os.path.join(path, "manifest-p*.json")))
-    if os.path.exists(os.path.join(path, _MANIFEST)):
-        names.append(os.path.join(path, _MANIFEST))
-    enforce(names, f"no manifest found under {path!r}")
+    p0 = os.path.join(path, "manifest-p0.json")
+    if not os.path.exists(p0) and os.path.exists(
+            os.path.join(path, _MANIFEST)):
+        p0 = os.path.join(path, _MANIFEST)  # legacy single-host name
+    enforce(os.path.exists(p0), f"no manifest found under {path!r}")
+    with open(p0) as f:
+        head = json.load(f)
+    world = int(head.get("world", 1))
+    names = [p0] + [os.path.join(path, f"manifest-p{i}.json")
+                    for i in range(1, world)]
+    missing_m = [n for n in names if not os.path.exists(n)]
+    enforce(not missing_m,
+            f"checkpoint written by {world} processes but manifests missing:"
+            f" {missing_m}")
     leaves: Dict[str, Any] = {}
-    for mpath in names:  # union of every process's shard lists
+    for mpath in names:  # union of exactly this save's shard lists
         with open(mpath) as f:
             part = json.load(f)["leaves"]
         for lname, entry in part.items():

@@ -1,0 +1,174 @@
+"""Elastic / preemption handling (component D14).
+
+Reference: fleet/elastic/manager.py ``ElasticManager``:130 — etcd node
+registry with watch callbacks (:245) and lease heartbeats; on membership
+change it tears down and relaunches training with rewritten endpoints.
+Companion: automatic checkpointing for recovery
+(fluid/incubate/checkpoint/auto_checkpoint.py).
+
+TPU-native rendering: cluster membership is the TPU runtime's problem (a
+preempted pod slice just goes away); what the framework owes the user is
+**surviving preemption** — periodic async sharded checkpoints, a SIGTERM
+hook that flushes one final checkpoint inside the grace window, and a
+restore-on-restart that reshards into whatever topology the job came back
+with (which checkpoint.load_sharded already does).  That is the whole
+teardown/relaunch loop of the reference with the etcd machinery replaced
+by the platform's own scheduler.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from ..framework.log import vlog
+from .checkpoint import AsyncSaveHandle, load_sharded, save_sharded
+
+__all__ = ["ElasticTrainState", "latest_checkpoint"]
+
+_STEP_PREFIX = "step-"
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest complete checkpoint path under ``directory`` (or None)."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        if not name.startswith(_STEP_PREFIX):
+            continue
+        full = os.path.join(directory, name)
+        if not os.path.exists(os.path.join(full, "COMMITTED")):
+            continue  # partial write (crashed mid-save)
+        try:
+            step = int(name[len(_STEP_PREFIX):])
+        except ValueError:
+            continue
+        if step > best_step:
+            best, best_step = full, step
+    return best
+
+
+class ElasticTrainState:
+    """Preemption-aware checkpoint manager.
+
+    >>> mgr = ElasticTrainState("ckpts", save_interval_steps=100)
+    >>> state, start = mgr.restore_or(init_state, template_fn)
+    >>> for step in range(start, total):
+    ...     state = train_step(state)
+    ...     mgr.maybe_save(step, state)     # async, every interval
+    >>> mgr.finalize(step, state)
+
+    On SIGTERM (the TPU preemption notice) the handler saves one final
+    checkpoint synchronously before re-raising the default handler —
+    restart then resumes from it, under the SAME or a DIFFERENT mesh
+    (resharding-on-load).  ≙ ElasticManager's watch→checkpoint→relaunch
+    cycle with the relaunch owned by the cluster scheduler.
+    """
+
+    def __init__(self, directory: str, save_interval_steps: int = 1000,
+                 keep: int = 2, install_sigterm_handler: bool = True):
+        self.directory = directory
+        self.save_interval_steps = int(save_interval_steps)
+        self.keep = keep
+        self._pending: Optional[AsyncSaveHandle] = None
+        self._latest_state: Any = None
+        self._latest_step: int = -1
+        self._lock = threading.Lock()
+        self._prev_handler = None
+        if install_sigterm_handler:
+            try:
+                self._prev_handler = signal.signal(
+                    signal.SIGTERM, self._on_sigterm)
+            except ValueError:  # not the main thread
+                self._prev_handler = None
+
+    # -- save --------------------------------------------------------------
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
+
+    def _commit(self, step: int) -> None:
+        open(os.path.join(self._path(step), "COMMITTED"), "w").close()
+        self._gc()
+
+    def save(self, step: int, state, *, use_async: bool = True) -> None:
+        self.wait()
+        path = self._path(step)
+        vlog(1, "elastic: saving checkpoint %s", path)
+        if use_async:
+            handle = save_sharded(state, path, use_async=True)
+            mgr = self
+            errors: list = []
+
+            def _finish(h=handle, s=step):
+                try:
+                    h.wait()
+                    mgr._commit(s)
+                except Exception as e:  # surfaced by self.wait()
+                    errors.append(e)
+
+            t = threading.Thread(target=_finish, daemon=True)
+            t.start()
+            self._pending = AsyncSaveHandle(t, errors)
+        else:
+            save_sharded(state, path)
+            self._commit(step)
+
+    def maybe_save(self, step: int, state) -> bool:
+        """Track the live state; checkpoint every save_interval_steps."""
+        with self._lock:
+            self._latest_state = state
+            self._latest_step = step
+        if step > 0 and step % self.save_interval_steps == 0:
+            self.save(step, state)
+            return True
+        return False
+
+    def finalize(self, step: int, state) -> None:
+        self.save(step, state, use_async=False)
+        self.wait()
+
+    def wait(self) -> None:
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+
+    # -- restore -----------------------------------------------------------
+    def restore_or(self, init_fn: Callable[[], Any],
+                   template_fn: Callable[[], Any]):
+        """(state, start_step): restore the newest committed checkpoint into
+        ``template_fn()``'s placement, else ``(init_fn(), 0)``."""
+        path = latest_checkpoint(self.directory)
+        if path is None:
+            return init_fn(), 0
+        step = int(os.path.basename(path)[len(_STEP_PREFIX):])
+        vlog(1, "elastic: restoring %s", path)
+        return load_sharded(path, template_fn()), step + 1
+
+    # -- preemption --------------------------------------------------------
+    def _on_sigterm(self, signum, frame) -> None:
+        with self._lock:
+            state, step = self._latest_state, self._latest_step
+        if state is not None:
+            vlog(0, "elastic: SIGTERM — flushing checkpoint at step %d", step)
+            self.save(step, state, use_async=False)
+        if callable(self._prev_handler):
+            self._prev_handler(signum, frame)
+        else:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def _gc(self) -> None:
+        if not self.keep:
+            return
+        done = sorted(
+            (int(n[len(_STEP_PREFIX):]) for n in os.listdir(self.directory)
+             if n.startswith(_STEP_PREFIX) and os.path.exists(
+                 os.path.join(self.directory, n, "COMMITTED"))),
+            reverse=True)
+        import shutil
+        for step in done[self.keep:]:
+            shutil.rmtree(self._path(step), ignore_errors=True)

@@ -1,0 +1,124 @@
+"""Distributed launcher (component D13).
+
+Reference: ``python -m paddle.distributed.launch`` —
+launch/controllers/collective.py spawns one process per device and wires
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM (:89-92); multi-node rendezvous via
+launch/controllers/master.py (etcd/http).
+
+TPU-native model: ONE process per HOST (single-controller SPMD), not one
+per device — the per-device process zoo is NCCL's requirement, not XLA's.
+Responsibilities that remain real:
+
+- ``init_from_env()``: called in the training process; wires
+  ``jax.distributed.initialize`` (the TCPStore-analog rendezvous — on TPU
+  pods the runtime discovers the topology itself and all arguments are
+  optional) from the reference's PADDLE_* env names or JAX's own.
+- ``python -m paddle_tpu.distributed.launch --nnodes N --master host:port
+  train.py ...``: spawns N local host-processes with the env wired (the
+  localhost simulation of a pod, ≙ the reference's test doctrine), or with
+  ``--nnodes 1`` just execs the script.
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import subprocess
+import sys
+from typing import List, Optional
+
+import jax
+
+from ...framework.log import vlog
+
+__all__ = ["init_from_env", "launch"]
+
+
+def _env(name: str, *alts: str, default: Optional[str] = None
+         ) -> Optional[str]:
+    for n in (name,) + alts:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return default
+
+
+def init_from_env() -> None:
+    """Bring up multi-host JAX from launcher env vars.
+
+    Env (reference names first, JAX names accepted):
+      PADDLE_MASTER / JAX_COORDINATOR_ADDRESS — host:port of process 0
+      PADDLE_TRAINERS_NUM / JAX_NUM_PROCESSES — process count
+      PADDLE_TRAINER_ID / JAX_PROCESS_ID — this process's id
+    With none set on a TPU pod, jax.distributed.initialize() lets the
+    runtime discover everything (the TPU-native path).
+    """
+    if jax.distributed.is_initialized():
+        return  # idempotent: the launcher already initialized this process
+    coord = _env("PADDLE_MASTER", "JAX_COORDINATOR_ADDRESS")
+    nproc = _env("PADDLE_TRAINERS_NUM", "JAX_NUM_PROCESSES")
+    pid = _env("PADDLE_TRAINER_ID", "JAX_PROCESS_ID")
+    kwargs = {}
+    if coord:
+        kwargs["coordinator_address"] = coord
+    if nproc:
+        kwargs["num_processes"] = int(nproc)
+    if pid:
+        kwargs["process_id"] = int(pid)
+    vlog(1, "launch.init_from_env: %s", kwargs or "(TPU pod auto-discovery)")
+    jax.distributed.initialize(**kwargs)
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    """Entry of ``python -m paddle_tpu.distributed.launch``."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a (multi-host) training script.")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                   help="number of host processes (local simulation when "
+                        "they all run here)")
+    p.add_argument("--master", default=os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:37777"),
+        help="host:port of the coordinator (process 0)")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="run ONLY this rank (real multi-host: one launcher "
+                        "per host); default spawns all ranks locally")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    def env_for(rank: int) -> dict:
+        env = dict(os.environ)
+        env["PADDLE_MASTER"] = args.master
+        env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        return env
+
+    if args.nnodes <= 1:
+        sys.argv = [args.script] + list(args.script_args)
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    if args.node_rank is not None:
+        os.environ.update(env_for(args.node_rank))
+        init_from_env()
+        sys.argv = [args.script] + list(args.script_args)
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    # local simulation: spawn every rank here (≙ the reference's
+    # localhost-multiprocess test doctrine, test_dist_base.py:782)
+    procs = []
+    for rank in range(args.nnodes):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", str(args.nnodes), "--master", args.master,
+               "--node_rank", str(rank), args.script] + list(args.script_args)
+        procs.append(subprocess.Popen(cmd, env=env_for(rank)))
+    rc = 0
+    for rank, proc in enumerate(procs):
+        code = proc.wait()
+        vlog(1, "rank %d exited with %d", rank, code)
+        rc = rc or code
+    return rc

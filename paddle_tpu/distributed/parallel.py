@@ -40,9 +40,12 @@ def init_parallel_env(dp_degree: Optional[int] = None) -> "ParallelEnv":
     Single-host: builds a pure-DP mesh over all local devices unless a
     hybrid mesh was already installed via fleet.init().
     """
-    if int(os.environ.get("PADDLE_TPU_MULTIHOST", "0")):
-        # multi-host: one process per host, all hosts see the global mesh
-        jax.distributed.initialize()
+    if (int(os.environ.get("PADDLE_TPU_MULTIHOST", "0"))
+            or os.environ.get("PADDLE_TRAINERS_NUM", "1") != "1"):
+        # multi-host: one process per host, all hosts see the global mesh;
+        # rendezvous wired by the launcher's env vars (distributed.launch)
+        from .launch import init_from_env
+        init_from_env()
     if topo.get_hybrid_communicate_group() is None:
         n = dp_degree or jax.device_count()
         t = CommunicateTopology(["data"], [n])

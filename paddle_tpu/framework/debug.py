@@ -1,0 +1,53 @@
+"""nan/inf debugging (SURVEY §5 race-detection row: the reference's
+debugging aid is ``FLAGS_check_nan_inf`` checked inside
+OperatorWithKernel::RunImpl, operator.cc:1252 →
+details/nan_inf_utils_detail — a per-op output scan that aborts with the
+offending op named).
+
+TPU-native: per-op host checks would sync every dispatch; instead the check
+compiles INTO the jitted step.  ``finite_flags`` reduces every leaf to one
+boolean on device (cheap, fused); ``assert_all_finite`` reads the flags on
+host and raises naming each offending leaf — same observability, one sync
+per step instead of per op.  The hapi train step wires this automatically
+when ``FLAGS_check_nan_inf`` is set; custom loops call these two functions
+directly (or flip ``jax_debug_nans`` for the per-primitive variant).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .flags import get_flags
+
+__all__ = ["check_nan_inf_enabled", "finite_flags", "assert_all_finite"]
+
+
+def check_nan_inf_enabled() -> bool:
+    v = get_flags(["check_nan_inf"])["check_nan_inf"]
+    return bool(v) and str(v) not in ("0", "False", "false")
+
+
+def finite_flags(tree) -> Dict[str, Any]:
+    """{leaf path: scalar bool (all finite)} — call inside jit."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        x = jnp.asarray(leaf)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            out[name] = jnp.all(jnp.isfinite(x))
+    return out
+
+
+def assert_all_finite(flags: Dict[str, Any], context: str = "") -> None:
+    """Host-side: raise listing every non-finite leaf (≙ the reference's
+    PADDLE_ENFORCE abort with the op name)."""
+    bad = [name for name, ok in flags.items() if not bool(ok)]
+    if bad:
+        where = f" in {context}" if context else ""
+        raise FloatingPointError(
+            f"nan/inf detected{where}: {', '.join(sorted(bad)[:10])}"
+            + (f" (+{len(bad) - 10} more)" if len(bad) > 10 else ""))

@@ -1,0 +1,38 @@
+"""VLOG-style logging (SURVEY §5 metrics/logging: glog ``VLOG(n)`` +
+fluid/log_helper.py).
+
+``vlog(level, msg)`` emits when ``FLAGS_log_level >= level`` — level 0 is
+always-on (warnings/errors go through the standard logger regardless).
+"""
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any
+
+from .flags import get_flags
+
+__all__ = ["get_logger", "vlog"]
+
+_logger = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        logger = logging.getLogger("paddle_tpu")
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "%(asctime)s [paddle_tpu] %(levelname)s %(message)s"))
+            logger.addHandler(h)
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+        _logger = logger
+    return _logger
+
+
+def vlog(level: int, msg: str, *args: Any) -> None:
+    """Emit ``msg`` when FLAGS_log_level >= level (glog VLOG semantics)."""
+    if int(get_flags(["log_level"])["log_level"]) >= level:
+        get_logger().info(msg, *args)

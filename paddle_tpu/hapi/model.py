@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import framework
+from ..framework import debug
 from ..framework import random as fw_random
 from ..framework.errors import enforce
 from ..io import DataLoader
@@ -72,7 +73,12 @@ class Model:
                 grads, trainable, opt_state)
             merged = dict(new_vars)
             merged.update(new_trainable)
-            return loss_v, out, merged, new_opt_state
+            # always traced (a few fused scalar reductions, ≙ the
+            # operator.cc:1252 per-op scans) so FLAGS_check_nan_inf stays
+            # runtime-togglable — the host only LOOKS at these when the
+            # flag is set at call time (train_batch)
+            finite = debug.finite_flags({"loss": loss_v, "grads": grads})
+            return loss_v, out, merged, new_opt_state, finite
 
         def eval_fn(params, *data):
             *inputs, label = data
@@ -97,8 +103,10 @@ class Model:
         data = [jnp.asarray(np.asarray(x)) for x in
                 (*_tuplify(inputs), *_tuplify(labels))]
         key = fw_random.next_key()
-        loss, out, new_params, self._opt_state = self._train_step(
+        loss, out, new_params, self._opt_state, finite = self._train_step(
             trainable, rest, self._opt_state, key, *data)
+        if debug.check_nan_inf_enabled():
+            debug.assert_all_finite(finite, context="train_batch")
         self.network.set_state_dict(new_params, strict=False)
         metrics = []
         for m in self._metrics:

@@ -1,0 +1,105 @@
+"""paddle.inference parity (E1): the deployment-facing predictor facade.
+
+Reference: AnalysisPredictor (inference/api/analysis_predictor.h:90) — load
+a saved program + params, run an optimization pass pipeline, execute with
+zero-copy IO; python surface ``paddle.inference.Config`` /
+``create_predictor`` / ``predictor.run``.
+
+TPU-native: the saved artifact is jit-exported StableHLO
+(paddle_tpu.jit.save); "the pass pipeline" is XLA compiling that module for
+the attached device — there is no separate inference executor to build.
+This facade keeps the reference's call shapes so serving code ports
+directly."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from .. import jit as pt_jit
+from ..framework.errors import enforce
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """≙ paddle.inference.Config(model_dir)."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self._model_dir = model_dir
+        self._device = "tpu"
+
+    def set_model(self, model_dir: str) -> None:
+        self._model_dir = model_dir
+
+    def model_dir(self) -> str:
+        return self._model_dir
+
+    def disable_gpu(self) -> None:  # source-compat no-op
+        self._device = "cpu"
+
+    def enable_memory_optim(self) -> None:  # XLA owns buffer reuse
+        pass
+
+    def switch_ir_optim(self, _=True) -> None:  # XLA owns the pass pipeline
+        pass
+
+
+class Predictor:
+    """≙ AnalysisPredictor's python surface: named input handles, run(),
+    named output fetch."""
+
+    def __init__(self, config: Config):
+        enforce(config.model_dir(), "Config.set_model(path) first")
+        self._layer = pt_jit.load(config.model_dir())
+        n_in = len(self._layer.input_spec)
+        self._input_names = [
+            s.name or f"input_{i}"
+            for i, s in enumerate(self._layer.input_spec)]
+        self._inputs: Dict[str, Any] = {}
+        self._outputs: List[Any] = []
+        assert len(self._input_names) == n_in
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> "_Handle":
+        return _Handle(self._inputs, name)
+
+    def run(self) -> None:
+        args = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*args)
+        self._outputs = list(out) if isinstance(out, (tuple, list)) else [out]
+
+    def get_output_names(self) -> List[str]:
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name: str) -> "_OutHandle":
+        idx = int(name.split("_")[-1])
+        return _OutHandle(self._outputs, idx)
+
+
+class _Handle:
+    def __init__(self, store: Dict[str, Any], name: str):
+        self._store, self._name = store, name
+
+    def copy_from_cpu(self, arr) -> None:
+        self._store[self._name] = np.asarray(arr)
+
+    def reshape(self, shape) -> None:  # source-compat no-op (static shapes)
+        pass
+
+
+class _OutHandle:
+    def __init__(self, outputs: List[Any], idx: int):
+        self._outputs, self._idx = outputs, idx
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._outputs[self._idx])
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

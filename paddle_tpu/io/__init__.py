@@ -234,10 +234,15 @@ def default_collate_fn(batch: List[Any]):
 # Worker process loop (reference: fluid/dataloader/worker.py:255 _worker_loop)
 # ---------------------------------------------------------------------------
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
-                 worker_init_fn):
+                 worker_init_fn, ring=None):
+    """With ``ring`` (the native shared-memory transport, io/native.py)
+    batches cross as raw array buffers gathered into a shm slot — no
+    pickling of payloads; otherwise the python mp.Queue carries them."""
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     np.random.seed((np.random.SeedSequence().entropy + worker_id) % (2**31))
+    if ring is not None:
+        from .native import encode_batch_parts
     while True:
         item = index_queue.get()
         if item is None:
@@ -245,7 +250,25 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
         batch_id, indices = item
         try:
             samples = [dataset[i] for i in indices]
-            result_queue.put((batch_id, collate_fn(samples), None))
+            batch = collate_fn(samples)
+            if ring is not None:
+                try:
+                    while True:
+                        try:
+                            ring.put_parts(
+                                encode_batch_parts(batch_id, batch))
+                            break
+                        except TimeoutError:
+                            # consumer busy (e.g. first-step compile) —
+                            # keep waiting; ring close ends the loop
+                            continue
+                except ValueError:
+                    # batch exceeds a shm slot → per-batch queue fallback
+                    result_queue.put((batch_id, batch, None))
+                except BrokenPipeError:
+                    break  # parent closed the ring (shutdown)
+            else:
+                result_queue.put((batch_id, batch, None))
         except Exception as e:  # propagate across the process boundary
             result_queue.put((batch_id, None, repr(e)))
 
@@ -271,6 +294,8 @@ class DataLoader:
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
         self.to_device = to_device
+        self.use_shared_memory = use_shared_memory
+        self.native_slot_bytes = 32 << 20
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -314,16 +339,31 @@ class DataLoader:
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
+    def _make_ring(self):
+        """Native shm transport when FLAGS_dataloader_use_native (and the
+        toolchain) allow it — the mmap_allocator/blocking-queue analog."""
+        from ..framework.flags import get_flags
+        flag = get_flags(["dataloader_use_native"])["dataloader_use_native"]
+        if not self.use_shared_memory or not flag or str(flag) in (
+                "0", "False", "false"):
+            return None
+        from .native import ShmRing, native_available
+        if not native_available():
+            return None
+        return ShmRing(slots=max(4, 2 * self.num_workers),
+                       slot_bytes=self.native_slot_bytes)
+
     def _iter_multiprocess(self):
         ctx = mp.get_context("fork")
         index_queue = ctx.Queue()
         result_queue = ctx.Queue()
+        ring = self._make_ring()   # create BEFORE fork: children inherit it
         workers = []
         for wid in range(self.num_workers):
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queue, result_queue, self.collate_fn,
-                      wid, self.worker_init_fn),
+                      wid, self.worker_init_fn, ring),
                 daemon=True)
             w.start()
             workers.append(w)
@@ -338,6 +378,25 @@ class DataLoader:
                 w.join(timeout=1.0)
                 if w.is_alive():
                     w.terminate()
+            if ring is not None:
+                ring.close()
+
+        def recv():
+            if ring is None:
+                return result_queue.get()
+            from .native import decode_batch
+            while True:
+                try:  # rare path: errors / oversized batches via the queue
+                    return result_queue.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                try:
+                    bid, err, batch = decode_batch(ring.get(timeout=0.1))
+                    return bid, batch, err
+                except TimeoutError:
+                    if not any(w.is_alive() for w in workers):
+                        raise RuntimeError(
+                            "all DataLoader workers died") from None
 
         try:
             sampler_iter = enumerate(iter(self.batch_sampler))
@@ -353,7 +412,7 @@ class DataLoader:
                 index_queue.put((bid, indices))
                 in_flight[bid] = True
             while in_flight:
-                bid, batch, err = result_queue.get()
+                bid, batch, err = recv()
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed: {err}")
                 del in_flight[bid]

@@ -1,0 +1,187 @@
+"""ctypes binding + message codec for the native shared-memory ring
+(io/_native/shm_ring.cc — see its header for the design rationale;
+the reference analog is mmap_allocator.cc + lod_tensor_blocking_queue.h).
+
+Batches cross the ring as [u32 meta_len][pickle meta][raw array buffers]:
+only tiny metadata is pickled; array payloads are gathered straight into
+the shared slot (srq_put iovecs) and rebuilt with np.frombuffer on the
+parent side.  The .so is compiled on first use with g++ (no pybind11 in
+the image; plain C ABI via ctypes) and cached next to the source.
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pickle
+import struct
+import subprocess
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.log import get_logger
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
+_SRC = os.path.join(_DIR, "shm_ring.cc")
+_SO = os.path.join(_DIR, "libshm_ring.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class _Iovec(ctypes.Structure):
+    _fields_ = [("base", ctypes.c_void_p), ("len", ctypes.c_uint64)]
+
+
+def _build() -> Optional[str]:
+    if (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", _SO, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception as e:  # toolchain missing → python fallback
+        get_logger().warning("native dataloader core build failed: %s", e)
+        return None
+
+
+def load_library():
+    """The ctypes handle, building the .so on first use; None if the
+    toolchain is unavailable (callers fall back to the python queue)."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.srq_size.restype = ctypes.c_uint64
+        lib.srq_size.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.srq_init.restype = ctypes.c_int
+        lib.srq_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                 ctypes.c_uint64]
+        lib.srq_put.restype = ctypes.c_int
+        lib.srq_put.argtypes = [ctypes.c_void_p, ctypes.POINTER(_Iovec),
+                                ctypes.c_uint64, ctypes.c_double]
+        lib.srq_get.restype = ctypes.c_int64
+        lib.srq_get.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_uint64, ctypes.c_double]
+        lib.srq_close.restype = None
+        lib.srq_close.argtypes = [ctypes.c_void_p]
+        lib.srq_count.restype = ctypes.c_uint64
+        lib.srq_count.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+class ShmRing:
+    """Fixed-slot MPSC ring in an anonymous shared mapping.
+
+    Create in the PARENT before forking workers — children inherit the
+    mapping, so there is nothing to name, unlink, or clean up."""
+
+    def __init__(self, slots: int = 8, slot_bytes: int = 32 << 20):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native dataloader core unavailable")
+        self._lib = lib
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        size = int(lib.srq_size(slots, slot_bytes))
+        self._mm = mmap.mmap(-1, size)  # MAP_SHARED|MAP_ANONYMOUS
+        self._addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+        rc = lib.srq_init(self._addr, slots, slot_bytes)
+        if rc != 0:
+            raise RuntimeError(f"srq_init failed rc={rc}")
+        self._scratch = bytearray(slot_bytes)
+
+    # -- raw message API ---------------------------------------------------
+    def put_parts(self, parts: List[Any], timeout: float = 60.0) -> None:
+        """Gathered write of buffer-protocol objects as one message."""
+        n = len(parts)
+        iov = (_Iovec * n)()
+        keep = []  # hold buffer references until the call returns
+        for i, p in enumerate(parts):
+            mv = memoryview(p).cast("B") if not isinstance(p, np.ndarray) \
+                else memoryview(np.ascontiguousarray(p)).cast("B")
+            if not mv.c_contiguous:
+                mv = memoryview(bytes(mv))
+            if mv.readonly:
+                ro = bytes(mv)
+                keep.append(ro)
+                iov[i].base = ctypes.cast(ctypes.c_char_p(ro),
+                                          ctypes.c_void_p)
+                iov[i].len = len(ro)
+            else:
+                buf = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+                keep.append((mv, buf))
+                iov[i].base = ctypes.addressof(buf)
+                iov[i].len = mv.nbytes
+        rc = self._lib.srq_put(self._addr, iov, n, float(timeout))
+        if rc == -1:
+            raise TimeoutError("ShmRing.put timeout")
+        if rc == -2:
+            total = sum(memoryview(p).nbytes for p in parts)
+            raise ValueError(
+                f"message {total}B exceeds slot {self.slot_bytes}B — raise "
+                f"DataLoader(native_slot_bytes=...)")
+        if rc == -3:
+            raise BrokenPipeError("ShmRing closed")
+
+    def get(self, timeout: float = 60.0) -> Optional[bytearray]:
+        """One message (writable bytearray); None when closed and drained."""
+        buf = self._scratch
+        caddr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        rc = self._lib.srq_get(self._addr, caddr, len(buf), float(timeout))
+        if rc == -1:
+            raise TimeoutError("ShmRing.get timeout")
+        if rc == -2:
+            raise ValueError("message larger than slot?")
+        if rc == -3:
+            return None
+        # bytearray: decode_batch's np.frombuffer views must be writable,
+        # matching the arrays the python-queue transport yields
+        return bytearray(buf[: int(rc)])
+
+    def close(self) -> None:
+        self._lib.srq_close(self._addr)
+
+    def count(self) -> int:
+        return int(self._lib.srq_count(self._addr))
+
+
+# -- batch codec -------------------------------------------------------------
+def encode_batch_parts(bid: int, batch, err: Optional[str] = None
+                       ) -> List[Any]:
+    """[u32 meta_len][meta pickle][array payloads...] as iovec parts."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    arrays = [np.ascontiguousarray(a) for a in leaves]
+    meta = pickle.dumps(
+        (bid, err, treedef, [(str(a.dtype), a.shape) for a in arrays]))
+    parts: List[Any] = [struct.pack("<I", len(meta)), meta]
+    parts.extend(arrays)
+    return parts
+
+
+def decode_batch(msg: bytes) -> Tuple[int, Optional[str], Any]:
+    import jax
+    (meta_len,) = struct.unpack_from("<I", msg, 0)
+    bid, err, treedef, specs = pickle.loads(msg[4: 4 + meta_len])
+    off = 4 + meta_len
+    leaves = []
+    for dtype, shape in specs:
+        n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        leaves.append(np.frombuffer(msg, dtype=dtype, count=int(
+            np.prod(shape)), offset=off).reshape(shape))
+        off += n
+    return bid, err, jax.tree_util.tree_unflatten(treedef, leaves)

@@ -3,6 +3,12 @@ of BASELINE.json; vision models live in paddle_tpu.vision.models)."""
 from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,  # noqa: F401
                   gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b, gpt_6p7b)
 from .gpt_pipeline import GPTPipeline  # noqa: F401
+from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, bert_tiny,
+                   bert_base, bert_large)
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPipeline", "gpt_tiny",
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "bert_tiny", "bert_base",
+           "bert_large",
+           "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPipeline", "gpt_tiny",
            "gpt_125m", "gpt_350m", "gpt_1p3b", "gpt_6p7b"]

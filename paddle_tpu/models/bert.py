@@ -1,0 +1,283 @@
+"""BERT encoder family (BASELINE config #3: BERT-base pretraining).
+
+Semantic reference: the fused transformer family the reference builds for
+exactly this block — fused_attention_op.cc:221-357 with pre_layer_norm=False
+(BERT is post-LN: self-attention → bias+dropout+residual+LN via
+FusedDropoutLayerNormHelper, fused_dropout_helper.h:207) and
+fused_feedforward_op.cc for the intermediate/output FFN.  The model
+class/API shape follows the reference's nn.TransformerEncoder doctrine
+(python/paddle/nn/layer/transformer.py) since the BERT model itself lives
+in PaddleNLP, outside this snapshot.
+
+TPU-first: the same Megatron TP layout as GPT (qkv column-split over heads,
+out/ffn row-split), flash-attention routing for the non-causal path, bf16
+activations, vocab-parallel MLM loss.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                     VocabParallelEmbedding, shard_constraint)
+from ..distributed.mp_ops import parallel_cross_entropy
+from ..framework import random as fw_random
+from ..framework.errors import enforce
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.initializer import ParamAttr
+from ..nn.layer import Layer, Parameter
+from ..nn.layers import Dropout, Embedding, LayerNorm, Linear
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "bert_tiny", "bert_base",
+           "bert_large"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30528          # padded to a multiple of 64 for the MXU
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+    use_pallas_attention: bool = False
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        enforce(self.hidden_size % self.num_heads == 0,
+                "num_heads must evenly divide hidden_size")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _normal(std):
+    return I.Normal(mean=0.0, std=std)
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional self-attention, TP over heads; ≙ fused_attention_op's
+    FMHA path with SrcMask (the additive padding mask, cc:237)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        std = c.initializer_range
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, gather_output=False,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, input_is_parallel=True,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.attn_dropout_p = c.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        c = self.config
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)
+        # head-major fused dim: mp sharding factors onto heads through the
+        # reshape (same layout rationale as GPTAttention)
+        qkv = qkv.reshape(b, s, c.num_heads, 3, c.head_dim)
+        qkv = shard_constraint(qkv, "dp", None, "mp", None, None)
+        q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)
+        k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
+        if (c.use_pallas_attention and attn_mask is None
+                and not (self.attn_dropout_p > 0 and self.training)):
+            from ..ops import flash_attention
+            out = flash_attention(q, k, v, causal=False, dropout_p=0.0,
+                                  training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=False,
+                dropout_p=self.attn_dropout_p, training=self.training)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
+        return self.out_proj(out)
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block: attn → dropout+residual+LN → FFN →
+    dropout+residual+LN (fused_attention_op pre_layer_norm=False +
+    fused_feedforward_op semantics)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        std = c.initializer_range
+        self.attn = BertSelfAttention(c)
+        self.attn_dropout = Dropout(c.hidden_dropout)
+        self.attn_ln = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+        self.fc_in = ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, gather_output=False,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.fc_out = RowParallelLinear(
+            c.intermediate_size, c.hidden_size, input_is_parallel=True,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.ffn_dropout = Dropout(c.hidden_dropout)
+        self.ffn_ln = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+
+    def forward(self, x, attn_mask=None):
+        h = self.attn(x, attn_mask=attn_mask)
+        x = self.attn_ln(x + self.attn_dropout(h))
+        h = self.fc_out(F.gelu(self.fc_in(x)))
+        return self.ffn_ln(x + self.ffn_dropout(h))
+
+
+class BertEmbeddings(Layer):
+    """word + position + token-type embeddings → LN → dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        std = c.initializer_range
+        self.word_embeddings = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.position_embeddings = Embedding(
+            c.max_position_embeddings, c.hidden_size,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.token_type_embeddings = Embedding(
+            c.type_vocab_size, c.hidden_size,
+            weight_attr=ParamAttr(initializer=_normal(std)))
+        self.layer_norm = LayerNorm(c.hidden_size,
+                                    epsilon=c.layer_norm_epsilon)
+        self.dropout = Dropout(c.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = jnp.arange(s)
+        x = self.word_embeddings(input_ids)
+        x = x + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    """Encoder backbone (+ tanh pooler over [CLS], the reference BertPooler
+    shape)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        from ..nn.layer import LayerList
+        self.encoder = LayerList([BertLayer(c) for _ in range(c.num_layers)])
+        self.pooler = Linear(c.hidden_size, c.hidden_size,
+                             weight_attr=ParamAttr(
+                                 initializer=_normal(c.initializer_range)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.config
+        x = self.embeddings(input_ids, token_type_ids)
+        if c.dtype != "float32":
+            x = x.astype(c.dtype)
+        x = shard_constraint(x, "dp", None, None)
+        mask = None
+        if attention_mask is not None:
+            # (b, s) {0,1} → additive (b, 1, 1, s), the SrcMask layout
+            mask = (1.0 - attention_mask[:, None, None, :].astype(x.dtype))
+            mask = mask * jnp.asarray(-1e9, x.dtype)
+        for layer in self.encoder:
+            x = layer(x, attn_mask=mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP pretraining head; MLM logits tied to the word embedding,
+    loss vocab-parallel (c_softmax_with_cross_entropy semantics)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.bert = BertModel(c)
+        std = c.initializer_range
+        self.transform = Linear(c.hidden_size, c.hidden_size,
+                                weight_attr=ParamAttr(
+                                    initializer=_normal(std)))
+        self.transform_ln = LayerNorm(c.hidden_size,
+                                      epsilon=c.layer_norm_epsilon)
+        self.mlm_bias = Parameter(jnp.zeros((c.vocab_size,), jnp.float32),
+                                  is_bias=True)
+        self.mlm_bias.pspec = P("mp")
+        self.nsp = Linear(c.hidden_size, 2,
+                          weight_attr=ParamAttr(initializer=_normal(std)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                mlm_labels=None, nsp_labels=None):
+        c = self.config
+        hidden, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(hidden)))
+        table = self.bert.embeddings.word_embeddings.weight.value
+        logits = jnp.einsum("bsh,vh->bsv", h, table.astype(h.dtype))
+        logits = logits + self.mlm_bias.value.astype(h.dtype)
+        logits = shard_constraint(logits, "dp", None, "mp")
+        nsp_logits = self.nsp(pooled)
+        if mlm_labels is None:
+            return logits, nsp_logits
+        # MLM: only positions with label != -100 count (standard masking)
+        valid = (mlm_labels != -100)
+        safe_labels = jnp.where(valid, mlm_labels, 0)
+        per_tok = parallel_cross_entropy(
+            logits.astype(jnp.float32), safe_labels, reduction="none")
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        loss = jnp.sum(per_tok * valid) / denom
+        if nsp_labels is not None:
+            nsp_loss = jnp.mean(F.cross_entropy(
+                nsp_logits.astype(jnp.float32), nsp_labels))
+            loss = loss + nsp_loss
+        return loss, logits
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        loss = jnp.mean(F.cross_entropy(logits.astype(jnp.float32), labels))
+        return loss, logits
+
+
+def _cfg(defaults: Dict[str, Any], kw: Dict[str, Any]) -> BertConfig:
+    return BertConfig(**{**defaults, **kw})
+
+
+def bert_tiny(**kw) -> BertConfig:
+    return _cfg(dict(hidden_size=128, num_layers=2, num_heads=4,
+                     vocab_size=1024, max_position_embeddings=128), kw)
+
+
+def bert_base(**kw) -> BertConfig:
+    return _cfg(dict(hidden_size=768, num_layers=12, num_heads=12), kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    return _cfg(dict(hidden_size=1024, num_layers=24, num_heads=16), kw)

@@ -1,0 +1,263 @@
+"""paddle.profiler parity (SURVEY §5 tracing/profiling, component E8).
+
+Reference: the new-generation profiler — python/paddle/profiler/profiler.py
+``Profiler``:264 with scheduler states (:33 ProfilerState CLOSED/READY/
+RECORD/RECORD_AND_RETURN), ``make_scheduler``, chrome-trace export (:154),
+``RecordEvent`` host annotations (platform/profiler/event_tracing.h) and
+``profiler_statistic.py`` summaries.
+
+TPU-native: the device side is XLA's XPlane tracer via jax.profiler — we
+wrap start/stop/step scheduling and keep the reference API shape
+(``Profiler(targets, scheduler, on_trace_ready)``, ``RecordEvent``,
+``profiler.step()``).  Traces land in TensorBoard/XPlane format (the TPU
+ecosystem's chrome://tracing analog); host annotations become
+TraceAnnotation ranges inside the same timeline, exactly the role
+RecordEvent plays inside OperatorWithKernel::RunImpl.  A lightweight host
+statistic table (op name → count/total ms) is kept for
+``summary()`` parity without parsing XPlane."""
+from __future__ import annotations
+
+import contextlib
+import enum
+import functools
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+
+__all__ = ["ProfilerTarget", "ProfilerState", "Profiler", "RecordEvent",
+           "make_scheduler", "record_function", "profiler_summary"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1          # accepted for source compat; maps to the device tracer
+    TPU = 2
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """≙ paddle.profiler.make_scheduler: step → state cycle
+    [skip_first | (closed, ready, record)*repeat]."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle, pos = divmod(s, period)
+        if repeat > 0 and cycle >= repeat:
+            return ProfilerState.CLOSED
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+# --------------------------------------------------------------------------
+# Host-side event stats (RecordEvent analog)
+# --------------------------------------------------------------------------
+_stats_lock = threading.Lock()
+_stats: Dict[str, Tuple[int, float]] = {}
+
+
+def _record_stat(name: str, dt: float) -> None:
+    with _stats_lock:
+        n, total = _stats.get(name, (0, 0.0))
+        _stats[name] = (n + 1, total + dt)
+
+
+def profiler_summary(reset: bool = False) -> Dict[str, Tuple[int, float]]:
+    """{event name: (count, total seconds)} for every RecordEvent so far
+    (the profiler_statistic.py table, host side)."""
+    with _stats_lock:
+        out = dict(_stats)
+        if reset:
+            _stats.clear()
+    return out
+
+
+class RecordEvent:
+    """Host annotation visible in the device timeline
+    (≙ paddle.profiler.RecordEvent / platform RecordEvent instrumentation).
+
+    Usable as a context manager or via explicit begin()/end()."""
+
+    def __init__(self, name: str, event_type: Any = None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self) -> None:
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+
+    def end(self) -> None:
+        if self._ann is not None:
+            _record_stat(self.name, time.perf_counter() - self._t0)
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self) -> "RecordEvent":
+        self.begin()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+def record_function(name: Optional[str] = None):
+    """Decorator form of RecordEvent."""
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with RecordEvent(label):
+                return fn(*a, **kw)
+        return wrapped
+    return deco
+
+
+class Profiler:
+    """≙ paddle.profiler.Profiler(targets, scheduler, on_trace_ready).
+
+    >>> p = Profiler(scheduler=make_scheduler(closed=1, ready=1, record=2,
+    ...                                       repeat=1))
+    >>> p.start()
+    >>> for batch in loader:
+    ...     train_step(...)
+    ...     p.step()
+    >>> p.stop()
+
+    Traces are written per recording window to ``log_dir/plugins/profile``
+    (TensorBoard XPlane — open with the TensorBoard profile plugin or
+    xprof; this is the TPU ecosystem's chrome-trace export)."""
+
+    def __init__(self, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler: Optional[Callable[[int], ProfilerState]] = None,
+                 on_trace_ready: Optional[Callable[["Profiler"], None]] = None,
+                 log_dir: Optional[str] = None, timer_only: bool = False):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU,
+                                                      ProfilerTarget.TPU]
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self.on_trace_ready = on_trace_ready
+        self.log_dir = log_dir or os.path.join(tempfile.gettempdir(),
+                                               "paddle_tpu_profile")
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._tracing = False
+        self._step_ann = None
+        self._step_t0 = None
+        self._step_times = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.current_state = self.scheduler(self.step_num)
+        self._apply_state(self.current_state)
+        self._begin_step_annotation()
+
+    def stop(self) -> None:
+        self._end_step_annotation()
+        if self._tracing:
+            self._stop_trace(trigger_callback=True)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self) -> None:
+        """Advance the step scheduler (call once per train iteration)."""
+        self._end_step_annotation()
+        if self._step_t0 is not None:
+            self._step_times.append(time.perf_counter() - self._step_t0)
+        next_state = self.scheduler(self.step_num + 1)
+        self._transition(self.current_state, next_state)
+        self.step_num += 1
+        self.current_state = next_state
+        self._begin_step_annotation()
+
+    # -- internals ---------------------------------------------------------
+    def _begin_step_annotation(self) -> None:
+        if self._tracing and not self.timer_only:
+            self._step_ann = jax.profiler.StepTraceAnnotation(
+                "train_step", step_num=self.step_num)
+            self._step_ann.__enter__()
+        self._step_t0 = time.perf_counter()
+
+    def _end_step_annotation(self) -> None:
+        if self._step_ann is not None:
+            self._step_ann.__exit__(None, None, None)
+            self._step_ann = None
+
+    def _apply_state(self, state: ProfilerState) -> None:
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+
+    def _transition(self, cur: ProfilerState, new: ProfilerState) -> None:
+        recording = cur in (ProfilerState.RECORD,
+                            ProfilerState.RECORD_AND_RETURN)
+        will_record = new in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        if recording and (not will_record
+                          or cur == ProfilerState.RECORD_AND_RETURN):
+            self._stop_trace(
+                trigger_callback=cur == ProfilerState.RECORD_AND_RETURN)
+        if will_record and (not recording
+                            or cur == ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+
+    def _start_trace(self) -> None:
+        if self._tracing or self.timer_only:
+            return
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self._tracing = True
+
+    def _stop_trace(self, trigger_callback: bool) -> None:
+        if not self._tracing:
+            return
+        jax.profiler.stop_trace()
+        self._tracing = False
+        if trigger_callback and self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self, sorted_by: str = "total", reset: bool = False) -> str:
+        """Host-side table: RecordEvent stats + step timing (the
+        profiler_statistic.py report analog)."""
+        rows = [(name, n, tot) for name, (n, tot) in
+                profiler_summary(reset=reset).items()]
+        rows.sort(key=lambda r: r[2], reverse=True)
+        lines = [f"{'event':40s} {'count':>8s} {'total ms':>10s} "
+                 f"{'avg ms':>10s}"]
+        for name, n, tot in rows:
+            lines.append(f"{name[:40]:40s} {n:8d} {tot * 1e3:10.2f} "
+                         f"{tot / n * 1e3:10.2f}")
+        if self._step_times:
+            ts = self._step_times
+            lines.append(f"steps: {len(ts)}  avg "
+                         f"{sum(ts) / len(ts) * 1e3:.2f} ms")
+        return "\n".join(lines)
+
+    def __enter__(self) -> "Profiler":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,8 +1,5 @@
 """Model zoo (reference: python/paddle/vision/models/)."""
 from .lenet import LeNet  # noqa: F401
-
-try:  # resnet lands with the conv milestone
-    from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
-                         resnet152)
-except ImportError:  # pragma: no cover
-    pass
+from .resnet import (ResNet, BasicBlock, BottleneckBlock,  # noqa: F401
+                     resnet18, resnet34, resnet50, resnet101, resnet152,
+                     wide_resnet50_2, wide_resnet101_2)

@@ -1,0 +1,199 @@
+"""Aux subsystem tests: nan/inf debug flag, vlog, launcher env wiring,
+elastic auto-checkpoint (SURVEY §5 rows)."""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.framework import debug
+from paddle_tpu.framework.flags import get_flags, set_flags
+
+
+class TestCheckNanInf:
+    def test_finite_flags_and_raise(self):
+        flags = debug.finite_flags(
+            {"ok": jnp.ones(3), "bad": jnp.asarray([1.0, np.inf]),
+             "nested": {"nan": jnp.asarray([np.nan])},
+             "ints": jnp.arange(3)})
+        assert bool(flags["ok"])
+        assert not bool(flags["bad"])
+        assert "ints" not in flags  # integer leaves skipped
+        with pytest.raises(FloatingPointError, match="bad"):
+            debug.assert_all_finite(flags, context="test")
+
+    def test_hapi_train_raises_on_nan(self):
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+        set_flags({"check_nan_inf": True})
+        try:
+            pt.seed(0)
+            net = nn.Sequential(nn.Linear(4, 4))
+            model = Model(net)
+            model.prepare(
+                optimizer=pt.optimizer.SGD(learning_rate=1e30),
+                loss=lambda out, y: jnp.sum(jnp.exp(out * 1e20)))
+            x = np.ones((2, 4), np.float32)
+            with pytest.raises(FloatingPointError):
+                for _ in range(3):
+                    model.train_batch([x], [x])
+        finally:
+            set_flags({"check_nan_inf": False})
+
+    def test_hapi_train_clean_when_finite(self):
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+        set_flags({"check_nan_inf": True})
+        try:
+            pt.seed(0)
+            net = nn.Sequential(nn.Linear(4, 4))
+            model = Model(net)
+            model.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1),
+                          loss=lambda out, y: jnp.mean((out - y) ** 2))
+            x = np.ones((2, 4), np.float32)
+            loss, _ = model.train_batch([x], [x])
+            assert np.isfinite(loss)
+        finally:
+            set_flags({"check_nan_inf": False})
+
+
+class TestVlog:
+    def test_gated_by_flag(self, capsys):
+        from paddle_tpu.framework.log import vlog
+        set_flags({"log_level": 0})
+        vlog(2, "hidden message")
+        assert "hidden message" not in capsys.readouterr().err
+        set_flags({"log_level": 2})
+        try:
+            vlog(2, "visible message")
+            assert "visible message" in capsys.readouterr().err
+        finally:
+            set_flags({"log_level": 0})
+
+
+class TestLauncherEnv:
+    def test_init_from_env_wires_jax_args(self, monkeypatch):
+        from paddle_tpu.distributed import launch as launch_mod
+        captured = {}
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: captured.update(kw))
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:1234")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        launch_mod.init_from_env()
+        assert captured == {"coordinator_address": "127.0.0.1:1234",
+                            "num_processes": 4, "process_id": 2}
+
+    def test_single_node_exec(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text("print('LAUNCH-OK', flush=True)\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             str(script)],
+            capture_output=True, text=True, timeout=120,
+            cwd="/root/repo")
+        assert out.returncode == 0, out.stderr
+        assert "LAUNCH-OK" in out.stdout
+
+
+class TestElastic:
+    def test_restore_or_fresh(self, tmp_path):
+        from paddle_tpu.distributed.elastic import ElasticTrainState
+        mgr = ElasticTrainState(str(tmp_path / "none"),
+                                install_sigterm_handler=False)
+        state, start = mgr.restore_or(lambda: {"w": jnp.ones(2)},
+                                      lambda: None)
+        assert start == 0
+        np.testing.assert_array_equal(state["w"], np.ones(2))
+
+    def test_interval_save_and_resume(self, tmp_path):
+        from paddle_tpu.distributed.elastic import (ElasticTrainState,
+                                                    latest_checkpoint)
+        d = str(tmp_path / "ck")
+        mgr = ElasticTrainState(d, save_interval_steps=2, keep=2,
+                                install_sigterm_handler=False)
+        state = {"w": jnp.zeros(3), "step": jnp.asarray(0)}
+        for step in range(1, 6):
+            state = {"w": state["w"] + 1.0, "step": jnp.asarray(step)}
+            mgr.maybe_save(step, state)
+        mgr.wait()
+        assert latest_checkpoint(d).endswith("step-4")
+
+        mgr2 = ElasticTrainState(d, install_sigterm_handler=False)
+        template = {"w": jax.ShapeDtypeStruct((3,), np.float32),
+                    "step": jax.ShapeDtypeStruct((), state["step"].dtype)}
+        restored, start = mgr2.restore_or(lambda: None, lambda: template)
+        assert start == 5
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      4.0 * np.ones(3))
+
+    def test_sigterm_flushes_final_checkpoint(self, tmp_path):
+        from paddle_tpu.distributed.elastic import (ElasticTrainState,
+                                                    latest_checkpoint)
+        d = str(tmp_path / "pre")
+        mgr = ElasticTrainState(d, save_interval_steps=1000,
+                                install_sigterm_handler=False)
+        mgr.maybe_save(7, {"w": jnp.full((2,), 7.0)})
+        # simulate the preemption notice without killing pytest
+        mgr._prev_handler = lambda *a: None
+        mgr._on_sigterm(signal.SIGTERM, None)
+        path = latest_checkpoint(d)
+        assert path is not None and path.endswith("step-7")
+
+
+class TestNativeDataLoader:
+    def test_ring_transport_matches_queue(self):
+        """Same data through the native shm ring and the python queue
+        (≙ the reference's shared-memory vs non-shared DataLoader modes)."""
+        from paddle_tpu.io import DataLoader, TensorDataset
+        from paddle_tpu.io.native import native_available
+        if not native_available():
+            pytest.skip("native core unavailable (no toolchain)")
+        rng = np.random.RandomState(0)
+        xs = rng.randn(64, 8).astype(np.float32)
+        ys = rng.randint(0, 4, (64,)).astype(np.int64)
+        ds = TensorDataset([xs, ys])
+
+        def collect(use_native):
+            set_flags({"dataloader_use_native": use_native})
+            try:
+                loader = DataLoader(ds, batch_size=16, num_workers=2,
+                                    shuffle=False, to_device=False)
+                return [jax.tree_util.tree_map(np.asarray, b)
+                        for b in loader]
+            finally:
+                set_flags({"dataloader_use_native": True})
+
+        native = collect(True)
+        plain = collect(False)
+        assert len(native) == len(plain) == 4
+        for a, b in zip(native, plain):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+
+    def test_worker_error_propagates_through_ring(self):
+        from paddle_tpu.io import DataLoader, Dataset
+        from paddle_tpu.io.native import native_available
+        if not native_available():
+            pytest.skip("native core unavailable")
+
+        class Bad(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom-5")
+                return np.zeros(4, np.float32)
+
+        loader = DataLoader(Bad(), batch_size=4, num_workers=2,
+                            to_device=False)
+        with pytest.raises(RuntimeError, match="boom-5"):
+            list(loader)

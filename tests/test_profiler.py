@@ -1,0 +1,71 @@
+"""Profiler tests (≙ reference test_profiler.py doctrine: scheduler state
+machine, RecordEvent stats, trace files on disk)."""
+import glob
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 make_scheduler, profiler_summary,
+                                 record_function)
+
+
+class TestScheduler:
+    def test_cycle_states(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                               skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states == [
+            ProfilerState.CLOSED,            # skip_first
+            ProfilerState.CLOSED,
+            ProfilerState.READY,
+            ProfilerState.RECORD,
+            ProfilerState.RECORD_AND_RETURN,  # last record step of cycle
+            ProfilerState.CLOSED,            # repeat exhausted
+        ]
+
+
+class TestRecordEvent:
+    def test_stats_accumulate(self):
+        profiler_summary(reset=True)
+        with RecordEvent("fwd"):
+            pass
+        with RecordEvent("fwd"):
+            pass
+
+        @record_function("bwd")
+        def f():
+            return 1
+
+        f()
+        stats = profiler_summary(reset=True)
+        assert stats["fwd"][0] == 2
+        assert stats["bwd"][0] == 1
+
+
+class TestProfiler:
+    def test_trace_produces_files_and_summary(self, tmp_path):
+        log_dir = str(tmp_path / "prof")
+        ready = []
+        p = Profiler(
+            scheduler=make_scheduler(closed=0, ready=1, record=2, repeat=1),
+            on_trace_ready=lambda prof: ready.append(prof.step_num),
+            log_dir=log_dir)
+        x = jnp.asarray(np.random.RandomState(0).randn(64, 64), jnp.float32)
+        f = jax.jit(lambda a: a @ a)
+        p.start()
+        for _ in range(4):
+            with RecordEvent("matmul_step"):
+                f(x).block_until_ready()
+            p.step()
+        p.stop()
+        assert ready, "on_trace_ready never fired"
+        produced = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                             recursive=True)
+        assert produced, f"no xplane trace under {log_dir}"
+        text = p.summary()
+        assert "matmul_step" in text
