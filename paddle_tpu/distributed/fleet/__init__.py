@@ -72,6 +72,7 @@ def init(role_maker=None, is_collective: bool = True,
         "data": int(cfg.get("dp_degree", 1)),
         "pipe": int(cfg.get("pp_degree", 1)),
         "sharding": int(cfg.get("sharding_degree", 1)),
+        "sequence": int(cfg.get("sp_degree", 1)),
         "expert": int(cfg.get("ep_degree", 1)),
         "model": int(cfg.get("mp_degree", 1)),
     }
@@ -85,11 +86,22 @@ def init(role_maker=None, is_collective: bool = True,
         enforce(n_dev % rest == 0, "device count not divisible by degrees")
         degrees["data"] = n_dev // rest
     # drop degenerate axes except data (keep 'dp' so batch specs always work)
-    names = [n for n in ("data", "pipe", "sharding", "expert", "model")
+    names = [n for n in ("data", "pipe", "sharding", "sequence", "expert",
+                         "model")
              if degrees[n] > 1 or n in ("data", "model")]
     dims = [degrees[n] for n in names]
     topo = CommunicateTopology(names, dims)
-    set_hybrid_communicate_group(HybridCommunicateGroup(topo))
+    # DCN factors for multi-slice pods: hybrid_configs dcn_<axis>_degree
+    # says how much of that axis spans slices (scaling-book recipe: dp/pp
+    # over DCN, everything else inside a slice)
+    dcn = {}
+    for name, short in (("data", "dp"), ("pipe", "pp"),
+                        ("sharding", "sharding")):
+        d = int(cfg.get(f"dcn_{short}_degree", 1))
+        if d > 1:
+            dcn[name] = d
+    set_hybrid_communicate_group(
+        HybridCommunicateGroup(topo, dcn_dims=dcn or None))
 
 
 def fleet_initialized() -> bool:

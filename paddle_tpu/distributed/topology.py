@@ -99,7 +99,8 @@ class HybridCommunicateGroup:
     """
 
     def __init__(self, topology: CommunicateTopology,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 dcn_dims: Optional[Dict[str, int]] = None):
         self._topo = topology
         if devices is None:
             devices = jax.devices()
@@ -109,13 +110,51 @@ class HybridCommunicateGroup:
         names = topology.get_hybrid_group_names()
         dims = [topology.get_dim(name) for name in names]
         axis_names = tuple(_AXIS_SHORT.get(name, name) for name in names)
-        dev_array = np.asarray(devices[:n]).reshape(dims)
+        dev_array = self._device_array(list(devices[:n]), names, dims,
+                                       dcn_dims)
         self.mesh = Mesh(dev_array, axis_names)
         self._axis_names = axis_names
         # the process this host drives; under single-controller SPMD every
         # device is visible, so "my rank" is only meaningful per-device —
         # keep rank 0 semantics for host-side code paths (logging, saving)
         self.global_rank = 0
+
+    @staticmethod
+    def _device_array(devices, names, dims, dcn_dims):
+        """Device placement for the mesh, DCN-aware on multi-slice pods.
+
+        Single slice (or CPU mesh): plain row-major reshape — every axis
+        rides ICI.  Multi-slice (devices carry distinct ``slice_index``,
+        i.e. slices joined by the data-center network): the axes named in
+        ``dcn_dims`` (degree per axis; typically dp and/or pp — the
+        low-volume, overlappable collectives per the scaling-book recipe)
+        span slices and everything else stays inside a slice, via
+        mesh_utils.create_hybrid_device_mesh.  This is the comm-backend
+        topology layer the reference builds as hierarchical allreduce
+        (nccl_comm_num / hierarchical_allreduce strategy fields) and
+        multi-slice DCN pipelines (fleet_executor, SURVEY A5).
+        """
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slice_ids) <= 1 or not dcn_dims:
+            return np.asarray(devices).reshape(dims)
+        from jax.experimental import mesh_utils
+        num_slices = len(slice_ids)
+        dcn_shape = []
+        ici_shape = []
+        for name, dim in zip(names, dims):
+            d = int(dcn_dims.get(name, 1))
+            enforce(dim % d == 0,
+                    f"axis {name} degree {dim} not divisible by its DCN "
+                    f"factor {d}")
+            dcn_shape.append(d)
+            ici_shape.append(dim // d)
+        total_dcn = int(np.prod(dcn_shape))
+        enforce(total_dcn == num_slices,
+                f"DCN factors {dcn_shape} product {total_dcn} != "
+                f"{num_slices} slices")
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            allow_split_physical_axes=True)
 
     # -- paddle-parity query API ------------------------------------------
     def topology(self) -> CommunicateTopology:

@@ -58,6 +58,15 @@ class GPTConfig:
     recompute_policy: Optional[str] = None
     use_pallas_attention: bool = False   # flash-attention kernel (ops/)
     dtype: str = "float32"               # activation dtype ("bfloat16" on TPU)
+    # long-sequence parallelism over the 'sp' mesh axis (additive TPU-native
+    # capability; the reference has none — SURVEY §5):
+    #   sequence_parallel: Ulysses-style — activations seq-sharded, heads
+    #     resharded over mp×sp inside attention (GSPMD emits the all-to-alls)
+    #   context_parallel: ring attention — no device ever holds the full
+    #     sequence; KV chunks rotate via ppermute (distributed/
+    #     sequence_parallel.py)
+    sequence_parallel: bool = False
+    context_parallel: bool = False
     # MoE (BASELINE config #5, ERNIE-MoE style): 0 experts = dense FFN.
     # moe_every=2 alternates dense/MoE like GShard; 1 = every layer (needed
     # for the homogeneous-trunk pipeline path).
@@ -76,6 +85,10 @@ class GPTConfig:
             self.ffn_hidden_size = 4 * self.hidden_size
         enforce(self.hidden_size % self.num_heads == 0,
                 "num_heads must evenly divide hidden_size")
+        enforce(not (self.context_parallel and self.attention_dropout > 0),
+                "context_parallel (ring attention) does not implement "
+                "attention-probability dropout; set attention_dropout=0 "
+                "(hidden_dropout is unaffected)")
 
     @property
     def head_dim(self) -> int:
@@ -119,23 +132,47 @@ class GPTAttention(Layer):
         # of involuntarily rematerializing (a (3, heads, ...) factorization
         # would need mp | 3)
         qkv = qkv.reshape(b, s, c.num_heads, 3, c.head_dim)
-        qkv = shard_constraint(qkv, "dp", None, "mp", None, None)
+        seq_ax = "sp" if c.sequence_parallel or c.context_parallel else None
+        qkv = shard_constraint(qkv, "dp", seq_ax, "mp", None, None)
         q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)   # (b, heads, s, d)
         k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
         v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
         if cache is not None:
             k = jnp.concatenate([cache[0], k], axis=2)
             v = jnp.concatenate([cache[1], v], axis=2)
-        if c.use_pallas_attention and cache is None:
-            from ..ops import flash_attention
-            out = flash_attention(
-                q, k, v, causal=True, dropout_p=self.attn_dropout_p,
-                training=self.training)
+        if c.context_parallel and cache is None:
+            # ring attention: seq stays sharded, KV chunks rotate the ring
+            from ..distributed.sequence_parallel import (
+                ring_attention_sharded)
+            from ..distributed.topology import get_mesh
+            mesh = get_mesh()
+            if mesh is not None and "sp" in mesh.axis_names:
+                out = ring_attention_sharded(q, k, v, causal=True)
+            else:  # serial fallback (tests / meshes without an sp axis)
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True,
+                    dropout_p=self.attn_dropout_p, training=self.training)
         else:
-            out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
-                training=self.training)
-        out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
+            if c.sequence_parallel:
+                # Ulysses layout change: full seq per shard, heads over
+                # mp×sp — the pair of constraints IS the all-to-all pair
+                q = shard_constraint(q, "dp", ("mp", "sp"), None, None)
+                k = shard_constraint(k, "dp", ("mp", "sp"), None, None)
+                v = shard_constraint(v, "dp", ("mp", "sp"), None, None)
+            if c.use_pallas_attention and cache is None:
+                from ..ops import flash_attention
+                out = flash_attention(
+                    q, k, v, causal=True, dropout_p=self.attn_dropout_p,
+                    training=self.training)
+            else:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
+                    training=self.training)
+            if c.sequence_parallel:
+                out = shard_constraint(out, "dp", ("mp", "sp"), None, None)
+        out = out.transpose(0, 2, 1, 3)             # (b, s, heads, d)
+        out = shard_constraint(out, "dp", seq_ax, "mp", None)
+        out = out.reshape(b, s, c.hidden_size)
         out = self.resid_dropout(self.out_proj(out))
         if cache is not None:
             return out, (k, v)
@@ -244,7 +281,9 @@ class GPTModel(Layer):
         if c.dtype != "float32":
             x = x.astype(c.dtype)
         x = self.drop(x)
-        x = shard_constraint(x, "dp", None, None)
+        seq_ax = ("sp" if c.sequence_parallel or c.context_parallel
+                  else None)
+        x = shard_constraint(x, "dp", seq_ax, None)
         new_caches = []
         for i, layer in enumerate(self.h):
             if caches is not None:
@@ -272,9 +311,12 @@ class GPTForCausalLM(Layer):
         with collect_aux_losses() as aux_losses:
             hidden = self.gpt(input_ids)        # (b, s, h)
         # tied head: logits = h @ wte.T → vocab-sharded over mp
+        c = self.config
         table = self.gpt.wte.weight.value.astype(hidden.dtype)
         logits = jnp.einsum("bsh,vh->bsv", hidden, table)
-        logits = shard_constraint(logits, "dp", None, "mp")
+        seq_ax = ("sp" if c.sequence_parallel or c.context_parallel
+                  else None)
+        logits = shard_constraint(logits, "dp", seq_ax, "mp")
         if labels is None:
             return logits
         loss = parallel_cross_entropy(

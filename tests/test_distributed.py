@@ -358,3 +358,47 @@ class TestGPTShardingHygiene:
         offending = [l for l in txt.splitlines() if "all-gather" in l
                      and any(s in l for s in bad_shapes)]
         assert not offending, offending[:3]
+
+
+class TestMultiSliceTopology:
+    """DCN-aware device placement (the multi-slice comm-backend layer;
+    ≙ the reference's hierarchical-allreduce / fleet_executor DCN split)."""
+
+    class _FakeDev:
+        def __init__(self, i, slice_index):
+            self.id = i
+            self.slice_index = slice_index
+            self.process_index = slice_index
+            self.platform = "tpu"
+            self.device_kind = "fake TPU"
+            self.coords = (i % 4, 0, 0)
+            self.core_on_chip = 0
+
+        def __repr__(self):
+            return f"fake(id={self.id},slice={self.slice_index})"
+
+    def test_dcn_axis_spans_slices(self):
+        # 2 slices × 4 devices: dp=4 with dcn_dp=2 → dp splits (2 dcn, 2 ici)
+        devs = [self._FakeDev(i, i // 4) for i in range(8)]
+        topo = dist.CommunicateTopology(["data", "model"], [4, 2])
+        hcg = dist.HybridCommunicateGroup(topo, devices=devs,
+                                          dcn_dims={"data": 2})
+        arr = hcg.mesh.devices
+        assert arr.shape == (4, 2)
+        # each mp pair must sit inside ONE slice (mp rides ICI)...
+        for i in range(4):
+            assert len({d.slice_index for d in arr[i]}) == 1
+        # ...and the dp axis must cross slices (dp rides DCN)
+        assert len({d.slice_index for d in arr[:, 0]}) == 2
+
+    def test_mismatched_dcn_factors_raise(self):
+        devs = [self._FakeDev(i, i // 4) for i in range(8)]
+        topo = dist.CommunicateTopology(["data", "model"], [4, 2])
+        with pytest.raises(Exception):
+            dist.HybridCommunicateGroup(topo, devices=devs,
+                                        dcn_dims={"data": 4})
+
+    def test_single_slice_unchanged(self):
+        topo = dist.CommunicateTopology(["data", "model"], [4, 2])
+        hcg = dist.HybridCommunicateGroup(topo, dcn_dims={"data": 2})
+        assert hcg.mesh.devices.shape == (4, 2)  # CPU devices: 1 slice
