@@ -1,0 +1,223 @@
+"""paddle.distribution parity (reference python/paddle/distribution/ — the
+torch.distributions-style API: Normal/Uniform/Categorical/Beta/Dirichlet/
+Bernoulli + kl_divergence, SURVEY A14).
+
+TPU-native: sampling draws keys from the framework RNG stream (eager) or an
+explicit key (jit); densities are jnp compositions that fuse into the
+surrounding program."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma, gammaln
+
+from ..framework import random as fw_random
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "kl_divergence"]
+
+
+def _key(key):
+    return key if key is not None else fw_random.next_key()
+
+
+def _arr(x):
+    return jnp.asarray(x, jnp.float32) if not isinstance(
+        x, jnp.ndarray) else x
+
+
+class Distribution:
+    def sample(self, shape: Sequence[int] = (), key=None):
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = (), key=None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """Reference distribution/normal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return jnp.square(self.scale)
+
+    def sample(self, shape=(), key=None):
+        return self.rsample(shape, key)
+
+    def rsample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(_key(key), shape)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        var = jnp.square(self.scale)
+        return (-jnp.square(_arr(value) - self.loc) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            jnp.broadcast_to(self.scale, jnp.broadcast_shapes(
+                self.loc.shape, self.scale.shape)))
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+class Uniform(Distribution):
+    """Reference distribution/uniform.py: U[low, high)."""
+
+    def __init__(self, low, high):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), key=None):
+        return self.rsample(shape, key)
+
+    def rsample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_key(key), shape)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        value = _arr(value)
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Categorical(Distribution):
+    """Reference distribution/categorical.py (logits parameterization)."""
+
+    def __init__(self, logits=None, probs=None):
+        if logits is None:
+            logits = jnp.log(jnp.clip(_arr(probs), 1e-30))
+        self.logits = _arr(logits)
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.categorical(_key(key), self.logits,
+                                      shape=tuple(shape)
+                                      + self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, jnp.asarray(value, jnp.int32)[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def kl_divergence(self, other: "Categorical"):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.probs_ = jnp.clip(_arr(probs), 1e-7, 1 - 1e-7)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.probs_.shape
+        return jax.random.bernoulli(_key(key), self.probs_, shape).astype(
+            jnp.float32)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return v * jnp.log(self.probs_) + (1 - v) * jnp.log1p(-self.probs_)
+
+    def entropy(self):
+        p = self.probs_
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Beta(Distribution):
+    """Reference distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                    self.beta.shape)
+        return jax.random.beta(_key(key), self.alpha, self.beta, shape)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return ((self.alpha - 1) * jnp.log(v)
+                + (self.beta - 1) * jnp.log1p(-v)
+                - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    """Reference distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.dirichlet(_key(key), self.concentration,
+                                    tuple(shape))
+
+    def log_prob(self, value):
+        c = self.concentration
+        v = _arr(value)
+        norm = jnp.sum(gammaln(c), -1) - gammaln(jnp.sum(c, -1))
+        return jnp.sum((c - 1) * jnp.log(v), -1) - norm
+
+    def entropy(self):
+        c = self.concentration
+        c0 = jnp.sum(c, -1)
+        k = c.shape[-1]
+        norm = jnp.sum(gammaln(c), -1) - gammaln(c0)
+        return (norm + (c0 - k) * digamma(c0)
+                - jnp.sum((c - 1) * digamma(c), -1))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Reference distribution/kl.py dispatch."""
+    if hasattr(p, "kl_divergence") and type(p) is type(q):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

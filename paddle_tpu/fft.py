@@ -1,0 +1,87 @@
+"""paddle.fft parity (reference python/paddle/fft.py — spectral ops over
+the phi fft kernels).  On TPU the substrate is jnp.fft: XLA lowers FFTs
+natively (and falls back to a DUCC custom call on CPU); the paddle surface
+is norm/axis argument order, kept here verbatim."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else x
+
+
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(_arr(x), n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(_arr(x), n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(_arr(x), n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(_arr(x), n=n, axis=axis, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(_arr(x), n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(_arr(x), n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(_arr(x), s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype="float32"):
+    return jnp.fft.fftfreq(n, d=d).astype(dtype)
+
+
+def rfftfreq(n, d=1.0, dtype="float32"):
+    return jnp.fft.rfftfreq(n, d=d).astype(dtype)
+
+
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(_arr(x), axes=axes)
+
+
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(_arr(x), axes=axes)

@@ -1,0 +1,240 @@
+"""Declarative op-spec registry — the api.yaml analog (component C12).
+
+Reference: python/paddle/utils/code_gen/api.yaml (228 `api:` entries, each
+declaring args/output/infer_meta/kernel/backward) feeding api_gen.py and the
+eager codegen (SURVEY A6).  On TPU there is no kernel table to generate —
+jax.numpy IS the kernel substrate — but the yaml's other role survives: ONE
+source of truth for the public op surface that drives parity tests (OpTest
+sweep over every entry, tests/test_op_registry.py), the API inventory
+(``api_table()``), and grad coverage.
+
+Each OpSpec carries the public callable, a pure-numpy reference, a sample
+input generator, and tolerance/grad metadata.  Registering an op here is
+what makes it part of the tested API contract.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["OpSpec", "register_op", "registry", "api_table"]
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str                      # dotted public path under paddle_tpu
+    fn: Callable                   # the framework op
+    ref: Callable                  # numpy reference implementation
+    sample: Callable               # rng -> tuple of np args
+    grad_wrt: Tuple[int, ...] = (0,)   # args to grad-check (() = skip)
+    rtol: float = 1e-5
+    atol: float = 1e-6
+    grad_rtol: float = 5e-3
+    grad_atol: float = 5e-4
+
+
+_REGISTRY: List[OpSpec] = []
+
+
+def register_op(spec: OpSpec) -> OpSpec:
+    _REGISTRY.append(spec)
+    return spec
+
+
+def registry() -> List[OpSpec]:
+    if not _REGISTRY:
+        _populate()
+    return list(_REGISTRY)
+
+
+def api_table() -> str:
+    """Markdown inventory of the registered public op surface."""
+    lines = ["| op | grad-checked |", "|---|---|"]
+    for s in registry():
+        lines.append(f"| `paddle_tpu.{s.name}` | "
+                     f"{'yes' if s.grad_wrt else 'n/a'} |")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Registration corpus
+# ---------------------------------------------------------------------------
+def _r(rng, *shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def _pos(rng, *shape):
+    return (np.abs(rng.randn(*shape)) + 0.5).astype(np.float32)
+
+
+def _populate() -> None:
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+
+    def unary(name, fn, ref, sample=lambda rng: (_r(rng, 3, 4),), **kw):
+        register_op(OpSpec(name=name, fn=fn, ref=ref, sample=sample, **kw))
+
+    def binary(name, fn, ref, sample=None, **kw):
+        sample = sample or (lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)))
+        register_op(OpSpec(name=name, fn=fn, ref=ref, sample=sample,
+                           grad_wrt=kw.pop("grad_wrt", (0, 1)), **kw))
+
+    # -- math unary (reference tensor/math.py ≙ phi unary kernels) --------
+    unary("exp", pt.exp, np.exp)
+    unary("log", pt.log, np.log, sample=lambda rng: (_pos(rng, 3, 4),))
+    unary("log1p", pt.log1p, np.log1p,
+          sample=lambda rng: (_pos(rng, 3, 4),))
+    unary("sqrt", pt.sqrt, np.sqrt, sample=lambda rng: (_pos(rng, 3, 4),))
+    unary("rsqrt", pt.rsqrt, lambda x: 1.0 / np.sqrt(x),
+          sample=lambda rng: (_pos(rng, 3, 4),))
+    unary("square", pt.square, np.square)
+    unary("abs", pt.abs, np.abs)
+    unary("sin", pt.sin, np.sin)
+    unary("cos", pt.cos, np.cos)
+    unary("tanh", pt.tanh, np.tanh)
+    unary("sigmoid", pt.sigmoid, lambda x: 1 / (1 + np.exp(-x)))
+    unary("erf", pt.erf,
+          lambda x: np.vectorize(_erf_scalar)(x).astype(np.float64))
+    unary("floor", pt.floor, np.floor, grad_wrt=())
+    unary("ceil", pt.ceil, np.ceil, grad_wrt=())
+    unary("round", pt.round, np.round, grad_wrt=())
+    unary("sign", pt.sign, np.sign, grad_wrt=())
+    unary("reciprocal", pt.reciprocal, lambda x: 1.0 / x,
+          sample=lambda rng: (_pos(rng, 3, 4),))
+
+    # -- math binary (broadcasting included) ------------------------------
+    binary("add", pt.add, np.add)
+    binary("subtract", pt.subtract, np.subtract)
+    binary("multiply", pt.multiply, np.multiply)
+    binary("divide", pt.divide, np.divide,
+           sample=lambda rng: (_r(rng, 3, 4), _pos(rng, 3, 4)))
+    binary("maximum", pt.maximum, np.maximum)
+    binary("minimum", pt.minimum, np.minimum)
+    binary("pow", pt.pow, np.power,
+           sample=lambda rng: (_pos(rng, 3, 4), np.float32(2.0)),
+           grad_wrt=(0,))
+    binary("atan2", pt.atan2, np.arctan2)
+    binary("broadcast_add", pt.add, np.add,
+           sample=lambda rng: (_r(rng, 3, 4), _r(rng, 1, 4)))
+
+    # -- reductions -------------------------------------------------------
+    unary("sum", pt.sum, np.sum, sample=lambda rng: (_r(rng, 3, 4),))
+    unary("mean", pt.mean, np.mean)
+    unary("max", pt.max, np.max, grad_wrt=())
+    unary("min", pt.min, np.min, grad_wrt=())
+    unary("prod", pt.prod, np.prod,
+          sample=lambda rng: (_pos(rng, 2, 3),))
+    register_op(OpSpec(
+        name="sum.axis", fn=lambda x: __import__("paddle_tpu").sum(
+            x, axis=1, keepdim=True),
+        ref=lambda x: np.sum(x, axis=1, keepdims=True),
+        sample=lambda rng: (_r(rng, 3, 4),)))
+
+    # -- linalg -----------------------------------------------------------
+    register_op(OpSpec(
+        name="matmul", fn=pt.matmul, ref=np.matmul,
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 4, 5)),
+        grad_wrt=(0, 1), rtol=2e-5, atol=2e-5))
+    register_op(OpSpec(
+        name="nn.functional.linear",
+        fn=lambda x, w, b: F.linear(x, w, b),
+        ref=lambda x, w, b: x @ w + b,
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 4, 5), _r(rng, 5)),
+        grad_wrt=(0, 1, 2), rtol=2e-5, atol=2e-5))
+
+    # -- activations (nn/functional ≙ phi activation kernels) -------------
+    unary("nn.functional.relu", F.relu, lambda x: np.maximum(x, 0))
+    unary("nn.functional.gelu", F.gelu,
+          lambda x: 0.5 * x * (1 + np.vectorize(_erf_scalar)(
+              x / np.sqrt(2.0))), rtol=2e-5, atol=2e-5)
+    unary("nn.functional.silu", F.silu,
+          lambda x: x / (1 + np.exp(-x)))
+    unary("nn.functional.softmax",
+          lambda x: F.softmax(x, axis=-1), _np_softmax)
+    unary("nn.functional.log_softmax",
+          lambda x: F.log_softmax(x, axis=-1),
+          lambda x: np.log(_np_softmax(x)))
+    unary("nn.functional.leaky_relu",
+          lambda x: F.leaky_relu(x, negative_slope=0.1),
+          lambda x: np.where(x >= 0, x, 0.1 * x))
+    unary("nn.functional.hardswish", F.hardswish,
+          lambda x: x * np.clip(x + 3, 0, 6) / 6, grad_rtol=2e-2,
+          grad_atol=2e-3)
+
+    # -- norm layers (functional form) ------------------------------------
+    register_op(OpSpec(
+        name="nn.functional.layer_norm",
+        fn=lambda x, w, b: F.layer_norm(x, (4,), weight=w, bias=b,
+                                        epsilon=1e-5),
+        ref=lambda x, w, b: _np_layer_norm(x, w, b, 1e-5),
+        sample=lambda rng: (_r(rng, 3, 4), _pos(rng, 4), _r(rng, 4)),
+        grad_wrt=(0, 1, 2), rtol=2e-5, atol=2e-5))
+
+    # -- losses -----------------------------------------------------------
+    register_op(OpSpec(
+        name="nn.functional.cross_entropy",
+        fn=lambda lg, lb: F.cross_entropy(lg, lb, reduction="mean"),
+        ref=lambda lg, lb: -np.mean(
+            np.log(_np_softmax(lg))[np.arange(lg.shape[0]), lb]),
+        sample=lambda rng: (_r(rng, 6, 10),
+                            rng.randint(0, 10, (6,)).astype(np.int32)),
+        grad_wrt=(0,), rtol=2e-5, atol=2e-5))
+    register_op(OpSpec(
+        name="nn.functional.mse_loss",
+        fn=lambda a, b: F.mse_loss(a, b),
+        ref=lambda a, b: np.mean((a - b) ** 2),
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)),
+        grad_wrt=(0, 1)))
+
+    # -- shape ops --------------------------------------------------------
+    register_op(OpSpec(
+        name="concat", fn=lambda a, b: pt.concat([a, b], axis=1),
+        ref=lambda a, b: np.concatenate([a, b], axis=1),
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 2)),
+        grad_wrt=(0, 1)))
+    register_op(OpSpec(
+        name="transpose", fn=lambda x: pt.transpose(x, (1, 0)),
+        ref=lambda x: x.T, sample=lambda rng: (_r(rng, 3, 4),)))
+    register_op(OpSpec(
+        name="reshape", fn=lambda x: pt.reshape(x, (4, 3)),
+        ref=lambda x: x.reshape(4, 3),
+        sample=lambda rng: (_r(rng, 3, 4),)))
+    register_op(OpSpec(
+        name="clip", fn=lambda x: pt.clip(x, -0.5, 0.5),
+        ref=lambda x: np.clip(x, -0.5, 0.5),
+        sample=lambda rng: (_r(rng, 3, 4),)))
+    register_op(OpSpec(
+        name="where", fn=lambda c, a, b: pt.where(c, a, b),
+        ref=np.where,
+        sample=lambda rng: (rng.rand(3, 4) > 0.5, _r(rng, 3, 4),
+                            _r(rng, 3, 4)),
+        grad_wrt=(1, 2)))
+    register_op(OpSpec(
+        name="gather",
+        fn=lambda x, i: pt.gather(x, i, axis=0),
+        ref=lambda x, i: np.take(x, i, axis=0),
+        sample=lambda rng: (_r(rng, 5, 4),
+                            rng.randint(0, 5, (3,)).astype(np.int32)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="cumsum", fn=lambda x: pt.cumsum(x, axis=1),
+        ref=lambda x: np.cumsum(x, axis=1),
+        sample=lambda rng: (_r(rng, 3, 4),)))
+
+
+def _erf_scalar(x: float) -> float:
+    import math
+    return math.erf(float(x))
+
+
+def _np_softmax(x):
+    e = np.exp(x - np.max(x, axis=-1, keepdims=True))
+    return e / np.sum(e, axis=-1, keepdims=True)
+
+
+def _np_layer_norm(x, w, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * w + b

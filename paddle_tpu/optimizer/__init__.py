@@ -442,3 +442,41 @@ class Lamb(Optimizer):
         u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         return p - lr * trust * update, {"moment1": m, "moment2": v}
+
+
+class Lars(Optimizer):
+    """LARS (reference operators/optimizers/lars_momentum_op.cc +
+    fleet lars meta-optimizer): momentum SGD with a layerwise-adaptive
+    learning rate — local_lr = lars_coeff * ||p|| / (||g|| + wd*||p|| + eps).
+    The large-batch ResNet optimizer."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=1e-9, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True):
+        apply_fn = None
+        if exclude_from_weight_decay_fn is not None:
+            apply_fn = lambda name: not exclude_from_weight_decay_fn(name)
+        super().__init__(learning_rate, parameters, lars_weight_decay,
+                         grad_clip, multi_precision,
+                         apply_decay_param_fun=apply_fn)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.epsilon = epsilon
+
+    def _init_slot(self, p):
+        return {"velocity": jnp.zeros_like(jnp.asarray(p), jnp.float32)}
+
+    def _update(self, g, p, slots, lr, step, wd):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.lars_coeff * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0)
+        v = (self.momentum * slots["velocity"]
+             + lr * local_lr * (g + wd * p))
+        return p - v, {"velocity": v}
+
+
+__all__.append("Lars")

@@ -1,0 +1,122 @@
+"""paddle.sparse parity (reference paddle/phi sparse kernels + python
+paddle.sparse API: SparseCooTensor/SparseCsrTensor, SURVEY C6).
+
+TPU-native substrate: jax.experimental.sparse.BCOO — XLA's batched-COO
+format with native lowering of sparse-dense matmul (the phi
+sparse_coo kernels' role).  CSR is represented by converting to BCOO at
+construction (TPU has no CSR-specific units; the format distinction is an
+API-compat concern, kept via ``.layout``)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseTensor",
+           "is_sparse", "add", "matmul", "masked_matmul", "relu", "to_dense"]
+
+
+class SparseTensor:
+    """Thin wrapper over BCOO carrying the paddle surface
+    (indices/values/to_dense/nnz; layout 'coo' or 'csr')."""
+
+    def __init__(self, bcoo: jsparse.BCOO, layout: str = "coo"):
+        self._bcoo = bcoo
+        self.layout = layout
+
+    # -- paddle surface ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self):
+        return self._bcoo.indices.T  # paddle: (ndim, nnz)
+
+    def values(self):
+        return self._bcoo.data
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return self._bcoo.todense()
+
+    def bcoo(self) -> jsparse.BCOO:
+        return self._bcoo
+
+    def __repr__(self):
+        return (f"SparseTensor(layout={self.layout}, shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape: Sequence[int],
+                      dtype=None) -> SparseTensor:
+    """paddle.sparse.sparse_coo_tensor(indices (ndim, nnz), values)."""
+    idx = jnp.asarray(indices).T.astype(jnp.int32)   # BCOO: (nnz, ndim)
+    vals = jnp.asarray(values, dtype)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)),
+                        layout="coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int],
+                      dtype=None) -> SparseTensor:
+    """paddle.sparse.sparse_csr_tensor — stored as BCOO internally."""
+    crows = np.asarray(crows)
+    cols = np.asarray(cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     jnp.asarray(cols, jnp.int32)], axis=1)
+    vals = jnp.asarray(values, dtype)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)),
+                        layout="csr")
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseTensor)
+
+
+def to_dense(x):
+    return x.to_dense() if is_sparse(x) else jnp.asarray(x)
+
+
+def add(a: SparseTensor, b: SparseTensor) -> SparseTensor:
+    summed = (a.bcoo() + b.bcoo()).sum_duplicates()
+    return SparseTensor(summed, layout=a.layout)
+
+
+def matmul(a, b):
+    """sparse @ dense (or dense @ sparse) → dense; the phi
+    sparse_coo matmul kernel's role, lowered by XLA from BCOO dot."""
+    if is_sparse(a):
+        return a.bcoo() @ jnp.asarray(b)
+    if is_sparse(b):
+        return jnp.asarray(a) @ b.bcoo()
+    return jnp.asarray(a) @ jnp.asarray(b)
+
+
+def masked_matmul(a, b, mask: SparseTensor) -> SparseTensor:
+    """(dense @ dense) sampled at mask's sparsity pattern (SDDMM;
+    reference sparse masked_matmul)."""
+    m = mask.bcoo()
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+    return SparseTensor(jsparse.BCOO((vals, m.indices), shape=m.shape),
+                        layout=mask.layout)
+
+
+def relu(x: SparseTensor) -> SparseTensor:
+    """Elementwise on the stored values (reference sparse relu kernel)."""
+    b = x.bcoo()
+    return SparseTensor(jsparse.BCOO((jnp.maximum(b.data, 0), b.indices),
+                                     shape=b.shape), layout=x.layout)

@@ -265,3 +265,41 @@ def test_missing_keys_strict():
     m = nn.Linear(2, 2)
     with pytest.raises(KeyError, match="missing"):
         m.set_state_dict({"weight": jnp.zeros((2, 2))})
+
+
+class TestLars:
+    def test_converges_on_quadratic(self):
+        import paddle_tpu as pt
+        w = jnp.asarray(np.random.RandomState(0).randn(8, 4), jnp.float32)
+        target = jnp.asarray(np.random.RandomState(1).randn(8, 4),
+                             jnp.float32)
+        opt = pt.optimizer.Lars(learning_rate=1.0, momentum=0.9,
+                                lars_coeff=0.002, lars_weight_decay=0.0)
+        params = {"w": w}
+        state = opt.init(params)
+        loss0 = float(jnp.sum((w - target) ** 2))
+        for _ in range(400):
+            grads = jax.grad(
+                lambda p: jnp.sum((p["w"] - target) ** 2))(params)
+            params, state = opt.apply_gradients(grads, params, state)
+        # LARS steps have magnitude ~ lars_coeff*||w|| (independent of the
+        # residual), so assert strong loss reduction, not tight convergence
+        loss = float(jnp.sum((params["w"] - target) ** 2))
+        assert loss < 0.02 * loss0, (loss0, loss)
+
+    def test_layerwise_trust_ratio_scales_update(self):
+        import paddle_tpu as pt
+        # two params, same gradient, very different norms → different
+        # effective lrs (the LARS property)
+        big = {"w": jnp.full((4,), 100.0)}
+        small = {"w": jnp.full((4,), 0.01)}
+        g = {"w": jnp.ones((4,))}
+        opt = pt.optimizer.Lars(learning_rate=1.0, momentum=0.0,
+                                lars_weight_decay=0.0)
+        sb = opt.init(big)
+        ss = opt.init(small)
+        nb, _ = opt.apply_gradients(g, big, sb)
+        ns, _ = opt.apply_gradients(g, small, ss)
+        step_big = float(jnp.abs(nb["w"] - big["w"])[0])
+        step_small = float(jnp.abs(ns["w"] - small["w"])[0])
+        assert step_big > 100 * step_small
