@@ -18,12 +18,14 @@ from . import autograd  # noqa: F401
 from . import framework  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
+from . import static  # noqa: F401
 from .framework import (CPUPlace, TPUPlace, get_device, load, save, seed,  # noqa: F401
                         set_device)
 from .framework.dtype import convert_dtype

@@ -1,0 +1,93 @@
+"""paddle.device parity: device introspection + memory stats (L0/C1).
+
+Reference: phi::Place/DeviceContext device identity plus the memory-stat
+surface (memory/stats.cc backing paddle.device.cuda.max_memory_allocated /
+memory_allocated / device_count / get_device_properties).
+
+TPU-native: device identity is jax.Device; memory numbers come from
+PJRT's per-device ``memory_stats()`` (bytes_in_use, peak_bytes_in_use,
+bytes_limit — XLA's allocator telemetry, the stats.cc analog).  The cuda.*
+names are aliased so ported monitoring code keeps working against the TPU.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from .framework.dtype import get_device, set_device  # noqa: F401
+
+__all__ = ["device_count", "get_all_devices", "get_device_properties",
+           "memory_stats", "memory_allocated", "max_memory_allocated",
+           "memory_reserved", "set_device", "get_device", "cuda", "tpu"]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def get_all_devices() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def _dev(device: Optional[int] = None) -> jax.Device:
+    # index the GLOBAL device list, consistent with device_count(); stats
+    # for a non-addressable device raise from PJRT with a clear message
+    devs = jax.devices()
+    i = 0 if device is None else int(device)
+    if not 0 <= i < len(devs):
+        raise IndexError(f"device index {i} out of range "
+                         f"[0, {len(devs)})")
+    return devs[i]
+
+
+def get_device_properties(device: Optional[int] = None) -> Dict[str, Any]:
+    d = _dev(device)
+    stats = memory_stats(device)
+    return {
+        "name": getattr(d, "device_kind", d.platform),
+        "platform": d.platform,
+        "id": d.id,
+        "process_index": d.process_index,
+        "total_memory": stats.get("bytes_limit", 0),
+        "coords": getattr(d, "coords", None),
+    }
+
+
+def memory_stats(device: Optional[int] = None) -> Dict[str, int]:
+    """Raw PJRT allocator stats (≙ memory/stats.cc registry)."""
+    d = _dev(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:  # backend without stats (CPU)
+        return {}
+
+
+def memory_allocated(device: Optional[int] = None) -> int:
+    """Live bytes on the device (paddle.device.cuda.memory_allocated)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device: Optional[int] = None) -> int:
+    """Peak live bytes (paddle.device.cuda.max_memory_allocated)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device: Optional[int] = None) -> int:
+    """Allocator pool size; PJRT reports the usable limit."""
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+class _Namespace:
+    """paddle.device.cuda / paddle.device.tpu alias namespaces."""
+
+    device_count = staticmethod(device_count)
+    memory_stats = staticmethod(memory_stats)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    get_device_properties = staticmethod(get_device_properties)
+
+
+cuda = _Namespace()   # source compat for ported monitoring code
+tpu = _Namespace()

@@ -1,0 +1,146 @@
+"""paddle.static migration facade (L7 static-graph surface).
+
+The reference maintains a whole second programming model — Program/Block
+IR, append_backward, four executors (SURVEY C19-C23).  This framework
+deliberately has ONE codepath: a jitted function IS the static program
+(SURVEY A13 records the justification).  This module keeps the static
+API's *shape* so ported scripts have landing points, with each symbol
+mapped to its one-codepath equivalent:
+
+- ``static.data`` / ``InputSpec``  → trace-time specs (feed declarations)
+- ``Program`` / ``program_guard`` / ``default_main_program`` → a Program
+  here is just a named scope holding a traced callable; building ops
+  imperatively inside the guard is not supported (write a function and
+  ``jit`` it — that's the static graph)
+- ``Executor.run(program, feed, fetch_list)`` → calls the program's
+  callable under jit with the feed dict
+- ``save_inference_model`` / ``load_inference_model`` → the jit.save /
+  jit.load StableHLO artifact
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+from ..jit import InputSpec
+
+__all__ = ["InputSpec", "data", "Program", "program_guard",
+           "default_main_program", "default_startup_program", "Executor",
+           "save_inference_model", "load_inference_model"]
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32"):
+    """Feed declaration (reference static.data) → InputSpec."""
+    return InputSpec(shape, dtype=dtype, name=name)
+
+
+class Program:
+    """A named scope for one traced callable (the one-codepath rendering of
+    ProgramDesc).  Set the callable with ``set_fn`` (signature
+    ``fn(**feed) -> output or dict``); Executor.run jits and runs it."""
+
+    def __init__(self, name: str = "main"):
+        self.name = name
+        self._fn: Optional[Callable] = None
+        self._jitted = None
+
+    def set_fn(self, fn: Callable) -> "Program":
+        self._fn = fn
+        self._jitted = jax.jit(lambda feed: fn(**feed))
+        return self
+
+    def run(self, feed: Dict[str, Any]):
+        enforce(self._fn is not None,
+                f"Program {self.name!r} has no function attached — build "
+                "static programs as python functions (Program.set_fn) and "
+                "jit compiles them; imperative op-building has no analog")
+        return self._jitted({k: jnp.asarray(np.asarray(v))
+                             for k, v in feed.items()})
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program(self.name)
+        p._fn, p._jitted = self._fn, self._jitted
+        return p
+
+
+_default_main = Program("main")
+_default_startup = Program("startup")
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    """Source-compat scope: temporarily makes ``main_program`` the default.
+    (Params initialize at Layer construction, so startup programs carry
+    nothing here.)"""
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev_m, prev_s
+
+
+class Executor:
+    """Reference static.Executor facade: ``run`` executes a Program's
+    callable; place selection is owned by jax (the device the arrays live
+    on), kept as an argument for source compat."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[List] = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        out = program.run(feed or {})
+        if isinstance(out, dict):
+            keys = fetch_list or list(out.keys())
+            outs = [out[k] for k in keys]
+        elif isinstance(out, (list, tuple)):
+            outs = list(out)
+        else:
+            outs = [out]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return list(outs)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         *, layer=None, input_spec=None, **kw):
+    """→ jit.save (the StableHLO artifact).  Pass the Layer via ``layer``
+    (the Program-IR route has no analog)."""
+    from .. import jit as pt_jit
+    enforce(layer is not None,
+            "save_inference_model on TPU exports a Layer: pass layer=<Layer>"
+            " and input_spec=[InputSpec...] (≙ jit.save)")
+    specs = input_spec if input_spec is not None else feed_vars
+    enforce(specs is not None,
+            "save_inference_model needs input specs: pass "
+            "input_spec=[InputSpec...] (or feed_vars from static.data)")
+    pt_jit.save(layer, path_prefix, input_spec=list(specs))
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    from .. import jit as pt_jit
+    loaded = pt_jit.load(path_prefix)
+    feed_names = [s.name or f"input_{i}"
+                  for i, s in enumerate(loaded.input_spec)]
+    return loaded, feed_names, None

@@ -112,3 +112,49 @@ class TestSparse:
         want = np.zeros((2, 2), np.float32)
         want[0, 1], want[1, 0] = full[0, 1], full[1, 0]
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+class TestDevice:
+    def test_introspection(self):
+        import paddle_tpu.device as device
+        assert device.device_count() >= 1
+        props = device.get_device_properties()
+        assert "platform" in props and props["id"] == 0
+        # CPU backend: stats may be empty; the calls must not raise
+        assert device.memory_allocated() >= 0
+        assert device.cuda.max_memory_allocated() >= 0
+
+
+class TestStaticFacade:
+    def test_program_executor_roundtrip(self):
+        import paddle_tpu.static as static
+        prog = static.Program("toy").set_fn(
+            lambda x, y: {"z": x @ y})
+        exe = static.Executor()
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        y = np.random.RandomState(1).randn(3, 2).astype(np.float32)
+        (z,) = exe.run(prog, feed={"x": x, "y": y}, fetch_list=["z"])
+        np.testing.assert_allclose(z, x @ y, rtol=1e-5)
+
+    def test_program_guard_swaps_default(self):
+        import paddle_tpu.static as static
+        p = static.Program("alt")
+        with static.program_guard(p):
+            assert static.default_main_program() is p
+        assert static.default_main_program() is not p
+
+    def test_save_load_inference_model(self, tmp_path):
+        import paddle_tpu.static as static
+        from paddle_tpu import nn
+        pt.seed(0)
+        model = nn.Sequential(nn.Linear(4, 2))
+        model.eval()
+        spec = [static.data("x", (2, 4))]
+        path = str(tmp_path / "static_export")
+        static.save_inference_model(path, spec, None, None, layer=model,
+                                    input_spec=spec)
+        loaded, feeds, _ = static.load_inference_model(path)
+        assert feeds == ["x"]
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(loaded(x)),
+                                   np.asarray(model(x)), rtol=1e-6)
