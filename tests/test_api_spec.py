@@ -1,0 +1,27 @@
+"""API-compat guard (≙ the reference's API.spec + check_api_compatible.py
+CI gate): the live public-API signatures must match the committed spec, so
+every API change is an explicit, reviewed event — regenerate with
+``python tools/print_signatures.py --update``."""
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_public_api_matches_spec():
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "print_signatures.py")],
+        capture_output=True, text=True, timeout=240, cwd=_ROOT)
+    assert out.returncode == 0, out.stderr
+    live = out.stdout.splitlines()
+    with open(os.path.join(_ROOT, "API.spec")) as f:
+        spec = f.read().splitlines()
+    added = sorted(set(live) - set(spec))
+    removed = sorted(set(spec) - set(live))
+    assert not added and not removed, (
+        "public API drifted from API.spec — regenerate with "
+        "`python tools/print_signatures.py --update` and review:\n"
+        + "\n".join(f"+ {l}" for l in added[:10])
+        + "\n".join(f"- {l}" for l in removed[:10]))

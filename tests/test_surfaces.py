@@ -158,3 +158,42 @@ class TestStaticFacade:
         x = jnp.asarray(np.random.RandomState(2).randn(2, 4), jnp.float32)
         np.testing.assert_allclose(np.asarray(loaded(x)),
                                    np.asarray(model(x)), rtol=1e-6)
+
+
+class TestViterbi:
+    def _np_viterbi(self, pot, trans, length, bos_eos):
+        # brute force over all paths
+        import itertools
+        T, N = pot.shape
+        n_tags = N - 2 if bos_eos else N
+        best, best_path = -1e30, None
+        for path in itertools.product(range(n_tags), repeat=length):
+            s = pot[0, path[0]]
+            if bos_eos:
+                s += trans[N - 2, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+            if bos_eos:
+                s += trans[path[-1], N - 1]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_bruteforce(self, bos_eos):
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 5, 6
+        pot = rng.randn(B, T, N).astype(np.float32)
+        if bos_eos:
+            pot[:, :, -2:] = -1e4  # emissions never pick BOS/EOS tags
+        trans = rng.randn(N, N).astype(np.float32)
+        lengths = np.asarray([5, 3, 4], np.int32)
+        scores, paths = viterbi_decode(pot, trans, lengths,
+                                       include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            want_s, want_p = self._np_viterbi(pot[b], trans,
+                                              int(lengths[b]), bos_eos)
+            np.testing.assert_allclose(float(scores[b]), want_s, rtol=1e-5)
+            got = list(np.asarray(paths[b][: lengths[b]]))
+            assert got == want_p, (b, got, want_p)

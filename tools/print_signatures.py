@@ -1,0 +1,108 @@
+"""Public-API signature inventory (component E10).
+
+Reference: tools/print_signatures.py + paddle/fluid/API.spec — CI hashes
+every public signature and diffs against the committed spec so API breaks
+are explicit, reviewed events (tools/check_api_compatible.py).
+
+Usage:
+  python tools/print_signatures.py            # print current spec
+  python tools/print_signatures.py --update   # rewrite API.spec
+
+tests/test_api_spec.py diffs the live spec against the committed file.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+SPEC_PATH = os.path.join(ROOT, "API.spec")
+
+# the public surface: (module, recurse-into-classes)
+_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr",
+    "paddle_tpu.amp",
+    "paddle_tpu.autograd",
+    "paddle_tpu.io",
+    "paddle_tpu.metric",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.vision.models",
+    "paddle_tpu.models",
+    "paddle_tpu.hapi",
+    "paddle_tpu.profiler",
+    "paddle_tpu.jit",
+    "paddle_tpu.inference",
+    "paddle_tpu.static",
+    "paddle_tpu.sparse",
+    "paddle_tpu.fft",
+    "paddle_tpu.distribution",
+    "paddle_tpu.device",
+    "paddle_tpu.text",
+]
+
+
+def _sig(obj) -> str:
+    import re
+    try:
+        s = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # object reprs carry memory addresses — strip for determinism
+    return re.sub(r" at 0x[0-9a-f]+", "", s)
+
+
+def collect() -> list[str]:
+    # the virtual CPU mesh keeps collection deterministic and TPU-free
+    from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
+    force_virtual_cpu_mesh(1)
+    lines = []
+    for modname in _MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        names = getattr(mod, "__all__", None) or [
+            n for n in vars(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{modname}.{name} class{_sig(obj)}")
+                for mname, m in sorted(vars(obj).items()):
+                    if mname.startswith("_") and mname != "__init__":
+                        continue
+                    if callable(m):
+                        lines.append(
+                            f"{modname}.{name}.{mname} {_sig(m)}")
+            elif callable(obj):
+                lines.append(f"{modname}.{name} {_sig(obj)}")
+    return lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    args = ap.parse_args()
+    spec = "\n".join(collect()) + "\n"
+    if args.update:
+        with open(SPEC_PATH, "w") as f:
+            f.write(spec)
+        print(f"wrote {SPEC_PATH} ({spec.count(chr(10))} entries)")
+        return 0
+    sys.stdout.write(spec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
