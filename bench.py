@@ -163,11 +163,23 @@ def main():
     from paddle_tpu.models import gpt_125m, gpt_tiny
 
     if on_tpu:
-        cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
-                       attention_dropout=0.0, use_pallas_attention=True,
-                       max_position_embeddings=2048)
-        tok_s, mfu = _bench_config(cfg, B=8, S=2048, steps=10, warmup=3,
-                                   tag="gpt-125m")
+        try:
+            cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
+                           attention_dropout=0.0, use_pallas_attention=True,
+                           max_position_embeddings=2048)
+            tok_s, mfu = _bench_config(cfg, B=8, S=2048, steps=10, warmup=3,
+                                       tag="gpt-125m-flash")
+        except Exception as e:
+            # the headline number must survive a kernel regression: fall
+            # back to the XLA attention path and say so
+            print(f"[flash path failed: {e!r}] falling back to XLA "
+                  f"attention", file=sys.stderr)
+            cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
+                           attention_dropout=0.0,
+                           use_pallas_attention=False,
+                           max_position_embeddings=2048)
+            tok_s, mfu = _bench_config(cfg, B=8, S=2048, steps=10,
+                                       warmup=3, tag="gpt-125m-xla")
         if os.environ.get("BENCH_SKIP_SLICE", "0") != "1":
             try:
                 _bench_1p3b_slice()

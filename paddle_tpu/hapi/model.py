@@ -7,7 +7,6 @@ dygraph/static execution paths.
 """
 from __future__ import annotations
 
-import time
 from typing import List, Optional
 
 import jax
@@ -50,9 +49,12 @@ class Model:
         net, opt, loss_fn = self.network, self._optimizer, self._loss
         amp_level = self._amp_level
 
-        def train_step(trainable, rest, opt_state, key, *data):
+        def train_step(trainable, rest, opt_state, key, lr_override, *data):
             """Differentiate w.r.t. trainable params only; buffers (`rest`)
-            flow through mutable apply."""
+            flow through mutable apply.  ``lr_override``: traced scalar (or
+            None) — set when the optimizer's lr is a stateful LRScheduler,
+            whose .step() the LRScheduler callback drives (paddle
+            convention)."""
             *inputs, label = data
 
             def compute_loss(tp):
@@ -70,7 +72,7 @@ class Model:
             (loss_v, (out, new_vars)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(trainable)
             new_trainable, new_opt_state = opt.apply_gradients(
-                grads, trainable, opt_state)
+                grads, trainable, opt_state, lr=lr_override)
             merged = dict(new_vars)
             merged.update(new_trainable)
             # always traced (a few fused scalar reductions, ≙ the
@@ -103,8 +105,16 @@ class Model:
         data = [jnp.asarray(np.asarray(x)) for x in
                 (*_tuplify(inputs), *_tuplify(labels))]
         key = fw_random.next_key()
+        from ..optimizer import lr as lr_mod
+        lr_override = None
+        if isinstance(getattr(self._optimizer, "_lr", None),
+                      lr_mod.LRScheduler):
+            # stateful scheduler: the current value applies until someone
+            # (the LRScheduler callback, or the user) calls .step()
+            lr_override = jnp.asarray(self._optimizer._lr.get_lr(),
+                                      jnp.float32)
         loss, out, new_params, self._opt_state, finite = self._train_step(
-            trainable, rest, self._opt_state, key, *data)
+            trainable, rest, self._opt_state, key, lr_override, *data)
         if debug.check_nan_inf_enabled():
             debug.assert_all_finite(finite, context="train_batch")
         self.network.set_state_dict(new_params, strict=False)
@@ -133,34 +143,66 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir: Optional[str] = None, shuffle: bool = True,
-            num_workers: int = 0, verbose: int = 1, drop_last: bool = False):
+            num_workers: int = 0, verbose: int = 1, drop_last: bool = False,
+            callbacks=None):
+        from ..optimizer import lr as lr_mod
+        from .callbacks import (CallbackList, LRScheduler as LRSchedulerCB,
+                                ModelCheckpoint, ProgBarLogger)
         if not isinstance(train_data, DataLoader):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
                                       num_workers=num_workers)
         else:
             train_loader = train_data
+        cbs = CallbackList(list(callbacks or []))
+        if not any(isinstance(c, ProgBarLogger) for c in cbs.callbacks):
+            cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        if save_dir and not any(isinstance(c, ModelCheckpoint)
+                                for c in cbs.callbacks):
+            cbs.append(ModelCheckpoint(save_dir=save_dir))
+        if (isinstance(getattr(self._optimizer, "_lr", None),
+                       lr_mod.LRScheduler)
+                and not any(isinstance(c, LRSchedulerCB)
+                            for c in cbs.callbacks)):
+            # paddle convention: fit drives per-step scheduling by default
+            cbs.append(LRSchedulerCB(by_step=True))
+        cbs.set_model(self)
+        cbs.set_params({"epochs": epochs, "batch_size": batch_size,
+                        "verbose": verbose, "save_dir": save_dir})
+        self.stop_training = False
         history = {"loss": []}
+        cbs.on_train_begin()
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
-            t0 = time.time()
+            cbs.on_epoch_begin(epoch)
+            epoch_losses = []
             for step, batch in enumerate(train_loader):
+                cbs.on_train_batch_begin(step)
                 *inputs, label = batch
                 loss, metrics = self.train_batch(inputs, label)
                 history["loss"].append(loss)
-                if verbose and step % log_freq == 0:
-                    m_str = " ".join(
-                        f"{m.name()}: {v if not isinstance(v, list) else v[0]:.4f}"
-                        for m, v in zip(self._metrics, metrics))
-                    print(f"Epoch {epoch+1}/{epochs} step {step} "
-                          f"loss: {loss:.4f} {m_str}")
-            if verbose:
-                print(f"Epoch {epoch+1} done in {time.time()-t0:.1f}s")
+                epoch_losses.append(loss)
+                logs = {"loss": loss}
+                for m, v in zip(self._metrics, metrics):
+                    logs[m.name()] = v[0] if isinstance(v, list) else v
+                cbs.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            epoch_logs = {"loss": float(np.mean(epoch_losses))
+                          if epoch_losses else float("nan")}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
-            if save_dir:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                cbs.on_eval_begin()
+                eval_res = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=verbose)
+                cbs.on_eval_end(eval_res)
+                # eval metrics reach on_epoch_end (EarlyStopping monitors)
+                epoch_logs.update({f"eval_{k}" if k == "loss" else k: v
+                                   for k, v in eval_res.items()})
+            cbs.on_epoch_end(epoch, epoch_logs)
+            if self.stop_training:
+                break
+        cbs.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,

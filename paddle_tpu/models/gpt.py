@@ -403,11 +403,14 @@ class GPTForCausalLM(Layer):
             nxt, caches = step(params, nxt[:, None], caches,
                                jnp.asarray(prompt_len + i - 1, jnp.int32),
                                sub)
-            out.append(nxt[:, None])
             if eos_token_id is not None:
+                # finished rows stay pinned to EOS (reference generate pads
+                # completed sequences instead of sampling garbage)
+                nxt = jnp.where(jnp.asarray(finished), eos_token_id, nxt)
                 finished = finished | np.asarray(nxt == eos_token_id)
-                if bool(np.all(finished)):
-                    break
+            out.append(nxt[:, None])
+            if eos_token_id is not None and bool(np.all(finished)):
+                break
         return jnp.concatenate(out, axis=1)
 
     def _gen_step(self, temperature: float, top_k: int):
@@ -430,7 +433,7 @@ class GPTForCausalLM(Layer):
             else:
                 scaled = logits / temperature
                 if top_k > 0:
-                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
                     scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
                 nxt = jax.random.categorical(k, scaled, axis=-1)
             return nxt.astype(jnp.int32), new_caches

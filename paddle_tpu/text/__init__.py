@@ -10,9 +10,6 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import numpy as np
-
-import jax
 import jax.numpy as jnp
 from jax import lax
 

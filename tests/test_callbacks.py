@@ -1,0 +1,133 @@
+"""hapi callbacks tests (≙ reference test_callbacks.py doctrine)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.hapi import (Callback, EarlyStopping, LRScheduler, Model,
+                             ModelCheckpoint, ProgBarLogger)
+
+
+def _toy_model():
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model = Model(net)
+    model.prepare(optimizer=pt.optimizer.Adam(learning_rate=1e-2),
+                  loss=lambda out, y: jnp.mean(
+                      pt.nn.functional.cross_entropy(out, y)))
+    return model
+
+
+def _toy_data(n=32):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    from paddle_tpu.io import TensorDataset
+    return TensorDataset([x, y])
+
+
+class TestCallbacks:
+    def test_hooks_fire_in_order(self):
+        events = []
+
+        class Recorder(Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(f"epoch_begin{epoch}")
+
+            def on_train_batch_end(self, step, logs=None):
+                events.append("batch")
+
+            def on_epoch_end(self, epoch, logs=None):
+                events.append(f"epoch_end{epoch}")
+                assert "loss" in (logs or {})
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        model = _toy_model()
+        model.fit(_toy_data(), batch_size=16, epochs=2, verbose=0,
+                  callbacks=[Recorder()])
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert events.count("epoch_begin0") == 1
+        assert events.count("batch") == 4  # 2 epochs x 2 steps
+
+    def test_early_stopping_stops(self):
+        class Worsen(Callback):
+            """Force a non-improving metric by rewriting logs."""
+
+        model = _toy_model()
+        es = EarlyStopping(monitor="loss", patience=0, baseline=0.0,
+                           mode="min")
+        model.fit(_toy_data(), batch_size=16, epochs=10, verbose=0,
+                  callbacks=[es])
+        # loss never beats baseline 0.0 → stops after epoch 0 (patience 0)
+        assert es.stopped_epoch == 0
+        assert model.stop_training
+
+    def test_model_checkpoint_saves(self, tmp_path):
+        model = _toy_model()
+        model.fit(_toy_data(), batch_size=16, epochs=2, verbose=0,
+                  callbacks=[ModelCheckpoint(save_freq=1,
+                                             save_dir=str(tmp_path))])
+        assert os.path.exists(str(tmp_path / "epoch_0.pdparams")) or \
+            os.path.exists(str(tmp_path / "epoch_0"))
+
+    def test_lr_scheduler_callback_changes_applied_lr(self):
+        """The scheduled lr must reach the actual update, not just the
+        scheduler's bookkeeping: with loss = mean(w) the SGD step size IS
+        the applied lr (grad = 1/numel elementwise, scaled back up)."""
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(1, 1, bias_attr=False))
+        model = Model(net)
+        sched = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+        model.prepare(optimizer=pt.optimizer.SGD(learning_rate=sched),
+                      loss=lambda out, y: jnp.sum(out))
+        from paddle_tpu.io import TensorDataset
+        x = np.ones((2, 1), np.float32)
+        ds = TensorDataset([x, x.copy()])
+        w = [float(net[0].weight.value[0, 0])]
+
+        class Track(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                w.append(float(net[0].weight.value[0, 0]))
+
+        # fit auto-appends the by_step LRScheduler callback
+        model.fit(ds, batch_size=1, epochs=1, shuffle=False, verbose=0,
+                  callbacks=[Track()])
+        # d(loss)/dw = sum over batch of x = 1 per sample (batch 1)
+        step1, step2 = w[0] - w[1], w[1] - w[2]
+        np.testing.assert_allclose(step1, 0.1, rtol=1e-5)
+        np.testing.assert_allclose(step2, 0.05, rtol=1e-5)
+        assert sched.last_epoch >= 1
+
+    def test_eval_metrics_reach_epoch_end(self):
+        from paddle_tpu.metric import Accuracy
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(4, 2))
+        model = Model(net)
+        model.prepare(optimizer=pt.optimizer.Adam(learning_rate=1e-2),
+                      loss=lambda out, y: jnp.mean(
+                          pt.nn.functional.cross_entropy(out, y)),
+                      metrics=Accuracy())
+        seen = {}
+
+        class Grab(Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                seen.update(logs or {})
+
+            def on_eval_end(self, logs=None):
+                seen["eval_end_fired"] = True
+
+        model.fit(_toy_data(), eval_data=_toy_data(), batch_size=16,
+                  epochs=1, verbose=0, callbacks=[Grab()])
+        assert seen.get("eval_end_fired")
+        assert "eval_loss" in seen and "acc" in seen
