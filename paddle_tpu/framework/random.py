@@ -20,16 +20,20 @@ _state = threading.local()
 
 
 class Generator:
-    """Stateful key stream over jax.random (dygraph Generator analog)."""
+    """Stateful key stream over jax.random (dygraph Generator analog).
+
+    The stream is base-key + python counter (``fold_in(key(seed), n)``), NOT
+    split-and-store: under jit's trace context even ops on concrete keys
+    return tracers, and storing one back into global state poisons every
+    later eager call (UnexpectedTracerError).  With fold_in the only mutable
+    state is a python int, which is always trace-safe."""
 
     def __init__(self, seed: int = 0):
-        self._seed = seed
-        self._key = jax.random.key(seed)
-        self._count = 0
+        self.manual_seed(seed)
 
     def manual_seed(self, seed: int) -> "Generator":
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._base = jax.random.key(seed)
         self._count = 0
         return self
 
@@ -38,19 +42,21 @@ class Generator:
         return self._seed
 
     def next_key(self) -> jax.Array:
-        """Split off a fresh key (advances the stream)."""
-        self._key, sub = jax.random.split(self._key)
+        """Fresh key (advances the stream).
+
+        Safe to call inside a jit trace, but the drawn key is then baked
+        into the compiled program as a constant — stochastic ops in a jitted
+        step should thread keys via ``key_scope`` instead (the jitted-path
+        contract; see module docstring)."""
         self._count += 1
-        return sub
+        return jax.random.fold_in(self._base, self._count)
 
     def get_state(self):
         return (self._seed, self._count)
 
     def set_state(self, state):
-        seed, count = state
-        self.manual_seed(seed)
-        for _ in range(count):
-            self.next_key()
+        self._seed, self._count = state
+        self._base = jax.random.key(self._seed)
 
 
 def default_generator() -> Generator:

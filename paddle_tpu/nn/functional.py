@@ -13,6 +13,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..amp import state as amp_state
@@ -198,6 +199,20 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
     return summed / counts
 
 
+def _adaptive_avg_matrix(in_size: int, out_size: int):
+    """(out, in) row-stochastic averaging matrix for one spatial axis.
+
+    Bin i covers [floor(i*in/out), ceil((i+1)*in/out)) — torch/paddle
+    adaptive-pool semantics.  Built with numpy at trace time (static
+    shapes), so the general case lowers to two MXU matmuls."""
+    m = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        start = (i * in_size) // out_size
+        end = -(-((i + 1) * in_size) // out_size)
+        m[i, start:end] = 1.0 / (end - start)
+    return m
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     x = _arr(x)
     out_h, out_w = _pair(output_size)
@@ -205,11 +220,15 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
         in_h, in_w = x.shape[2], x.shape[3]
     else:
         in_h, in_w = x.shape[1], x.shape[2]
-    enforce(in_h % out_h == 0 and in_w % out_w == 0,
-            "adaptive pool requires divisible sizes in this build")
-    return avg_pool2d(x, (in_h // out_h, in_w // out_w),
-                      stride=(in_h // out_h, in_w // out_w),
-                      data_format=data_format)
+    if in_h % out_h == 0 and in_w % out_w == 0:  # fast reduce_window path
+        return avg_pool2d(x, (in_h // out_h, in_w // out_w),
+                          stride=(in_h // out_h, in_w // out_w),
+                          data_format=data_format)
+    mh = jnp.asarray(_adaptive_avg_matrix(in_h, out_h), x.dtype)
+    mw = jnp.asarray(_adaptive_avg_matrix(in_w, out_w), x.dtype)
+    if data_format == "NCHW":
+        return jnp.einsum("oh,nchw,pw->ncop", mh, x, mw)
+    return jnp.einsum("oh,nhwc,pw->nopc", mh, x, mw)
 
 
 # ---------------------------------------------------------------------------

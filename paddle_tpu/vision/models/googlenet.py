@@ -1,0 +1,114 @@
+"""GoogLeNet / Inception-v1 (reference API:
+python/paddle/vision/models/googlenet.py:1 — class GoogLeNet, googlenet;
+forward returns (main, aux1, aux2) like the reference).
+
+Inception module = four parallel towers (1x1 / 1x1→3x3 / 1x1→5x5 /
+pool→1x1) concatenated on channels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...nn.layers import (AdaptiveAvgPool2D, Conv2D, Dropout, Linear,
+                          MaxPool2D)
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _Conv(Layer):
+    def __init__(self, in_ch: int, out_ch: int, kernel: int, stride: int = 1,
+                 padding: int = 0):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding)
+
+    def forward(self, x):
+        return F.relu(self.conv(x))
+
+
+class Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.t1 = _Conv(in_ch, c1, 1)
+        self.t2a = _Conv(in_ch, c3r, 1)
+        self.t2b = _Conv(c3r, c3, 3, padding=1)
+        self.t3a = _Conv(in_ch, c5r, 1)
+        self.t3b = _Conv(c5r, c5, 5, padding=2)
+        self.pool = MaxPool2D(3, stride=1, padding=1)
+        self.t4 = _Conv(in_ch, proj, 1)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.t1(x), self.t2b(self.t2a(x)), self.t3b(self.t3a(x)),
+             self.t4(self.pool(x))], axis=1)
+
+
+class _AuxHead(Layer):
+    def __init__(self, in_ch: int, num_classes: int):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D((4, 4))
+        self.conv = _Conv(in_ch, 128, 1)
+        self.fc1 = Linear(128 * 4 * 4, 1024)
+        self.drop = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = F.relu(self.fc1(F.flatten(x, 1)))
+        return self.fc2(self.drop(x))
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = _Conv(3, 64, 7, stride=2, padding=3)
+        self.pool1 = MaxPool2D(3, stride=2, padding=1)
+        self.conv2 = _Conv(64, 64, 1)
+        self.conv3 = _Conv(64, 192, 3, padding=1)
+        self.pool2 = MaxPool2D(3, stride=2, padding=1)
+
+        self.ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.drop = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool2(self.conv3(self.conv2(x)))
+        x = self.ince3b(self.ince3a(x))
+        x = self.ince4a(self.pool3(x))
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.ince4d(self.ince4c(self.ince4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.ince4e(x))
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(F.flatten(x, 1)))
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(**kw) -> GoogLeNet:
+    return GoogLeNet(**kw)

@@ -1,0 +1,79 @@
+"""MobileNetV1 (reference API: python/paddle/vision/models/mobilenetv1.py:1
+— class MobileNetV1(scale), mobilenet_v1).
+
+Depthwise-separable stack: 3x3 depthwise (groups=channels) + 1x1 pointwise,
+each conv-BN-ReLU.  TPU note: depthwise convs are VPU-bound, the 1x1
+pointwise convs carry the MXU FLOPs — widths stay multiples of 32.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer import Layer, Sequential
+from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear,
+                          ReLU)
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_ch: int, out_ch: int, kernel: int, stride: int = 1,
+                 groups: int = 1):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=(kernel - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, in_ch: int, out_ch: int, stride: int):
+        super().__init__()
+        self.depthwise = ConvBNLayer(in_ch, in_ch, 3, stride, groups=in_ch)
+        self.pointwise = ConvBNLayer(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+# (out_channels, stride) per depthwise-separable block at scale=1.0
+_BLOCKS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+           (1024, 1)]
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch: int) -> int:
+            return max(8, int(ch * scale))
+
+        layers = [ConvBNLayer(3, c(32), 3, stride=2)]
+        in_ch = c(32)
+        for out, stride in _BLOCKS:
+            layers.append(DepthwiseSeparable(in_ch, c(out), stride))
+            in_ch = c(out)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(in_ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(F.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(scale: float = 1.0, **kw) -> MobileNetV1:
+    return MobileNetV1(scale=scale, **kw)

@@ -1,0 +1,95 @@
+"""MobileNetV2 (reference API: python/paddle/vision/models/mobilenetv2.py:1
+— class MobileNetV2(scale), mobilenet_v2).
+
+Inverted residual: 1x1 expand → 3x3 depthwise → 1x1 linear project, with a
+residual add when stride==1 and channels match.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer import Layer, Sequential
+from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                          Linear)
+
+__all__ = ["MobileNetV2", "mobilenet_v2"]
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:  # never round down by more than 10%
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU6(Layer):
+    def __init__(self, in_ch: int, out_ch: int, kernel: int = 3,
+                 stride: int = 1, groups: int = 1):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=(kernel - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        return F.relu6(self.bn(self.conv(x)))
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_ch: int, out_ch: int, stride: int, expand: int):
+        super().__init__()
+        hidden = int(round(in_ch * expand))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand != 1:
+            layers.append(ConvBNReLU6(in_ch, hidden, 1))
+        layers.append(ConvBNReLU6(hidden, hidden, 3, stride, groups=hidden))
+        self.body = Sequential(*layers)
+        self.project = Conv2D(hidden, out_ch, 1, bias_attr=False)
+        self.project_bn = BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        out = self.project_bn(self.project(self.body(x)))
+        return x + out if self.use_res else out
+
+
+# (expand_ratio, out_channels, repeats, first_stride) at scale=1.0
+_SETTINGS = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        in_ch = _make_divisible(32 * scale)
+        last_ch = _make_divisible(1280 * max(1.0, scale))
+        layers = [ConvBNReLU6(3, in_ch, 3, stride=2)]
+        for t, c, n, s in _SETTINGS:
+            out_ch = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(InvertedResidual(
+                    in_ch, out_ch, s if i == 0 else 1, t))
+                in_ch = out_ch
+        layers.append(ConvBNReLU6(in_ch, last_ch, 1))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(last_ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(F.flatten(x, 1)))
+        return x
+
+
+def mobilenet_v2(scale: float = 1.0, **kw) -> MobileNetV2:
+    return MobileNetV2(scale=scale, **kw)

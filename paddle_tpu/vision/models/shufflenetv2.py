@@ -1,0 +1,161 @@
+"""ShuffleNetV2 (reference API: python/paddle/vision/models/shufflenetv2.py:1
+— class ShuffleNetV2(scale, act), shuffle_net_v2_x0_25 … x2_0 + swish).
+
+Channel split → (identity ‖ dw-separable branch) → concat → channel
+shuffle.  The shuffle is a reshape/transpose pair — free for XLA (layout
+change only, usually fused away).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer import Layer, Sequential
+from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear,
+                          MaxPool2D)
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups: int):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)
+
+
+def _act(x, act: str):
+    return F.silu(x) if act == "swish" else F.relu(x)
+
+
+class ConvBN(Layer):
+    def __init__(self, in_ch: int, out_ch: int, kernel: int, stride: int = 1,
+                 groups: int = 1):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=(kernel - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class ShuffleUnit(Layer):
+    """stride=1 unit: split in half, transform one half, concat+shuffle."""
+
+    def __init__(self, ch: int, act: str):
+        super().__init__()
+        branch = ch // 2
+        self.pw1 = ConvBN(branch, branch, 1)
+        self.dw = ConvBN(branch, branch, 3, groups=branch)
+        self.pw2 = ConvBN(branch, branch, 1)
+        self.act = act
+
+    def forward(self, x):
+        half = x.shape[1] // 2
+        x1, x2 = x[:, :half], x[:, half:]
+        x2 = _act(self.pw1(x2), self.act)
+        x2 = self.dw(x2)
+        x2 = _act(self.pw2(x2), self.act)
+        return channel_shuffle(jnp.concatenate([x1, x2], axis=1), 2)
+
+
+class ShuffleDownUnit(Layer):
+    """stride=2 unit: both branches transform and downsample."""
+
+    def __init__(self, in_ch: int, out_ch: int, act: str):
+        super().__init__()
+        branch = out_ch // 2
+        self.left_dw = ConvBN(in_ch, in_ch, 3, stride=2, groups=in_ch)
+        self.left_pw = ConvBN(in_ch, branch, 1)
+        self.right_pw1 = ConvBN(in_ch, branch, 1)
+        self.right_dw = ConvBN(branch, branch, 3, stride=2, groups=branch)
+        self.right_pw2 = ConvBN(branch, branch, 1)
+        self.act = act
+
+    def forward(self, x):
+        left = _act(self.left_pw(self.left_dw(x)), self.act)
+        right = _act(self.right_pw1(x), self.act)
+        right = self.right_dw(right)
+        right = _act(self.right_pw2(right), self.act)
+        return channel_shuffle(jnp.concatenate([left, right], axis=1), 2)
+
+
+_STAGE_REPEATS = [4, 8, 4]
+_STAGE_CHANNELS = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        if scale not in _STAGE_CHANNELS:
+            raise ValueError(f"unsupported ShuffleNetV2 scale {scale}")
+        chans = _STAGE_CHANNELS[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvBN(3, chans[0], 3, stride=2)
+        self.act_name = act
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages: List[Layer] = []
+        in_ch = chans[0]
+        for stage_i, repeats in enumerate(_STAGE_REPEATS):
+            out_ch = chans[stage_i + 1]
+            units: List[Layer] = [ShuffleDownUnit(in_ch, out_ch, act)]
+            units += [ShuffleUnit(out_ch, act) for _ in range(repeats - 1)]
+            stages.append(Sequential(*units))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.conv_last = ConvBN(in_ch, chans[4], 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(chans[4], num_classes)
+
+    def forward(self, x):
+        x = _act(self.conv1(x), self.act_name)
+        x = self.stages(self.maxpool(x))
+        x = _act(self.conv_last(x), self.act_name)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(F.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(**kw) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)

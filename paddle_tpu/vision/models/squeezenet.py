@@ -1,0 +1,86 @@
+"""SqueezeNet (reference API: python/paddle/vision/models/squeezenet.py:1
+— class SqueezeNet with version "1.0"/"1.1", squeezenet1_0/1_1).
+
+Fire module = squeeze 1x1 → parallel expand 1x1 / expand 3x3 → channel
+concat; final classifier is a 1x1 conv + global average pool.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer import Layer, Sequential
+from ...nn.layers import (AdaptiveAvgPool2D, Conv2D, Dropout, MaxPool2D,
+                          ReLU)
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(Layer):
+    def __init__(self, in_ch: int, squeeze: int, expand1x1: int,
+                 expand3x3: int):
+        super().__init__()
+        self.squeeze = Conv2D(in_ch, squeeze, 1)
+        self.expand1x1 = Conv2D(squeeze, expand1x1, 1)
+        self.expand3x3 = Conv2D(squeeze, expand3x3, 3, padding=1)
+
+    def forward(self, x):
+        x = F.relu(self.squeeze(x))
+        return jnp.concatenate(
+            [F.relu(self.expand1x1(x)), F.relu(self.expand3x3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"unsupported SqueezeNet version {version!r}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2),
+                Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier_drop = Dropout(0.5)
+            self.classifier_conv = Conv2D(512, num_classes, 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = F.relu(self.classifier_conv(self.classifier_drop(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = F.flatten(x, 1)
+        return x
+
+
+def squeezenet1_0(**kw) -> SqueezeNet:
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw) -> SqueezeNet:
+    return SqueezeNet("1.1", **kw)

@@ -1,0 +1,86 @@
+"""VGG family (reference API: python/paddle/vision/models/vgg.py:1 —
+class VGG + vgg11/13/16/19 constructors with a batch_norm knob).
+
+TPU note: all channel widths are multiples of 64, so every conv tiles the
+MXU cleanly; BN folds into the conv at inference via XLA fusion.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ...nn import functional as F
+from ...nn.layer import Layer, Sequential
+from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                          Linear, MaxPool2D, ReLU)
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_features(cfg: List, batch_norm: bool) -> Sequential:
+    layers: List[Layer] = []
+    in_ch = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, stride=2))
+        else:
+            layers.append(Conv2D(in_ch, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            in_ch = v
+    return Sequential(*layers)
+
+
+class VGG(Layer):
+    def __init__(self, features: Layer, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096), ReLU(), Dropout(),
+                Linear(4096, 4096), ReLU(), Dropout(),
+                Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(F.flatten(x, 1))
+        return x
+
+
+def _vgg(cfg_key: str, batch_norm: bool, **kw) -> VGG:
+    return VGG(_make_features(_CFGS[cfg_key], batch_norm), **kw)
+
+
+def vgg11(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("A", batch_norm, **kw)
+
+
+def vgg13(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("B", batch_norm, **kw)
+
+
+def vgg16(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("D", batch_norm, **kw)
+
+
+def vgg19(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("E", batch_norm, **kw)

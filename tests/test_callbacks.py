@@ -60,9 +60,6 @@ class TestCallbacks:
         assert events.count("batch") == 4  # 2 epochs x 2 steps
 
     def test_early_stopping_stops(self):
-        class Worsen(Callback):
-            """Force a non-improving metric by rewriting logs."""
-
         model = _toy_model()
         es = EarlyStopping(monitor="loss", patience=0, baseline=0.0,
                            mode="min")

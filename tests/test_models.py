@@ -44,7 +44,7 @@ class TestResNet:
         model = resnet18(num_classes=4)
         model.train()
         params = model.state_dict()
-        opt = pt.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
         state = opt.init(params)
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(8, 3, 32, 32), jnp.float32)

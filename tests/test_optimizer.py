@@ -36,7 +36,7 @@ def test_optimizer_descends(cls, kwargs):
     params = model.trainable_variables()
     state = o.init(params)
     l0 = float(loss_fn(params))
-    for _ in range(30):
+    for _ in range(60):
         grads = jax.grad(loss_fn)(params)
         params, state = o.apply_gradients(grads, params, state)
     assert float(loss_fn(params)) < 0.5 * l0

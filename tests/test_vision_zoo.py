@@ -1,0 +1,113 @@
+"""Vision model-zoo construction + forward-shape tests.
+
+Mirrors the reference's test_vision_models.py doctrine: build each
+architecture, run one forward on a small batch, check the logits shape.
+Small spatial sizes keep CPU wall-clock low; Inception/GoogLeNet need their
+minimum legal inputs.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+
+
+def _forward(model, hw=64, batch=2, channels=3):
+    model.eval()
+    x = jnp.asarray(np.random.RandomState(0).randn(batch, channels, hw, hw),
+                    jnp.float32)
+    return model(x)
+
+
+@pytest.mark.parametrize("ctor,kw,hw", [
+    (M.alexnet, {}, 224),
+    (M.vgg11, {}, 64),
+    (M.vgg16, {"batch_norm": True}, 64),
+    (M.mobilenet_v1, {"scale": 0.25}, 64),
+    (M.mobilenet_v2, {"scale": 0.25}, 64),
+    (M.mobilenet_v3_small, {"scale": 0.5}, 64),
+    (M.mobilenet_v3_large, {"scale": 0.35}, 64),
+    (M.squeezenet1_0, {}, 96),
+    (M.squeezenet1_1, {}, 96),
+    (M.shufflenet_v2_x0_25, {}, 64),
+    (M.shufflenet_v2_swish, {}, 64),
+    (M.densenet121, {}, 64),
+    (M.resnext50_32x4d, {}, 64),
+    (M.inception_v3, {}, 128),
+])
+def test_zoo_forward_shape(ctor, kw, hw):
+    pt.seed(0)
+    model = ctor(num_classes=10, **kw)
+    out = _forward(model, hw=hw)
+    assert out.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_googlenet_returns_aux_heads():
+    pt.seed(0)
+    model = M.googlenet(num_classes=10)
+    out, aux1, aux2 = _forward(model, hw=128)
+    assert out.shape == aux1.shape == aux2.shape == (2, 10)
+
+
+def test_headless_backbone_modes():
+    """num_classes=0 / with_pool toggles parallel the reference's API."""
+    pt.seed(0)
+    m = M.mobilenet_v2(scale=0.25, num_classes=0)
+    feats = _forward(m, hw=64)
+    assert feats.shape[0:2] == (2, 1280) and feats.ndim == 4
+
+    m = M.vgg11(num_classes=0, with_pool=False)
+    feats = _forward(m, hw=64)
+    assert feats.ndim == 4 and feats.shape[1] == 512
+
+
+def test_mobilenet_v2_trains_one_step():
+    """One SGD step decreases loss on an overfit-able toy batch."""
+    import jax
+
+    pt.seed(0)
+    model = M.mobilenet_v2(scale=0.25, num_classes=4)
+    model.train()
+    params = model.state_dict()
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3, 32, 32), jnp.float32)
+    y = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    opt = pt.optimizer.Adam(learning_rate=1e-3)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        logits = model.apply(p, x)
+        from paddle_tpu.nn import functional as F
+        return F.cross_entropy(logits, y)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p2, s2 = opt.apply_gradients(grads, p, s)
+        return loss, p2, s2
+
+    losses = []
+    for _ in range(6):
+        loss, params, state = step(params, state)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_adaptive_avg_pool_non_divisible():
+    """The general adaptive-pool path (matmul formulation) matches a numpy
+    reference on a non-divisible 14→4 bin layout (GoogLeNet aux head)."""
+    from paddle_tpu.nn import functional as F
+
+    x = np.random.RandomState(0).randn(2, 3, 14, 14).astype(np.float32)
+    got = np.asarray(F.adaptive_avg_pool2d(jnp.asarray(x), (4, 4)))
+    # bin i covers [floor(i*in/out), ceil((i+1)*in/out))
+    ref = np.zeros((2, 3, 4, 4), np.float32)
+    for i in range(4):
+        hs, he = (i * 14) // 4, -(-((i + 1) * 14) // 4)
+        for j in range(4):
+            ws, we = (j * 14) // 4, -(-((j + 1) * 14) // 4)
+            ref[:, :, i, j] = x[:, :, hs:he, ws:we].mean(axis=(2, 3))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
