@@ -24,6 +24,7 @@ from . import fft  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from .framework import (CPUPlace, TPUPlace, get_device, load, save, seed,  # noqa: F401

@@ -1,0 +1,416 @@
+"""Quantization: QAT (fake-quant) + PTQ (observer/calibration) + int8 convert.
+
+Reference surface (E7):
+- QAT layer swap: fluid/contrib/slim/quantization/imperative/qat.py:42
+  ``ImperativeQuantAware`` (knobs :50-54 weight/activation type, bits,
+  moving_rate) — walks the model and replaces Linear/Conv2D with quantized
+  wrappers.
+- Fake-quant layers: python/paddle/nn/quant/quant_layers.py:46
+  ``FakeQuantAbsMax``, :128 ``FakeQuantMovingAverageAbsMax``, :226
+  ``FakeQuantChannelWiseAbsMax``, :309 ``MovingAverageAbsMaxScale``, :396/:591
+  ``QuantizedConv2D``/``QuantizedLinear``.
+- PTQ: post_training_quantization.py:97 ``PostTrainingQuantization``
+  (calibrate → scales → int8 weights; :1101 quantize_weight_to_int).
+
+TPU-first design:
+- fake quant-dequant is a pure function with a straight-through estimator
+  (``x + stop_gradient(qdq(x) - x)``) — no custom kernels needed, XLA fuses
+  the round/clip chain into neighbors.
+- moving-average scales are Layer buffers, so they ride the same
+  mutable-buffer path as BN running stats (trace-safe under ``apply``).
+- converted int8 inference runs the matmul on the MXU in int8 via
+  ``lax.dot_general(..., preferred_element_type=int32)`` then rescales —
+  the TPU-native analog of the reference's cuDNN/MKL int8 engines.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.errors import enforce
+from ..nn import functional as F
+from ..nn.layer import Layer, Parameter
+from ..nn.layers import Conv2D, Linear
+
+__all__ = [
+    "quant_dequant", "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+    "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
+    "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
+    "PostTrainingQuantization", "quantize_weight_to_int", "Int8Linear",
+    "Int8Conv2D",
+]
+
+
+# ---------------------------------------------------------------------------
+# functional core
+# ---------------------------------------------------------------------------
+def _qdq(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def quant_dequant(x, scale, bits: int = 8):
+    """Symmetric fake quantization with a straight-through gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+    return x + lax.stop_gradient(_qdq(x, scale, qmax) - x)
+
+
+# ---------------------------------------------------------------------------
+# fake-quant layers (QAT building blocks)
+# ---------------------------------------------------------------------------
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max scale computed on the fly (weights)."""
+
+    def __init__(self, bits: int = 8):
+        super().__init__()
+        self.bits = bits
+
+    def forward(self, x):
+        return quant_dequant(x, jnp.max(jnp.abs(x)), self.bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-output-channel abs-max scale (conv/linear weights)."""
+
+    def __init__(self, bits: int = 8, channel_axis: int = 0):
+        super().__init__()
+        self.bits = bits
+        self.channel_axis = channel_axis
+
+    def forward(self, x):
+        axes = tuple(i for i in range(x.ndim) if i != self.channel_axis)
+        scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+        return quant_dequant(x, scale, self.bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation fake quant with an EMA abs-max scale buffer.
+
+    Training updates ``scale ← r*scale + (1-r)*absmax(x)`` through the
+    mutable-buffer path; eval uses the frozen scale.  ``mode="max"`` turns
+    the EMA into a running max — the reference PTQ's abs_max calibration
+    algorithm (post_training_quantization.py algo='abs_max')."""
+
+    def __init__(self, bits: int = 8, moving_rate: float = 0.9,
+                 mode: str = "ema"):
+        super().__init__()
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.mode = mode
+        # None → follow self.training (QAT); True/False force collection
+        # on/off independent of train mode (PTQ calibrates with the model
+        # in eval so BN stats and dropout stay frozen)
+        self.observe = None
+        init = 1.0 if mode == "ema" else 0.0
+        self.register_buffer("scale", jnp.asarray(init, jnp.float32))
+
+    def forward(self, x):
+        scale = self._buffers["scale"]
+        if self.training if self.observe is None else self.observe:
+            batch = jnp.max(jnp.abs(lax.stop_gradient(x))).astype(jnp.float32)
+            if self.mode == "max":
+                scale = jnp.maximum(scale, batch)
+            else:
+                scale = (self.moving_rate * scale
+                         + (1 - self.moving_rate) * batch)
+            self._update_buffer("scale", scale)
+        return quant_dequant(x, scale, self.bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Observer only: tracks the EMA abs-max scale without quantizing
+    (quant_layers.py:309 — used to record output scales for deployment)."""
+
+    def __init__(self, moving_rate: float = 0.9):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", jnp.asarray(1.0, jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            batch = jnp.max(jnp.abs(lax.stop_gradient(x))).astype(jnp.float32)
+            scale = (self.moving_rate * self._buffers["scale"]
+                     + (1 - self.moving_rate) * batch)
+            self._update_buffer("scale", scale)
+        return x
+
+
+def _weight_quanter(kind: str, bits: int) -> Layer:
+    if kind == "abs_max":
+        return FakeQuantAbsMax(bits)
+    if kind == "channel_wise_abs_max":
+        return FakeQuantChannelWiseAbsMax(bits)
+    raise ValueError(f"unsupported weight_quantize_type {kind!r}")
+
+
+def _act_quanter(kind: str, bits: int, moving_rate: float) -> Optional[Layer]:
+    if kind == "moving_average_abs_max":
+        return FakeQuantMovingAverageAbsMax(bits, moving_rate)
+    if kind == "abs_max":
+        return FakeQuantAbsMax(bits)
+    if kind == "none":
+        return None
+    raise ValueError(f"unsupported activation_quantize_type {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight + input (quant_layers.py:591)."""
+
+    def __init__(self, layer: Linear, weight_quantize_type: str,
+                 activation_quantize_type: str, weight_bits: int,
+                 activation_bits: int, moving_rate: float):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        # linear weight is (in, out): output channel axis = 1
+        self.weight_quanter = _weight_quanter(weight_quantize_type,
+                                              weight_bits)
+        if isinstance(self.weight_quanter, FakeQuantChannelWiseAbsMax):
+            self.weight_quanter.channel_axis = 1
+        self.input_quanter = _act_quanter(activation_quantize_type,
+                                          activation_bits, moving_rate)
+
+    def forward(self, x):
+        if self.input_quanter is not None:
+            x = self.input_quanter(x)
+        w = self.weight_quanter(self.weight.value
+                                if isinstance(self.weight, Parameter)
+                                else self.weight)
+        return F.linear(x, w, self.bias)
+
+
+class QuantizedConv2D(Layer):
+    """Conv2D with fake-quantized weight + input (quant_layers.py:396)."""
+
+    def __init__(self, layer: Conv2D, weight_quantize_type: str,
+                 activation_quantize_type: str, weight_bits: int,
+                 activation_bits: int, moving_rate: float):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._stride = layer.stride
+        self._padding = layer.padding
+        self._dilation = layer.dilation
+        self._groups = layer.groups
+        self._data_format = layer.data_format
+        self.weight_quanter = _weight_quanter(weight_quantize_type,
+                                              weight_bits)  # OIHW: axis 0
+        self.input_quanter = _act_quanter(activation_quantize_type,
+                                          activation_bits, moving_rate)
+
+    def forward(self, x):
+        if self.input_quanter is not None:
+            x = self.input_quanter(x)
+        w = self.weight_quanter(self.weight.value
+                                if isinstance(self.weight, Parameter)
+                                else self.weight)
+        return F.conv2d(x, w, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+# ---------------------------------------------------------------------------
+# QAT driver
+# ---------------------------------------------------------------------------
+_SWAP = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """QAT layer-swap driver (imperative/qat.py:42).
+
+    ``quantize(model)`` rewrites the model in place: every Linear/Conv2D
+    becomes its fake-quant wrapper sharing the original Parameters, so the
+    optimizer state and state_dict keys keep working."""
+
+    def __init__(self, weight_quantize_type: str = "abs_max",
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9):
+        enforce(1 < weight_bits <= 16, "weight_bits must be in (1, 16]")
+        enforce(1 < activation_bits <= 16,
+                "activation_bits must be in (1, 16]")
+        self._kw = dict(weight_quantize_type=weight_quantize_type,
+                        activation_quantize_type=activation_quantize_type,
+                        weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        moving_rate=moving_rate)
+
+    def quantize(self, model: Layer) -> Layer:
+        for name, sub in list(model._sub_layers.items()):
+            wrapper = _SWAP.get(type(sub))
+            if wrapper is not None:
+                model._sub_layers[name] = wrapper(sub, **self._kw)
+            else:
+                self.quantize(sub)
+        return model
+
+
+def _walk(layer: Layer):
+    for sub in layer._sub_layers.values():
+        yield sub
+        yield from _walk(sub)
+
+
+# ---------------------------------------------------------------------------
+# PTQ + int8 conversion
+# ---------------------------------------------------------------------------
+def quantize_weight_to_int(w, bits: int = 8,
+                           channel_axis: Optional[int] = None
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """(int8 weight, float scale) — post_training_quantization.py:1101."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if channel_axis is None:
+        scale = jnp.max(jnp.abs(w))
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(jnp.int8)
+    return q, scale / qmax
+
+
+class Int8Linear(Layer):
+    """Converted int8 inference Linear: int8×int8 MXU matmul with int32
+    accumulation, then a per-channel rescale (the TPU-native deployment
+    form of the reference's quantized inference engines)."""
+
+    def __init__(self, layer: Linear, bits: int = 8):
+        super().__init__()
+        w = layer.weight.value if isinstance(layer.weight, Parameter) \
+            else layer.weight
+        q, s = quantize_weight_to_int(w, bits, channel_axis=1)
+        self.register_buffer("qweight", q)
+        self.register_buffer("wscale", s)        # (1, out)
+        self.bias = layer.bias
+        self.bits = bits
+        self.register_buffer("in_scale", jnp.asarray(1.0, jnp.float32))
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bits - 1) - 1)
+        in_scale = jnp.maximum(self._buffers["in_scale"], 1e-9)
+        xq = jnp.clip(jnp.round(x / in_scale * qmax), -qmax, qmax
+                      ).astype(jnp.int8)
+        acc = lax.dot_general(
+            xq, self._buffers["qweight"],
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * self._buffers["wscale"] \
+            * (in_scale / qmax)
+        if self.bias is not None:
+            b = self.bias.value if isinstance(self.bias, Parameter) \
+                else self.bias
+            y = y + b
+        return y
+
+
+class Int8Conv2D(Layer):
+    """Converted int8 inference Conv2D: int8 conv with int32 accumulation
+    (``lax.conv_general_dilated`` + preferred_element_type), per-output-
+    channel weight rescale."""
+
+    def __init__(self, layer: QuantizedConv2D, bits: int = 8):
+        super().__init__()
+        w = layer.weight.value if isinstance(layer.weight, Parameter) \
+            else layer.weight
+        q, s = quantize_weight_to_int(w, bits, channel_axis=0)  # OIHW
+        self.register_buffer("qweight", q)
+        self.register_buffer("wscale", s.reshape(1, -1, 1, 1))  # (1,O,1,1)
+        self.bias = layer.bias
+        self.bits = bits
+        self._stride = layer._stride
+        self._padding = layer._padding
+        self._dilation = layer._dilation
+        self._groups = layer._groups
+        self.register_buffer("in_scale", jnp.asarray(1.0, jnp.float32))
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bits - 1) - 1)
+        in_scale = jnp.maximum(self._buffers["in_scale"], 1e-9)
+        xq = jnp.clip(jnp.round(x / in_scale * qmax), -qmax, qmax
+                      ).astype(jnp.int8)
+        stride = (self._stride, self._stride) \
+            if isinstance(self._stride, int) else tuple(self._stride)
+        dil = (self._dilation, self._dilation) \
+            if isinstance(self._dilation, int) else tuple(self._dilation)
+        p = (self._padding, self._padding) \
+            if isinstance(self._padding, int) else tuple(self._padding)
+        dn = lax.conv_dimension_numbers(
+            x.shape, self._buffers["qweight"].shape,
+            ("NCHW", "OIHW", "NCHW"))
+        acc = lax.conv_general_dilated(
+            xq, self._buffers["qweight"], window_strides=stride,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=self._groups,
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * self._buffers["wscale"] \
+            * (in_scale / qmax)
+        if self.bias is not None:
+            b = self.bias.value if isinstance(self.bias, Parameter) \
+                else self.bias
+            y = y + b[None, :, None, None]
+        return y
+
+
+class PostTrainingQuantization:
+    """Calibration-based PTQ (post_training_quantization.py:97).
+
+    1. ``quantize(model, calibration_batches)``: attach moving-average
+       observers to every Linear/Conv2D input, run the batches, freeze
+       scales (the abs_max calibration algo).
+    2. ``convert(model)``: swap observed Linears/Conv2Ds to
+       Int8Linear/Int8Conv2D carrying the calibrated input scale.
+    """
+
+    def __init__(self, activation_bits: int = 8, weight_bits: int = 8,
+                 moving_rate: float = 0.9):
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model: Layer, calibration_data: Iterable) -> Layer:
+        qat = ImperativeQuantAware(
+            weight_quantize_type="channel_wise_abs_max",
+            activation_quantize_type="moving_average_abs_max",
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            moving_rate=self.moving_rate)
+        qat.quantize(model)
+        observers = [l for l in _walk(model)
+                     if isinstance(l, FakeQuantMovingAverageAbsMax)]
+        for obs in observers:        # abs_max calibration: running max
+            obs.mode = "max"
+            obs.observe = True
+            obs._buffers["scale"] = jnp.asarray(0.0, jnp.float32)
+        # model stays in eval: BN running stats and dropout must see
+        # inference conditions — only the observers collect
+        model.eval()
+        for batch in calibration_data:
+            model(batch)             # eager: scale buffers update in place
+        for obs in observers:
+            obs.observe = False
+        return model
+
+    def convert(self, model: Layer) -> Layer:
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, QuantizedLinear):
+                base = Linear.__new__(Linear)
+                Layer.__init__(base)
+                base.weight = sub.weight
+                base.bias = sub.bias
+                int8 = Int8Linear(base, self.weight_bits)
+            elif isinstance(sub, QuantizedConv2D):
+                int8 = Int8Conv2D(sub, self.weight_bits)
+            else:
+                self.convert(sub)
+                continue
+            if isinstance(sub.input_quanter, FakeQuantMovingAverageAbsMax):
+                int8._buffers["in_scale"] = \
+                    sub.input_quanter._buffers["scale"]
+            model._sub_layers[name] = int8
+        return model

@@ -12,7 +12,7 @@ from typing import List
 import jax.numpy as jnp
 
 from ...nn import functional as F
-from ...nn.layer import Layer
+from ...nn.layer import Layer, Sequential
 from ...nn.layers import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
                           Dropout, Linear, MaxPool2D)
 
@@ -156,7 +156,6 @@ class InceptionV3(Layer):
             ReductionB(768),
             InceptionC(1280), InceptionC(2048),
         ]
-        from ...nn.layer import Sequential
         self.features = Sequential(*body)
         if with_pool:
             self.pool = AdaptiveAvgPool2D((1, 1))

@@ -9,30 +9,18 @@ from __future__ import annotations
 
 from ...nn import functional as F
 from ...nn.layer import Layer, Sequential
-from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear,
-                          ReLU)
+from ...nn.layers import AdaptiveAvgPool2D, Linear
+from .utils import ConvNormActivation
 
 __all__ = ["MobileNetV1", "mobilenet_v1"]
-
-
-class ConvBNLayer(Layer):
-    def __init__(self, in_ch: int, out_ch: int, kernel: int, stride: int = 1,
-                 groups: int = 1):
-        super().__init__()
-        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
-                           padding=(kernel - 1) // 2, groups=groups,
-                           bias_attr=False)
-        self.bn = BatchNorm2D(out_ch)
-
-    def forward(self, x):
-        return F.relu(self.bn(self.conv(x)))
 
 
 class DepthwiseSeparable(Layer):
     def __init__(self, in_ch: int, out_ch: int, stride: int):
         super().__init__()
-        self.depthwise = ConvBNLayer(in_ch, in_ch, 3, stride, groups=in_ch)
-        self.pointwise = ConvBNLayer(in_ch, out_ch, 1)
+        self.depthwise = ConvNormActivation(in_ch, in_ch, 3, stride,
+                                            groups=in_ch)
+        self.pointwise = ConvNormActivation(in_ch, out_ch, 1)
 
     def forward(self, x):
         return self.pointwise(self.depthwise(x))
@@ -55,7 +43,7 @@ class MobileNetV1(Layer):
         def c(ch: int) -> int:
             return max(8, int(ch * scale))
 
-        layers = [ConvBNLayer(3, c(32), 3, stride=2)]
+        layers = [ConvNormActivation(3, c(32), 3, stride=2)]
         in_ch = c(32)
         for out, stride in _BLOCKS:
             layers.append(DepthwiseSeparable(in_ch, c(out), stride))

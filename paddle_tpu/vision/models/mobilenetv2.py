@@ -10,6 +10,7 @@ from ...nn import functional as F
 from ...nn.layer import Layer, Sequential
 from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
                           Linear)
+from .utils import ConvNormActivation
 
 __all__ = ["MobileNetV2", "mobilenet_v2"]
 
@@ -21,17 +22,9 @@ def _make_divisible(v: float, divisor: int = 8) -> int:
     return new_v
 
 
-class ConvBNReLU6(Layer):
-    def __init__(self, in_ch: int, out_ch: int, kernel: int = 3,
-                 stride: int = 1, groups: int = 1):
-        super().__init__()
-        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
-                           padding=(kernel - 1) // 2, groups=groups,
-                           bias_attr=False)
-        self.bn = BatchNorm2D(out_ch)
-
-    def forward(self, x):
-        return F.relu6(self.bn(self.conv(x)))
+def _conv_bn_relu6(in_ch, out_ch, kernel=3, stride=1, groups=1):
+    return ConvNormActivation(in_ch, out_ch, kernel, stride, groups,
+                              act="relu6")
 
 
 class InvertedResidual(Layer):
@@ -41,8 +34,9 @@ class InvertedResidual(Layer):
         self.use_res = stride == 1 and in_ch == out_ch
         layers = []
         if expand != 1:
-            layers.append(ConvBNReLU6(in_ch, hidden, 1))
-        layers.append(ConvBNReLU6(hidden, hidden, 3, stride, groups=hidden))
+            layers.append(_conv_bn_relu6(in_ch, hidden, 1))
+        layers.append(_conv_bn_relu6(hidden, hidden, 3, stride,
+                                     groups=hidden))
         self.body = Sequential(*layers)
         self.project = Conv2D(hidden, out_ch, 1, bias_attr=False)
         self.project_bn = BatchNorm2D(out_ch)
@@ -67,14 +61,14 @@ class MobileNetV2(Layer):
 
         in_ch = _make_divisible(32 * scale)
         last_ch = _make_divisible(1280 * max(1.0, scale))
-        layers = [ConvBNReLU6(3, in_ch, 3, stride=2)]
+        layers = [_conv_bn_relu6(3, in_ch, 3, stride=2)]
         for t, c, n, s in _SETTINGS:
             out_ch = _make_divisible(c * scale)
             for i in range(n):
                 layers.append(InvertedResidual(
                     in_ch, out_ch, s if i == 0 else 1, t))
                 in_ch = out_ch
-        layers.append(ConvBNReLU6(in_ch, last_ch, 1))
+        layers.append(_conv_bn_relu6(in_ch, last_ch, 1))
         self.features = Sequential(*layers)
         if with_pool:
             self.pool = AdaptiveAvgPool2D((1, 1))

@@ -10,31 +10,12 @@ from typing import List, Tuple
 
 from ...nn import functional as F
 from ...nn.layer import Layer, Sequential
-from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
-                          Linear)
+from ...nn.layers import AdaptiveAvgPool2D, Conv2D, Dropout, Linear
 from .mobilenetv2 import _make_divisible
+from .utils import ConvNormActivation as ConvBNAct
 
 __all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
            "mobilenet_v3_large"]
-
-
-class ConvBNAct(Layer):
-    def __init__(self, in_ch: int, out_ch: int, kernel: int, stride: int = 1,
-                 groups: int = 1, act: str = "hardswish"):
-        super().__init__()
-        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
-                           padding=(kernel - 1) // 2, groups=groups,
-                           bias_attr=False)
-        self.bn = BatchNorm2D(out_ch)
-        self.act = act
-
-    def forward(self, x):
-        x = self.bn(self.conv(x))
-        if self.act == "relu":
-            return F.relu(x)
-        if self.act == "hardswish":
-            return F.hardswish(x)
-        return x
 
 
 class SqueezeExcite(Layer):

@@ -37,8 +37,8 @@ def resnext101_64x4d(**kw) -> ResNeXt:
 
 
 def resnext152_32x4d(**kw) -> ResNeXt:
-    return ResNeXt([3, 8, 36, 3], 32, 4, **kw)
+    return ResNeXt(152, 32, 4, **kw)
 
 
 def resnext152_64x4d(**kw) -> ResNeXt:
-    return ResNeXt([3, 8, 36, 3], 64, 4, **kw)
+    return ResNeXt(152, 64, 4, **kw)

@@ -13,8 +13,8 @@ import jax.numpy as jnp
 
 from ...nn import functional as F
 from ...nn.layer import Layer, Sequential
-from ...nn.layers import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear,
-                          MaxPool2D)
+from ...nn.layers import AdaptiveAvgPool2D, Linear, MaxPool2D
+from .utils import ConvNormActivation
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
            "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
@@ -32,17 +32,10 @@ def _act(x, act: str):
     return F.silu(x) if act == "swish" else F.relu(x)
 
 
-class ConvBN(Layer):
-    def __init__(self, in_ch: int, out_ch: int, kernel: int, stride: int = 1,
-                 groups: int = 1):
-        super().__init__()
-        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
-                           padding=(kernel - 1) // 2, groups=groups,
-                           bias_attr=False)
-        self.bn = BatchNorm2D(out_ch)
-
-    def forward(self, x):
-        return self.bn(self.conv(x))
+def ConvBN(in_ch, out_ch, kernel, stride=1, groups=1):
+    # bare conv+bn; shufflenet applies its act selectively outside
+    return ConvNormActivation(in_ch, out_ch, kernel, stride, groups,
+                              act="none")
 
 
 class ShuffleUnit(Layer):

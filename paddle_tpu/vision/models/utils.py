@@ -1,0 +1,33 @@
+"""Shared building blocks for the model zoo (reference:
+python/paddle/vision/models/utils.py)."""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layers import BatchNorm2D, Conv2D
+from ...nn.layer import Layer
+
+__all__ = ["ConvNormActivation"]
+
+_ACTS = {"relu": F.relu, "relu6": F.relu6, "hardswish": F.hardswish,
+         "swish": F.silu, "none": None}
+
+
+class ConvNormActivation(Layer):
+    """Conv2D (same-padding, no bias) + BatchNorm2D + optional activation —
+    the block every mobile/shuffle architecture stamps out."""
+
+    def __init__(self, in_ch: int, out_ch: int, kernel: int = 3,
+                 stride: int = 1, groups: int = 1, act: str = "relu"):
+        super().__init__()
+        if act not in _ACTS:
+            raise ValueError(f"unsupported activation {act!r}")
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=(kernel - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        fn = _ACTS[self.act]
+        return fn(x) if fn is not None else x

@@ -1,0 +1,154 @@
+"""Quantization tests (E7): fake-quant numerics, QAT layer swap + training,
+PTQ calibration, int8 conversion accuracy.
+
+Doctrine follows the reference's imperative-QAT tests
+(test_imperative_qat.py pattern: quantize a small model, train, check it
+still learns and converted inference stays close to fp32).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu import quantization as Q
+from paddle_tpu.nn import functional as F
+
+
+def test_quant_dequant_values_and_ste_gradient():
+    x = jnp.asarray([-1.0, -0.5, 0.0, 0.3, 1.0])
+    y = Q.quant_dequant(x, jnp.asarray(1.0), bits=8)
+    # symmetric int8: q = round(x*127)/127
+    np.testing.assert_allclose(
+        np.asarray(y), np.round(np.asarray(x) * 127) / 127, atol=1e-7)
+    # straight-through: gradient of sum(qdq(x)) is all-ones
+    g = jax.grad(lambda t: jnp.sum(Q.quant_dequant(t, jnp.asarray(1.0))))(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones(5), atol=1e-7)
+
+
+def test_channel_wise_weight_quant():
+    w = jnp.asarray(np.random.RandomState(0).randn(4, 8) *
+                    np.asarray([0.1, 1.0, 10.0, 100.0])[:, None],
+                    jnp.float32)
+    fq = Q.FakeQuantChannelWiseAbsMax(bits=8, channel_axis=0)
+    y = np.asarray(fq(w))
+    # each row quantized against its own absmax: error bounded by scale/254
+    for i in range(4):
+        row_scale = float(np.max(np.abs(np.asarray(w)[i])))
+        assert np.max(np.abs(y[i] - np.asarray(w)[i])) <= row_scale / 254 + 1e-7
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_qat_swaps_layers_and_trains():
+    pt.seed(0)
+    model = _mlp()
+    Q.ImperativeQuantAware().quantize(model)
+    assert isinstance(model._sub_layers["0"], Q.QuantizedLinear)
+    assert isinstance(model._sub_layers["2"], Q.QuantizedLinear)
+
+    model.train()
+    params = model.state_dict()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 8), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, (16,)), jnp.int32)
+    opt = pt.optimizer.Adam(learning_rate=5e-3)
+    state = opt.init(params)
+
+    def step(p, s):
+        def loss_fn(q):
+            logits, newvars = model.apply(q, x, mutable=True)
+            return F.cross_entropy(logits, y), newvars
+        (loss, newvars), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p)
+        p2, s2 = opt.apply_gradients(grads, p, s)
+        for name, _ in model.named_buffers():
+            p2[name] = newvars[name]
+        return loss, p2, s2
+
+    jitted = jax.jit(step)
+    losses = []
+    for _ in range(25):
+        loss, params, state = jitted(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # the EMA activation scale buffer moved off its init value
+    scale_keys = [k for k in params if "input_quanter.scale" in k]
+    assert scale_keys and any(
+        abs(float(params[k]) - 1.0) > 1e-3 for k in scale_keys)
+
+
+def test_ptq_calibrates_and_converts_close_to_fp32():
+    pt.seed(3)
+    model = _mlp()
+    model.eval()
+    rng = np.random.RandomState(1)
+    calib = [jnp.asarray(rng.randn(32, 8), jnp.float32) for _ in range(8)]
+    x = jnp.asarray(rng.randn(64, 8), jnp.float32)
+    ref = np.asarray(model(x))
+
+    ptq = Q.PostTrainingQuantization()
+    ptq.quantize(model, calib)
+    ptq.convert(model)
+    assert isinstance(model._sub_layers["0"], Q.Int8Linear)
+    model.eval()
+    got = np.asarray(model(x))
+    # int8 per-channel weights + calibrated activations: a few % of range
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(got - ref)) / scale < 0.05
+
+
+def test_ptq_conv_model_preserves_bn_and_converts_conv():
+    """Calibration must not touch BN running stats or enable dropout
+    (model stays in eval), and convert() must swap convs to Int8Conv2D."""
+    pt.seed(9)
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.Dropout(0.5), nn.Conv2D(8, 4, 1), nn.Flatten(),
+        nn.Linear(4 * 8 * 8, 4))
+    model.eval()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 3, 8, 8), jnp.float32)
+    ref = np.asarray(model(x))
+    bn_mean_before = np.asarray(model._sub_layers["1"]._buffers["_mean"])
+
+    ptq = Q.PostTrainingQuantization()
+    ptq.quantize(model, [x])
+    bn_mean_after = np.asarray(model._sub_layers["1"]._buffers["_mean"])
+    np.testing.assert_array_equal(bn_mean_before, bn_mean_after)
+
+    ptq.convert(model)
+    assert isinstance(model._sub_layers["0"], Q.Int8Conv2D)
+    assert isinstance(model._sub_layers["4"], Q.Int8Conv2D)
+    assert isinstance(model._sub_layers["6"], Q.Int8Linear)
+    model.eval()
+    got = np.asarray(model(x))
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(got - ref)) / scale < 0.05
+
+
+def test_quantize_weight_to_int_roundtrip():
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    q, s = Q.quantize_weight_to_int(w, bits=8, channel_axis=1)
+    assert q.dtype == jnp.int8
+    back = np.asarray(q, np.float32) * np.asarray(s)
+    err = np.max(np.abs(back - np.asarray(w)))
+    assert err <= float(np.max(np.asarray(s))) / 2 + 1e-7
+
+
+def test_int8_linear_matmul_path():
+    """Int8Linear's dot runs in int8→int32 and matches fp32 within quant
+    error on well-scaled inputs."""
+    pt.seed(5)
+    lin = nn.Linear(32, 16)
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 32), jnp.float32)
+    ref = np.asarray(lin(x))
+    int8 = Q.Int8Linear(lin)
+    int8._buffers["in_scale"] = jnp.max(jnp.abs(x))
+    got = np.asarray(int8(x))
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(got - ref)) / scale < 0.05

@@ -40,6 +40,7 @@ _MODULES = [
     "paddle_tpu.models",
     "paddle_tpu.hapi",
     "paddle_tpu.profiler",
+    "paddle_tpu.quantization",
     "paddle_tpu.jit",
     "paddle_tpu.inference",
     "paddle_tpu.static",
