@@ -23,6 +23,7 @@ from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
+from . import linalg  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
@@ -523,3 +524,8 @@ def synchronize():
     synchronize analog)."""
     for a in jax.live_arrays():
         a.block_until_ready()
+
+
+# extended op corpus (reference tensor/{math,manipulation,search,random}.py
+# long tail) — see tensor_ops.py
+from .tensor_ops import *  # noqa: F401,F403,E402

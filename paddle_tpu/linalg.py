@@ -1,0 +1,154 @@
+"""paddle.linalg namespace (reference: python/paddle/tensor/linalg.py —
+cholesky:*, det, slogdet, eig/eigh/eigvals/eigvalsh, inv, lstsq, lu,
+matrix_power, matrix_rank, multi_dot, norm, pinv, qr, solve, svd,
+triangular_solve, cov, corrcoef).
+
+TPU notes: decompositions (svd/qr/eig/cholesky) lower to LAPACK-style XLA
+custom calls — supported on TPU but not MXU-bound; the GEMM-shaped members
+(multi_dot, matrix_power, solve via factorization) are.  All functions
+accept batched inputs per jnp.linalg broadcasting rules, matching the
+reference's batched-op semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .framework.errors import enforce
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
+    "eigh", "eigvals", "eigvalsh", "inv", "lstsq", "lu", "matrix_power",
+    "matrix_rank", "multi_dot", "norm", "pinv", "qr", "slogdet", "solve",
+    "svd", "triangular_solve",
+]
+
+
+def _arr(x):
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
+def cholesky(x, upper: bool = False):
+    l = jnp.linalg.cholesky(_arr(x))
+    return jnp.swapaxes(l, -1, -2).conj() if upper else l
+
+
+def cholesky_solve(x, y, upper: bool = False):
+    """Solve A @ out = x given y = chol factor of A."""
+    y = _arr(y)
+    l = jnp.swapaxes(y, -1, -2).conj() if upper else y
+    z = jax.scipy.linalg.solve_triangular(l, _arr(x), lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(l, -1, -2).conj(), z, lower=False)
+
+
+def det(x):
+    return jnp.linalg.det(_arr(x))
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(_arr(x))
+    return jnp.stack([sign, logabs])     # paddle returns one stacked tensor
+
+
+def eig(x):
+    return jnp.linalg.eig(_arr(x))
+
+
+def eigh(x, UPLO: str = "L"):
+    return jnp.linalg.eigh(_arr(x), UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(_arr(x))
+
+
+def eigvalsh(x, UPLO: str = "L"):
+    return jnp.linalg.eigvalsh(_arr(x), UPLO=UPLO)
+
+
+def inv(x):
+    return jnp.linalg.inv(_arr(x))
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_arr(x), _arr(y), rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot: bool = True, get_infos: bool = False):
+    """Packed LU + pivots (paddle.linalg.lu semantics)."""
+    lu_mat, piv = jax.scipy.linalg.lu_factor(_arr(x))
+    info = jnp.zeros((), jnp.int32)
+    # paddle pivots are 1-based
+    if get_infos:
+        return lu_mat, piv + 1, info
+    return lu_mat, piv + 1
+
+
+def matrix_power(x, n: int):
+    return jnp.linalg.matrix_power(_arr(x), n)
+
+
+def matrix_rank(x, tol=None, hermitian: bool = False):
+    return jnp.linalg.matrix_rank(_arr(x), tol=tol)
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot([_arr(x) for x in xs])
+
+
+def norm(x, p=None, axis=None, keepdim: bool = False):
+    x = _arr(x)
+    if p is None:
+        p = "fro" if axis is None or not jnp.isscalar(axis) else 2
+    if isinstance(axis, int):
+        return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis) if axis else None,
+                           keepdims=keepdim)
+
+
+def pinv(x, rcond=1e-15, hermitian: bool = False):
+    return jnp.linalg.pinv(_arr(x), rtol=rcond, hermitian=hermitian)
+
+
+def qr(x, mode: str = "reduced"):
+    return jnp.linalg.qr(_arr(x), mode=mode)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(_arr(x), _arr(y))
+
+
+def svd(x, full_matrices: bool = False):
+    return jnp.linalg.svd(_arr(x), full_matrices=full_matrices)
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False):
+    return jax.scipy.linalg.solve_triangular(
+        _arr(x), _arr(y), lower=not upper,
+        trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+def cond(x, p=None):
+    """Condition number (paddle.linalg.cond)."""
+    x = _arr(x)
+    if p is None:
+        p = 2
+    if p in (2, -2):
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return (s[..., 0] / s[..., -1]) if p == 2 else (s[..., -1] / s[..., 0])
+    return (jnp.linalg.norm(x, ord=p, axis=(-2, -1))
+            * jnp.linalg.norm(jnp.linalg.inv(x), ord=p, axis=(-2, -1)))
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None):
+    return jnp.cov(_arr(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar: bool = True):
+    return jnp.corrcoef(_arr(x), rowvar=rowvar)

@@ -251,19 +251,18 @@ class ImperativeQuantAware:
         return model
 
 
-def _walk(layer: Layer):
-    for sub in layer._sub_layers.values():
-        yield sub
-        yield from _walk(sub)
-
-
 # ---------------------------------------------------------------------------
 # PTQ + int8 conversion
 # ---------------------------------------------------------------------------
+def _int_dtype(bits: int):
+    return jnp.int8 if bits <= 8 else (jnp.int16 if bits <= 16 else jnp.int32)
+
+
 def quantize_weight_to_int(w, bits: int = 8,
                            channel_axis: Optional[int] = None
                            ) -> Tuple[jax.Array, jax.Array]:
-    """(int8 weight, float scale) — post_training_quantization.py:1101."""
+    """(int weight, float scale) — post_training_quantization.py:1101.
+    Storage dtype follows ``bits`` (int8 up to 8 bits, else int16/int32)."""
     qmax = float(2 ** (bits - 1) - 1)
     if channel_axis is None:
         scale = jnp.max(jnp.abs(w))
@@ -271,7 +270,8 @@ def quantize_weight_to_int(w, bits: int = 8,
         axes = tuple(i for i in range(w.ndim) if i != channel_axis)
         scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
     scale = jnp.maximum(scale, 1e-9)
-    q = jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(jnp.int8)
+    q = jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax
+                 ).astype(_int_dtype(bits))
     return q, scale / qmax
 
 
@@ -280,7 +280,9 @@ class Int8Linear(Layer):
     accumulation, then a per-channel rescale (the TPU-native deployment
     form of the reference's quantized inference engines)."""
 
-    def __init__(self, layer: Linear, bits: int = 8):
+    def __init__(self, layer, bits: int = 8):
+        """``layer``: anything exposing ``.weight``/``.bias`` with a (in,
+        out) weight — a plain Linear or a QuantizedLinear wrapper."""
         super().__init__()
         w = layer.weight.value if isinstance(layer.weight, Parameter) \
             else layer.weight
@@ -295,7 +297,7 @@ class Int8Linear(Layer):
         qmax = float(2 ** (self.bits - 1) - 1)
         in_scale = jnp.maximum(self._buffers["in_scale"], 1e-9)
         xq = jnp.clip(jnp.round(x / in_scale * qmax), -qmax, qmax
-                      ).astype(jnp.int8)
+                      ).astype(_int_dtype(self.bits))
         acc = lax.dot_general(
             xq, self._buffers["qweight"],
             (((x.ndim - 1,), (0,)), ((), ())),
@@ -318,42 +320,55 @@ class Int8Conv2D(Layer):
         super().__init__()
         w = layer.weight.value if isinstance(layer.weight, Parameter) \
             else layer.weight
-        q, s = quantize_weight_to_int(w, bits, channel_axis=0)  # OIHW
+        self._data_format = layer._data_format
+        # weight layout follows F.conv2d's contract: OIHW for NCHW inputs,
+        # HWIO for NHWC — the output-channel axis moves with it
+        out_axis = 0 if self._data_format == "NCHW" else 3
+        q, s = quantize_weight_to_int(w, bits, channel_axis=out_axis)
         self.register_buffer("qweight", q)
-        self.register_buffer("wscale", s.reshape(1, -1, 1, 1))  # (1,O,1,1)
         self.bias = layer.bias
         self.bits = bits
         self._stride = layer._stride
         self._padding = layer._padding
         self._dilation = layer._dilation
         self._groups = layer._groups
+        if self._data_format == "NCHW":
+            self.register_buffer("wscale", s.reshape(1, -1, 1, 1))
+        else:  # NHWC: channels last
+            self.register_buffer("wscale", s.reshape(1, 1, 1, -1))
         self.register_buffer("in_scale", jnp.asarray(1.0, jnp.float32))
 
     def forward(self, x):
         qmax = float(2 ** (self.bits - 1) - 1)
         in_scale = jnp.maximum(self._buffers["in_scale"], 1e-9)
         xq = jnp.clip(jnp.round(x / in_scale * qmax), -qmax, qmax
-                      ).astype(jnp.int8)
+                      ).astype(_int_dtype(self.bits))
         stride = (self._stride, self._stride) \
             if isinstance(self._stride, int) else tuple(self._stride)
         dil = (self._dilation, self._dilation) \
             if isinstance(self._dilation, int) else tuple(self._dilation)
-        p = (self._padding, self._padding) \
-            if isinstance(self._padding, int) else tuple(self._padding)
+        if isinstance(self._padding, str):
+            pad = self._padding.upper()
+        else:
+            p = (self._padding, self._padding) \
+                if isinstance(self._padding, int) else tuple(self._padding)
+            pad = [(p[0], p[0]), (p[1], p[1])]
         dn = lax.conv_dimension_numbers(
             x.shape, self._buffers["qweight"].shape,
-            ("NCHW", "OIHW", "NCHW"))
+            ("NCHW", "OIHW", "NCHW") if self._data_format == "NCHW"
+            else ("NHWC", "HWIO", "NHWC"))
         acc = lax.conv_general_dilated(
             xq, self._buffers["qweight"], window_strides=stride,
-            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=dil,
-            dimension_numbers=dn, feature_group_count=self._groups,
+            padding=pad, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=self._groups,
             preferred_element_type=jnp.int32)
         y = acc.astype(jnp.float32) * self._buffers["wscale"] \
             * (in_scale / qmax)
         if self.bias is not None:
             b = self.bias.value if isinstance(self.bias, Parameter) \
                 else self.bias
-            y = y + b[None, :, None, None]
+            y = y + (b[None, :, None, None]
+                     if self._data_format == "NCHW" else b)
         return y
 
 
@@ -381,7 +396,7 @@ class PostTrainingQuantization:
             activation_bits=self.activation_bits,
             moving_rate=self.moving_rate)
         qat.quantize(model)
-        observers = [l for l in _walk(model)
+        observers = [l for l in model.sublayers()
                      if isinstance(l, FakeQuantMovingAverageAbsMax)]
         for obs in observers:        # abs_max calibration: running max
             obs.mode = "max"
@@ -399,11 +414,7 @@ class PostTrainingQuantization:
     def convert(self, model: Layer) -> Layer:
         for name, sub in list(model._sub_layers.items()):
             if isinstance(sub, QuantizedLinear):
-                base = Linear.__new__(Linear)
-                Layer.__init__(base)
-                base.weight = sub.weight
-                base.bias = sub.bias
-                int8 = Int8Linear(base, self.weight_bits)
+                int8 = Int8Linear(sub, self.weight_bits)
             elif isinstance(sub, QuantizedConv2D):
                 int8 = Int8Conv2D(sub, self.weight_bits)
             else:

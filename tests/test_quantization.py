@@ -131,6 +131,45 @@ def test_ptq_conv_model_preserves_bn_and_converts_conv():
     assert np.max(np.abs(got - ref)) / scale < 0.05
 
 
+def test_wide_bits_use_wider_storage():
+    """bits > 8 must widen the storage dtype, not wrap modulo 256."""
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+    q, s = Q.quantize_weight_to_int(w, bits=12)
+    assert q.dtype == jnp.int16
+    back = np.asarray(q, np.float32) * float(np.asarray(s))
+    assert np.max(np.abs(back - np.asarray(w))) <= float(np.asarray(s)) + 1e-7
+    # end-to-end: 12-bit PTQ stays accurate
+    pt.seed(2)
+    model = _mlp()
+    model.eval()
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 8), jnp.float32)
+    ref = np.asarray(model(x))
+    ptq = Q.PostTrainingQuantization(activation_bits=12, weight_bits=12)
+    ptq.quantize(model, [x])
+    ptq.convert(model)
+    model.eval()
+    got = np.asarray(model(x))
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 0.01
+
+
+def test_ptq_conv_string_padding():
+    """Conv2D(padding='same') must survive conversion (regression: the
+    int8 conv once assumed numeric padding)."""
+    pt.seed(4)
+    model = nn.Sequential(nn.Conv2D(3, 4, 3, padding="same"), nn.Flatten(),
+                          nn.Linear(4 * 8 * 8, 2))
+    model.eval()
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 3, 8, 8), jnp.float32)
+    ref = np.asarray(model(x))
+    ptq = Q.PostTrainingQuantization()
+    ptq.quantize(model, [x])
+    ptq.convert(model)
+    model.eval()
+    got = np.asarray(model(x))
+    assert got.shape == ref.shape
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 0.05
+
+
 def test_quantize_weight_to_int_roundtrip():
     w = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
     q, s = Q.quantize_weight_to_int(w, bits=8, channel_axis=1)

@@ -1,0 +1,346 @@
+"""OpTest parity for the extended tensor corpus (tensor_ops.py + linalg.py):
+numpy-reference forward checks and finite-difference gradient checks on the
+differentiable members (reference doctrine: unittests/op_test.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from op_test import check_grad, check_output
+
+R = np.random.RandomState(7)
+
+
+class TestMathOps:
+    def test_amax_amin(self):
+        x = R.randn(3, 4).astype(np.float32)
+        check_output(lambda a: pt.amax(a, axis=1), lambda a: a.max(1), [x])
+        check_output(lambda a: pt.amin(a, axis=0), lambda a: a.min(0), [x])
+
+    def test_addmm(self):
+        i, a, b = (R.randn(3, 5).astype(np.float32),
+                   R.randn(3, 4).astype(np.float32),
+                   R.randn(4, 5).astype(np.float32))
+        check_output(lambda i, a, b: pt.addmm(i, a, b, beta=0.5, alpha=2.0),
+                     lambda i, a, b: 0.5 * i + 2.0 * (a @ b), [i, a, b])
+        check_grad(lambda i, a, b: pt.addmm(i, a, b), [i, a, b],
+                   wrt=(0, 1, 2))
+
+    def test_deg2rad_rad2deg_roundtrip(self):
+        x = R.randn(8).astype(np.float32) * 180
+        np.testing.assert_allclose(
+            np.asarray(pt.rad2deg(pt.deg2rad(x))), x, rtol=1e-5)
+
+    def test_lerp(self):
+        x, y = R.randn(4).astype(np.float32), R.randn(4).astype(np.float32)
+        check_output(lambda x, y: pt.lerp(x, y, 0.3),
+                     lambda x, y: x + 0.3 * (y - x), [x, y])
+        check_grad(lambda x, y: pt.lerp(x, y, 0.3), [x, y], wrt=(0, 1))
+
+    def test_logit_inverts_sigmoid(self):
+        p = np.clip(R.rand(16).astype(np.float32), 0.05, 0.95)
+        np.testing.assert_allclose(
+            np.asarray(pt.sigmoid(pt.logit(p))), p, rtol=1e-4, atol=1e-5)
+
+    def test_logsumexp(self):
+        x = R.randn(3, 4).astype(np.float32)
+        check_output(lambda a: pt.logsumexp(a, axis=1),
+                     lambda a: np.log(np.sum(np.exp(a), axis=1)), [x])
+        check_grad(lambda a: pt.logsumexp(a, axis=1), [x])
+
+    def test_nan_reductions(self):
+        x = np.asarray([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]], np.float32)
+        np.testing.assert_allclose(np.asarray(pt.nanmean(x, axis=1)),
+                                   [2.0, 5.5])
+        np.testing.assert_allclose(np.asarray(pt.nansum(x)), 15.0)
+        np.testing.assert_allclose(np.asarray(pt.nanmedian(x, axis=1)),
+                                   [2.0, 5.5])
+
+    def test_trace_diag_family(self):
+        x = R.randn(4, 4).astype(np.float32)
+        check_output(pt.trace, np.trace, [x])
+        check_output(lambda a: pt.diagonal(a, offset=1),
+                     lambda a: np.diagonal(a, offset=1), [x])
+        v = R.randn(3).astype(np.float32)
+        check_output(pt.diagflat, np.diagflat, [v])
+
+    def test_scale(self):
+        x = R.randn(5).astype(np.float32)
+        check_output(lambda a: pt.scale(a, scale=2.0, bias=1.0),
+                     lambda a: a * 2 + 1, [x])
+        check_output(
+            lambda a: pt.scale(a, scale=2.0, bias=1.0,
+                               bias_after_scale=False),
+            lambda a: (a + 1) * 2, [x])
+
+    def test_misc_elementwise(self):
+        x = R.randn(6).astype(np.float32)
+        y = R.randn(6).astype(np.float32)
+        check_output(pt.hypot, np.hypot, [x, y])
+        check_output(pt.copysign, np.copysign, [x, y])
+        check_output(pt.frac, lambda a: a - np.trunc(a), [x])
+        check_output(pt.stanh,
+                     lambda a: 1.7159 * np.tanh(0.67 * a), [x])
+        ints = R.randint(1, 30, (6,))
+        jnts = R.randint(1, 30, (6,))
+        check_output(pt.gcd, np.gcd, [ints, jnts])
+        check_output(pt.lcm, np.lcm, [ints, jnts])
+
+
+class TestComplexOps:
+    def test_complex_roundtrip(self):
+        re = R.randn(4).astype(np.float32)
+        im = R.randn(4).astype(np.float32)
+        c = pt.complex(re, im)
+        assert pt.is_complex(c)
+        np.testing.assert_allclose(np.asarray(pt.real(c)), re)
+        np.testing.assert_allclose(np.asarray(pt.imag(c)), im)
+        packed = pt.as_real(c)
+        np.testing.assert_allclose(np.asarray(pt.as_complex(packed)),
+                                   np.asarray(c))
+
+    def test_angle_conj(self):
+        c = np.asarray([1 + 1j, -1 + 0j], np.complex64)
+        check_output(pt.angle, np.angle, [c])
+        check_output(pt.conj, np.conj, [c])
+
+
+class TestLinalg:
+    def test_solve_det_inv(self):
+        a = (R.randn(4, 4) + 4 * np.eye(4)).astype(np.float32)
+        b = R.randn(4, 2).astype(np.float32)
+        x = np.asarray(pt.linalg.solve(a, b))
+        np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.linalg.det(a)),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.linalg.inv(a)),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-5)
+
+    def test_svd_qr_reconstruct(self):
+        a = R.randn(5, 3).astype(np.float32)
+        u, s, vt = pt.linalg.svd(a)
+        np.testing.assert_allclose(
+            np.asarray(u) * np.asarray(s) @ np.asarray(vt), a,
+            rtol=1e-4, atol=1e-5)
+        q, r = pt.linalg.qr(a)
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cholesky_and_solve(self):
+        m = R.randn(4, 4).astype(np.float32)
+        a = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+        l = pt.linalg.cholesky(a)
+        np.testing.assert_allclose(np.asarray(l) @ np.asarray(l).T, a,
+                                   rtol=1e-4, atol=1e-4)
+        b = R.randn(4, 1).astype(np.float32)
+        x = pt.linalg.cholesky_solve(b, l)
+        np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_eigh_symmetric(self):
+        m = R.randn(4, 4).astype(np.float32)
+        a = (m + m.T) / 2
+        w, v = pt.linalg.eigh(a)
+        np.testing.assert_allclose(
+            a @ np.asarray(v), np.asarray(v) * np.asarray(w),
+            rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.sort(np.asarray(pt.linalg.eigvalsh(a))),
+                                   np.sort(np.linalg.eigvalsh(a)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_matrix_power_rank_pinv(self):
+        a = (R.randn(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(pt.linalg.matrix_power(a, 3)),
+                                   a @ a @ a, rtol=1e-3, atol=1e-3)
+        lowrank = np.outer(R.randn(4), R.randn(4)).astype(np.float32)
+        assert int(pt.linalg.matrix_rank(lowrank, tol=1e-4)) == 1
+        p = np.asarray(pt.linalg.pinv(lowrank, rcond=1e-5))  # f32 noise floor
+        np.testing.assert_allclose(lowrank @ p @ lowrank, lowrank,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_multi_dot_slogdet_cond(self):
+        a, b, c = (R.randn(2, 3).astype(np.float32),
+                   R.randn(3, 4).astype(np.float32),
+                   R.randn(4, 2).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(pt.linalg.multi_dot([a, b, c])),
+                                   a @ b @ c, rtol=1e-4, atol=1e-5)
+        m = (R.randn(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        out = np.asarray(pt.linalg.slogdet(m))
+        sign, logabs = np.linalg.slogdet(m)
+        np.testing.assert_allclose(out[0], sign, rtol=1e-4)
+        np.testing.assert_allclose(out[1], logabs, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.linalg.cond(m)),
+                                   np.linalg.cond(m), rtol=1e-3)
+
+    def test_triangular_solve_lstsq(self):
+        a = np.triu(R.randn(3, 3).astype(np.float32) + 2 * np.eye(3, dtype=np.float32))
+        b = R.randn(3, 2).astype(np.float32)
+        x = np.asarray(pt.linalg.triangular_solve(a, b, upper=True))
+        np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-4)
+        a2 = R.randn(6, 3).astype(np.float32)
+        b2 = R.randn(6, 1).astype(np.float32)
+        sol = np.asarray(pt.linalg.lstsq(a2, b2)[0])
+        ref = np.linalg.lstsq(a2, b2, rcond=None)[0]
+        np.testing.assert_allclose(sol, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestManipulation:
+    def test_moveaxis_rot90_unbind(self):
+        x = R.randn(2, 3, 4).astype(np.float32)
+        check_output(lambda a: pt.moveaxis(a, 0, 2),
+                     lambda a: np.moveaxis(a, 0, 2), [x])
+        check_output(lambda a: pt.rot90(a, k=1, axes=(1, 2)),
+                     lambda a: np.rot90(a, 1, (1, 2)), [x])
+        parts = pt.unbind(x, axis=1)
+        assert len(parts) == 3 and parts[0].shape == (2, 4)
+        np.testing.assert_allclose(np.asarray(parts[1]), x[:, 1])
+
+    def test_repeat_interleave_expand_as(self):
+        x = np.asarray([[1, 2], [3, 4]], np.float32)
+        check_output(lambda a: pt.repeat_interleave(a, 2, axis=1),
+                     lambda a: np.repeat(a, 2, axis=1), [x])
+        y = np.zeros((3, 2, 2), np.float32)
+        assert pt.expand_as(x, y).shape == (3, 2, 2)
+
+    def test_put_along_axis_modes(self):
+        x = np.zeros((2, 3), np.float32)
+        idx = np.asarray([[0], [2]])
+        out = np.asarray(pt.put_along_axis(x, idx, 5.0, axis=1))
+        assert out[0, 0] == 5.0 and out[1, 2] == 5.0 and out.sum() == 10.0
+        out2 = np.asarray(pt.put_along_axis(out, idx, 1.0, axis=1,
+                                            reduce="add"))
+        assert out2[0, 0] == 6.0
+
+    def test_index_sample_multiplex(self):
+        x = R.randn(3, 5).astype(np.float32)
+        idx = R.randint(0, 5, (3, 2))
+        out = np.asarray(pt.index_sample(x, idx))
+        for i in range(3):
+            np.testing.assert_allclose(out[i], x[i, idx[i]])
+        a, b = (R.randn(4, 2).astype(np.float32),
+                R.randn(4, 2).astype(np.float32))
+        sel = np.asarray([0, 1, 0, 1])
+        out = np.asarray(pt.multiplex([a, b], sel))
+        np.testing.assert_allclose(out[0], a[0])
+        np.testing.assert_allclose(out[1], b[1])
+
+    def test_unique_consecutive(self):
+        x = np.asarray([1, 1, 2, 2, 2, 3, 1, 1])
+        out, inv, counts = pt.unique_consecutive(
+            x, return_inverse=True, return_counts=True)
+        np.testing.assert_array_equal(np.asarray(out), [1, 2, 3, 1])
+        np.testing.assert_array_equal(np.asarray(counts), [2, 3, 1, 2])
+        np.testing.assert_array_equal(np.asarray(out)[np.asarray(inv)], x)
+
+    def test_meshgrid_broadcast_helpers(self):
+        a, b = np.arange(3), np.arange(4)
+        gx, gy = pt.meshgrid(a, b)
+        assert gx.shape == gy.shape == (3, 4)
+        assert pt.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        outs = pt.broadcast_tensors([np.zeros((2, 1)), np.zeros((1, 3))])
+        assert outs[0].shape == outs[1].shape == (2, 3)
+
+    def test_renorm_caps_norms(self):
+        x = R.randn(4, 8).astype(np.float32) * 10
+        out = np.asarray(pt.renorm(x, p=2.0, axis=0, max_norm=1.0))
+        norms = np.linalg.norm(out, axis=1)
+        assert np.all(norms <= 1.0 + 1e-4)
+
+    def test_as_strided_view(self):
+        x = np.arange(12, dtype=np.float32)
+        out = np.asarray(pt.as_strided(x, (3, 4), (4, 1)))
+        np.testing.assert_allclose(out, x.reshape(3, 4))
+        assert pt.view(x, [4, 3]).shape == (4, 3)
+        assert pt.tolist(np.asarray([1, 2])) == [1, 2]
+
+
+class TestSearchSort:
+    def test_kthvalue_median_quantile(self):
+        x = R.randn(3, 7).astype(np.float32)
+        val, idx = pt.kthvalue(x, 3, axis=1)
+        np.testing.assert_allclose(np.asarray(val),
+                                   np.sort(x, axis=1)[:, 2])
+        np.testing.assert_allclose(np.asarray(pt.median(x, axis=1)),
+                                   np.median(x, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.quantile(x, 0.5, axis=1)),
+                                   np.quantile(x, 0.5, axis=1), rtol=1e-5)
+
+    def test_mode(self):
+        x = np.asarray([[1, 3, 3, 2], [2, 2, 1, 1]], np.float32)
+        val, idx = pt.mode(x, axis=-1)
+        np.testing.assert_allclose(np.asarray(val), [3.0, 2.0])
+
+    def test_searchsorted_bucketize(self):
+        edges = np.asarray([1.0, 3.0, 5.0, 7.0], np.float32)
+        vals = np.asarray([0.5, 3.0, 6.0, 9.0], np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(pt.searchsorted(edges, vals)), [0, 1, 3, 4])
+        np.testing.assert_array_equal(
+            np.asarray(pt.searchsorted(edges, vals, right=True)),
+            [0, 2, 3, 4])
+        np.testing.assert_array_equal(
+            np.asarray(pt.bucketize(vals, edges)), [0, 1, 3, 4])
+
+    def test_histogram_bincount(self):
+        x = np.asarray([0.1, 0.4, 0.6, 0.9, 0.4], np.float32)
+        counts = np.asarray(pt.histogram(x, bins=2, min=0.0, max=1.0))
+        np.testing.assert_array_equal(counts, [3, 2])
+        ints = np.asarray([0, 1, 1, 3])
+        np.testing.assert_array_equal(np.asarray(pt.bincount(ints)),
+                                      [1, 2, 0, 1])
+
+
+class TestLinalgAdjacent:
+    def test_cross_inner_kron_mv(self):
+        a = R.randn(3).astype(np.float32)
+        b = R.randn(3).astype(np.float32)
+        check_output(pt.cross, np.cross, [a, b])
+        check_output(pt.inner, np.inner, [a, b])
+        m = R.randn(2, 2).astype(np.float32)
+        check_output(pt.kron, np.kron, [m, m])
+        check_output(pt.mv, lambda m, v: m @ v,
+                     [R.randn(3, 4).astype(np.float32),
+                      R.randn(4).astype(np.float32)])
+
+    def test_dist_tensordot(self):
+        x = R.randn(3, 4).astype(np.float32)
+        y = R.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(pt.dist(x, y, 2)),
+                                   np.linalg.norm((x - y).ravel()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pt.dist(x, y, float("inf"))),
+            np.max(np.abs(x - y)), rtol=1e-6)
+        a = R.randn(2, 3, 4).astype(np.float32)
+        b = R.randn(3, 4, 5).astype(np.float32)
+        check_output(lambda a, b: pt.tensordot(a, b, axes=2),
+                     lambda a, b: np.tensordot(a, b, axes=2), [a, b],
+                     rtol=1e-4, atol=1e-4)
+
+
+class TestRandomOps:
+    def test_multinomial_respects_support(self):
+        pt.seed(0)
+        probs = np.asarray([0.0, 0.3, 0.7], np.float32)
+        draws = np.asarray(pt.multinomial(probs, 64, replacement=True))
+        assert draws.shape == (64,)
+        assert set(np.unique(draws)).issubset({1, 2})
+        noreplace = np.asarray(pt.multinomial(probs + 0.1, 3,
+                                              replacement=False))
+        assert sorted(noreplace.tolist()) == [0, 1, 2]
+
+    def test_standard_normal_poisson_randint_like(self):
+        pt.seed(1)
+        z = np.asarray(pt.standard_normal((2000,)))
+        assert abs(z.mean()) < 0.1 and abs(z.std() - 1.0) < 0.1
+        lam = np.full((2000,), 4.0, np.float32)
+        p = np.asarray(pt.poisson(lam))
+        assert abs(p.mean() - 4.0) < 0.3
+        ref = np.zeros((3, 3), np.float32)
+        ri = np.asarray(pt.randint_like(ref, 5))
+        assert ri.shape == (3, 3) and ri.min() >= 0 and ri.max() < 5
+        e = np.asarray(pt.exponential(np.zeros(2000, np.float32), lam=2.0))
+        assert abs(e.mean() - 0.5) < 0.1

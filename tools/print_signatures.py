@@ -33,6 +33,7 @@ _MODULES = [
     "paddle_tpu.amp",
     "paddle_tpu.autograd",
     "paddle_tpu.io",
+    "paddle_tpu.linalg",
     "paddle_tpu.metric",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
