@@ -13,9 +13,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-
-from .framework.errors import enforce
 
 __all__ = [
     "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
@@ -101,12 +98,18 @@ def multi_dot(xs):
 
 def norm(x, p=None, axis=None, keepdim: bool = False):
     x = _arr(x)
+    if axis is None:
+        # paddle: Frobenius norm of the flattened tensor for any rank
+        flat = x.reshape(-1)
+        out = jnp.linalg.norm(flat, ord=2 if p in (None, "fro") else p)
+        if keepdim:
+            out = out.reshape((1,) * x.ndim)
+        return out
     if p is None:
-        p = "fro" if axis is None or not jnp.isscalar(axis) else 2
+        p = 2 if isinstance(axis, int) else "fro"
     if isinstance(axis, int):
         return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
-    return jnp.linalg.norm(x, ord=p, axis=tuple(axis) if axis else None,
-                           keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
 
 
 def pinv(x, rcond=1e-15, hermitian: bool = False):

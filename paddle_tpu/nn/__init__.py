@@ -12,3 +12,5 @@ from .layers import (GELU, SiLU, AdaptiveAvgPool2D, AvgPool2D,  # noqa: F401
                      MultiHeadAttention, NLLLoss, ReLU, ReLU6, RMSNorm,
                      Sigmoid, SmoothL1Loss, Softmax, Softplus, Tanh,
                      TransformerEncoder, TransformerEncoderLayer)
+from .rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401
+                  SimpleRNN, SimpleRNNCell)

@@ -144,10 +144,12 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     else:
         p = _pair(padding)
         pad = [(p[0], p[0]), (p[1], p[1])]
+    # paddle weights are OIHW for BOTH data formats (data_format only
+    # describes x); XLA's layout assignment handles the rest
     dn = lax.conv_dimension_numbers(
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
-        else ("NHWC", "HWIO", "NHWC"))
+        else ("NHWC", "OIHW", "NHWC"))
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,

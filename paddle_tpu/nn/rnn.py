@@ -1,0 +1,323 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py — RNNCellBase,
+SimpleRNNCell/LSTMCell/GRUCell, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU; the
+C++ side is operators/rnn_op + the fused CPU fusion_{gru,lstm} kernels).
+
+TPU-first design:
+- time recursion is a single ``lax.scan`` — one compiled loop body, no
+  per-step dispatch (the reference's CUDNN-descriptor path collapses into
+  XLA's while-loop + fused GEMMs);
+- the input projection for ALL timesteps is hoisted out of the scan as one
+  big (B*T, in)×(in, G*H) matmul — MXU-shaped — so the scan body only
+  carries the (B, H)×(H, G*H) recurrent GEMM;
+- gates are computed from a fused 4H/3H-wide projection, paddle's two-bias
+  (ih + hh) parameterization kept for state_dict parity;
+- variable-length batches mask state updates inside the scan
+  (sequence_length semantics of the reference op).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.errors import enforce
+from . import functional as F
+from .initializer import Uniform
+from .layer import Layer, LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    """Gate-fused single-step cell; ``gates`` = multiplier of hidden width."""
+
+    gates = 1
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = self.gates
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (input_size, g * hidden_size), default_initializer=init,
+            attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, g * hidden_size), default_initializer=init,
+            attr=weight_hh_attr)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter((g * hidden_size,), is_bias=True,
+                                  default_initializer=init,
+                                  attr=bias_ih_attr)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter((g * hidden_size,), is_bias=True,
+                                  default_initializer=init,
+                                  attr=bias_hh_attr)
+
+    def project_inputs(self, x):
+        """Input-side projection, hoistable across time: x @ W_ih + b_ih."""
+        y = x @ self.weight_ih
+        if self.bias_ih is not None:
+            y = y + self.bias_ih
+        return y
+
+    def get_initial_states(self, batch_size: int, dtype=jnp.float32):
+        """Zero state; tuple-state cells (LSTM, custom peephole cells…)
+        override this — downstream code keys off the returned structure,
+        never off the cell's class."""
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(x W_ih + b_ih + h W_hh + b_hh) (rnn.py SimpleRNNCell)."""
+
+    gates = 1
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: str = "tanh", **kw):
+        super().__init__(input_size, hidden_size, **kw)
+        enforce(activation in ("tanh", "relu"),
+                "SimpleRNNCell activation must be tanh or relu")
+        self.activation = activation
+
+    def step(self, xproj, h):
+        z = xproj + h @ self.weight_hh
+        if self.bias_hh is not None:
+            z = z + self.bias_hh
+        return jnp.tanh(z) if self.activation == "tanh" else F.relu(z)
+
+    def forward(self, inputs, states=None):
+        h = self.get_initial_states(inputs.shape[0], inputs.dtype) \
+            if states is None else states
+        h = self.step(self.project_inputs(inputs), h)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """i,f,g,o gate order (rnn.py LSTMCell; rnn_op GetGateValue order)."""
+
+    gates = 4
+
+    def get_initial_states(self, batch_size: int, dtype=jnp.float32):
+        z = jnp.zeros((batch_size, self.hidden_size), dtype)
+        return (z, z)
+
+    def step(self, xproj, state):
+        h, c = state
+        z = xproj + h @ self.weight_hh
+        if self.bias_hh is not None:
+            z = z + self.bias_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c = f * c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return h, c
+
+    def forward(self, inputs, states=None):
+        st = self.get_initial_states(inputs.shape[0], inputs.dtype) \
+            if states is None else states
+        h, c = self.step(self.project_inputs(inputs), st)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """r,z,c gate order with paddle's candidate form
+    c = tanh(x W_c + b_c + r*(h W_hc + b_hc)) (rnn.py GRUCell)."""
+
+    gates = 3
+
+    def step(self, xproj, h):
+        hproj = h @ self.weight_hh
+        if self.bias_hh is not None:
+            hproj = hproj + self.bias_hh
+        xr, xz, xc = jnp.split(xproj, 3, axis=-1)
+        hr, hz, hc = jnp.split(hproj, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        return (1.0 - z) * c + z * h
+
+    def forward(self, inputs, states=None):
+        h = self.get_initial_states(inputs.shape[0], inputs.dtype) \
+            if states is None else states
+        h = self.step(self.project_inputs(inputs), h)
+        return h, h
+
+
+def _scan_layer(cell: RNNCellBase, x_tbf, init_state, seq_lens=None,
+                reverse: bool = False):
+    """Run one cell over time-major (T, B, F) inputs with lax.scan.
+
+    Variable lengths: a step with t >= seq_len passes the previous state
+    through unchanged (output at padded steps is zeros, matching the
+    reference op's zero-padded output)."""
+    T, B = x_tbf.shape[0], x_tbf.shape[1]
+    xproj = cell.project_inputs(x_tbf.reshape(T * B, -1)).reshape(T, B, -1)
+    steps = jnp.arange(T)
+    if reverse:
+        xproj = jnp.flip(xproj, axis=0)
+        steps = jnp.flip(steps, axis=0)
+
+    is_tuple = isinstance(init_state, tuple)
+
+    def body(state, inp):
+        xp, t = inp
+        new_state = cell.step(xp, state)
+        h_new = new_state[0] if is_tuple else new_state
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]
+            if is_tuple:       # carry every state leaf through padded steps
+                new_state = tuple(jnp.where(valid, n, p)
+                                  for n, p in zip(new_state, state))
+            else:
+                new_state = jnp.where(valid, h_new, state)
+            out = jnp.where(valid, h_new, jnp.zeros_like(h_new))
+        else:
+            out = h_new
+        return new_state, out
+
+    final, outs = lax.scan(body, init_state, (xproj, steps))
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    return outs, final
+
+
+class RNN(Layer):
+    """Generic scan wrapper over any cell (rnn.py class RNN)."""
+
+    def __init__(self, cell: RNNCellBase, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        init = self.cell.get_initial_states(x.shape[1], x.dtype) \
+            if initial_states is None else initial_states
+        outs, final = _scan_layer(self.cell, x, init, sequence_length,
+                                  self.is_reverse)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, concat outputs (rnn.py class BiRNN)."""
+
+    def __init__(self, cell_fw: RNNCellBase, cell_bw: RNNCellBase,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        B = x.shape[1]
+        if initial_states is None:
+            init_fw = self.cell_fw.get_initial_states(B, x.dtype)
+            init_bw = self.cell_bw.get_initial_states(B, x.dtype)
+        else:
+            init_fw, init_bw = initial_states
+        out_fw, fin_fw = _scan_layer(self.cell_fw, x, init_fw,
+                                     sequence_length, reverse=False)
+        out_bw, fin_bw = _scan_layer(self.cell_bw, x, init_bw,
+                                     sequence_length, reverse=True)
+        outs = jnp.concatenate([out_fw, out_bw], axis=-1)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, (fin_fw, fin_bw)
+
+
+class _StackedRNN(Layer):
+    """Shared driver for SimpleRNN/LSTM/GRU: num_layers × {forward or
+    bidirect} with inter-layer dropout (rnn.py _RNNBase)."""
+
+    cell_cls = SimpleRNNCell
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1, direction: str = "forward",
+                 time_major: bool = False, dropout: float = 0.0,
+                 **cell_kw):
+        super().__init__()
+        enforce(direction in ("forward", "bidirect", "bidirectional"),
+                f"unknown direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirect = direction != "forward"
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_directions = 2 if self.bidirect else 1
+
+        cells = []
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 \
+                else hidden_size * self.num_directions
+            cells.append(self.cell_cls(in_sz, hidden_size, **cell_kw))
+            if self.bidirect:
+                cells.append(self.cell_cls(in_sz, hidden_size, **cell_kw))
+        self.cells = LayerList(cells)
+
+    def _tuple_state(self) -> bool:
+        return isinstance(self.cells[0].get_initial_states(1), tuple)
+
+    def _split_states(self, initial_states, B, dtype):
+        """(L*D, B, H) stacked tensors → per-cell states."""
+        n = self.num_layers * self.num_directions
+        if initial_states is None:
+            return [self.cells[i].get_initial_states(B, dtype)
+                    for i in range(n)]
+        if self._tuple_state():
+            h0, c0 = initial_states
+            return [(h0[i], c0[i]) for i in range(n)]
+        return [initial_states[i] for i in range(n)]
+
+    def _stack_finals(self, finals):
+        if isinstance(finals[0], tuple):
+            return (jnp.stack([f[0] for f in finals]),
+                    jnp.stack([f[1] for f in finals]))
+        return jnp.stack(finals)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        states = self._split_states(initial_states, x.shape[1], x.dtype)
+        finals = []
+        for layer_i in range(self.num_layers):
+            if layer_i > 0 and self.dropout > 0:
+                x = F.dropout(x, self.dropout, training=self.training)
+            ci = layer_i * self.num_directions
+            out_fw, fin_fw = _scan_layer(self.cells[ci], x, states[ci],
+                                         sequence_length, reverse=False)
+            finals.append(fin_fw)
+            if self.bidirect:
+                out_bw, fin_bw = _scan_layer(self.cells[ci + 1], x,
+                                             states[ci + 1],
+                                             sequence_length, reverse=True)
+                finals.append(fin_bw)
+                x = jnp.concatenate([out_fw, out_bw], axis=-1)
+            else:
+                x = out_fw
+        outs = x if self.time_major else jnp.swapaxes(x, 0, 1)
+        return outs, self._stack_finals(finals)
+
+
+class SimpleRNN(_StackedRNN):
+    cell_cls = SimpleRNNCell
+
+
+class LSTM(_StackedRNN):
+    cell_cls = LSTMCell
+
+
+class GRU(_StackedRNN):
+    cell_cls = GRUCell

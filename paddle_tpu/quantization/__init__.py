@@ -321,10 +321,9 @@ class Int8Conv2D(Layer):
         w = layer.weight.value if isinstance(layer.weight, Parameter) \
             else layer.weight
         self._data_format = layer._data_format
-        # weight layout follows F.conv2d's contract: OIHW for NCHW inputs,
-        # HWIO for NHWC — the output-channel axis moves with it
-        out_axis = 0 if self._data_format == "NCHW" else 3
-        q, s = quantize_weight_to_int(w, bits, channel_axis=out_axis)
+        # weight layout is OIHW for both data formats (paddle contract:
+        # data_format describes x only) — output channels are axis 0
+        q, s = quantize_weight_to_int(w, bits, channel_axis=0)
         self.register_buffer("qweight", q)
         self.bias = layer.bias
         self.bits = bits
@@ -356,7 +355,7 @@ class Int8Conv2D(Layer):
         dn = lax.conv_dimension_numbers(
             x.shape, self._buffers["qweight"].shape,
             ("NCHW", "OIHW", "NCHW") if self._data_format == "NCHW"
-            else ("NHWC", "HWIO", "NHWC"))
+            else ("NHWC", "OIHW", "NHWC"))
         acc = lax.conv_general_dilated(
             xq, self._buffers["qweight"], window_strides=stride,
             padding=pad, rhs_dilation=dil, dimension_numbers=dn,

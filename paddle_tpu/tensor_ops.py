@@ -160,6 +160,11 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale: bool = True,
           act=None):
     x = _arr(x)
     y = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        from .nn import functional as F
+        fn = getattr(F, act, None)
+        enforce(fn is not None, f"scale: unknown activation {act!r}")
+        y = fn(y)
     return y
 
 
@@ -224,7 +229,9 @@ def is_integer(x) -> bool:
 def cross(x, y, axis: int = 9):
     x, y = _arr(x), _arr(y)
     if axis == 9:  # paddle default: first axis of size 3
-        axis = next(i for i, d in enumerate(x.shape) if d == 3)
+        axis = next((i for i, d in enumerate(x.shape) if d == 3), None)
+        enforce(axis is not None,
+                "cross: no dimension of size 3 found; pass axis explicitly")
     return jnp.cross(x, y, axis=axis)
 
 
@@ -480,10 +487,13 @@ def multinomial(x, num_samples: int = 1, replacement: bool = False):
     key = fw_random.next_key()
     logits = jnp.log(jnp.maximum(x, 1e-30))
     if replacement:
-        return jax.random.categorical(
-            key, logits, axis=-1,
-            shape=(*x.shape[:-1], num_samples) if x.ndim > 1
-            else (num_samples,)).astype(jnp.int64)
+        # categorical's shape prepends to the batch dims: draw
+        # (num_samples, *batch) then move samples last — (batch, n) out
+        batch = x.shape[:-1]
+        draws = jax.random.categorical(key, logits, axis=-1,
+                                       shape=(num_samples, *batch))
+        return jnp.moveaxis(draws, 0, -1).astype(jnp.int64) if batch \
+            else draws.astype(jnp.int64)
     enforce(num_samples <= x.shape[-1],
             "cannot draw more samples than categories without replacement")
     # Gumbel top-k trick: without-replacement sampling
@@ -506,9 +516,12 @@ def randint_like(x, low, high=None, dtype=None):
     x = _arr(x)
     if high is None:
         low, high = 0, low
-    return jax.random.randint(
-        fw_random.next_key(), x.shape, low, high,
-        convert_dtype(dtype) if dtype else jnp.int64)
+    out_dtype = convert_dtype(dtype) if dtype else x.dtype  # paddle: match x
+    draw_dtype = out_dtype if jnp.issubdtype(out_dtype, jnp.integer) \
+        else jnp.int32
+    out = jax.random.randint(fw_random.next_key(), x.shape, low, high,
+                             draw_dtype)
+    return out.astype(out_dtype)
 
 
 def exponential(x, lam: float = 1.0):

@@ -332,6 +332,36 @@ class TestRandomOps:
                                               replacement=False))
         assert sorted(noreplace.tolist()) == [0, 1, 2]
 
+    def test_multinomial_batched(self):
+        pt.seed(5)
+        probs = np.tile(np.asarray([0.0, 0.5, 0.5], np.float32), (4, 1))
+        draws = np.asarray(pt.multinomial(probs, 6, replacement=True))
+        assert draws.shape == (4, 6)
+        assert set(np.unique(draws)).issubset({1, 2})
+
+    def test_linalg_norm_any_rank_default(self):
+        x = R.randn(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(pt.linalg.norm(x)),
+                                   np.linalg.norm(x.ravel()), rtol=1e-5)
+        v = R.randn(5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(pt.linalg.norm(v)),
+                                   np.linalg.norm(v), rtol=1e-6)
+
+    def test_scale_applies_activation(self):
+        x = np.asarray([-2.0, 0.5], np.float32)
+        out = np.asarray(pt.scale(x, scale=2.0, act="relu"))
+        np.testing.assert_allclose(out, [0.0, 1.0])
+
+    def test_cross_without_3dim_raises(self):
+        with pytest.raises(Exception):
+            pt.cross(np.ones((2, 4), np.float32),
+                     np.ones((2, 4), np.float32))
+
+    def test_randint_like_matches_dtype(self):
+        ref = np.zeros((2, 2), np.float32)
+        out = pt.randint_like(ref, 5)
+        assert out.dtype == jnp.float32
+
     def test_standard_normal_poisson_randint_like(self):
         pt.seed(1)
         z = np.asarray(pt.standard_normal((2000,)))
