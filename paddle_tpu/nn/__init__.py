@@ -12,5 +12,14 @@ from .layers import (GELU, SiLU, AdaptiveAvgPool2D, AvgPool2D,  # noqa: F401
                      MultiHeadAttention, NLLLoss, ReLU, ReLU6, RMSNorm,
                      Sigmoid, SmoothL1Loss, Softmax, Softplus, Tanh,
                      TransformerEncoder, TransformerEncoderLayer)
+from .layers import (AdaptiveMaxPool2D, AvgPool1D, Conv1D, Conv3D,  # noqa: F401
+                     Conv2DTranspose, CosineEmbeddingLoss, CosineSimilarity,
+                     CTCLoss, GLULayer, HingeEmbeddingLoss, Identity,
+                     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+                     KLDivLoss, MarginRankingLoss, MaxPool1D,
+                     PairwiseDistance, PixelShuffle, PixelUnshuffle, PReLU,
+                     SpectralNorm, Transformer, TransformerDecoder,
+                     TransformerDecoderLayer, TripletMarginLoss, Unflatten,
+                     Upsample, UpsamplingBilinear2D, UpsamplingNearest2D)
 from .rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401
                   SimpleRNN, SimpleRNNCell)

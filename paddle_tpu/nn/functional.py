@@ -545,3 +545,281 @@ def flatten(x, start_axis: int = 0, stop_axis: int = -1):
         stop_axis += nd
     shape = x.shape[:start_axis] + (-1,) + x.shape[stop_axis + 1:]
     return x.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Extended conv/pool family (reference phi conv3d/conv2d_transpose/pool ops)
+# ---------------------------------------------------------------------------
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    """x: (N,C,D,H,W), weight: (O, I/g, kD, kH, kW) — reference conv3d_op."""
+    x, weight = amp_state.cast_for_op("conv2d", _arr(x), _arr(weight))
+    trip = lambda v: (v, v, v) if isinstance(v, int) else tuple(v)
+    stride, dilation = trip(stride), trip(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = trip(padding)
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        b = _arr(bias).astype(y.dtype)
+        y = y + (b[None, :, None, None, None] if data_format == "NCDHW"
+                 else b)
+    return y
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     data_format: str = "NCHW"):
+    """Gradient-of-conv deconvolution (reference conv2d_transpose_op).
+
+    weight layout (in_ch, out_ch/groups, kh, kw) — paddle's IOHW transpose
+    convention.  Implemented as lax.conv_transpose with explicit padding
+    arithmetic: out = (in-1)*s - 2*p + d*(k-1) + 1 + output_padding.
+    """
+    x, weight = amp_state.cast_for_op("conv2d", _arr(x), _arr(weight))
+    s, d = _pair(stride), _pair(dilation)
+    p, op = _pair(padding), _pair(output_padding)
+    kh = (weight.shape[2] - 1) * d[0] + 1
+    kw = (weight.shape[3] - 1) * d[1] + 1
+    # lax.conv_transpose padding is on the *output* grid
+    pad = [(kh - 1 - p[0], kh - 1 - p[0] + op[0]),
+           (kw - 1 - p[1], kw - 1 - p[1] + op[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, (weight.shape[1] * groups, weight.shape[0] // groups,
+                  weight.shape[2], weight.shape[3]),
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    # flip spatial dims + swap in/out channels: conv_transpose as a
+    # dilated conv with the mirrored kernel.  Grouped: input-channel block
+    # g maps to output block g — reorder to (out, in/g, kh, kw)
+    w = jnp.flip(weight, axis=(2, 3))          # (in, out/g, kh, kw)
+    in_g = weight.shape[0] // groups
+    w = w.reshape(groups, in_g, weight.shape[1], *weight.shape[2:])
+    w = jnp.swapaxes(w, 1, 2)                  # (g, out/g, in_g, kh, kw)
+    w = w.reshape(groups * weight.shape[1], in_g, *weight.shape[2:])
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=s,
+        rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        b = _arr(bias).astype(y.dtype)
+        y = y + (b[None, :, None, None] if data_format == "NCHW" else b)
+    return y
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    # x: (N, C, L)
+    y = max_pool2d(x[..., None, :], (1, kernel_size),
+                   (1, stride if stride is not None else kernel_size),
+                   (0, padding))
+    return y[..., 0, :]
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    y = avg_pool2d(x[..., None, :], (1, kernel_size),
+                   (1, stride if stride is not None else kernel_size),
+                   (0, padding))
+    return y[..., 0, :]
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    x = _arr(x)
+    out_h, out_w = _pair(output_size)
+    if data_format == "NCHW":
+        in_h, in_w = x.shape[2], x.shape[3]
+    else:
+        in_h, in_w = x.shape[1], x.shape[2]
+    enforce(in_h % out_h == 0 and in_w % out_w == 0,
+            "adaptive_max_pool2d requires divisible sizes")
+    return max_pool2d(x, (in_h // out_h, in_w // out_w),
+                      stride=(in_h // out_h, in_w // out_w),
+                      data_format=data_format)
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    """(N, C*r^2, H, W) → (N, C, H*r, W*r) — reference pixel_shuffle_op."""
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def prelu(x, weight):
+    x, w = _arr(x), _arr(weight)
+    if w.size > 1 and x.ndim > 1:       # per-channel (NCHW channel axis 1)
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, w * x)
+
+
+def glu(x, axis: int = -1):
+    a, b = jnp.split(_arr(x), 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    x1, x2 = _arr(x1), _arr(x2)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False):
+    d = _arr(x) - _arr(y) + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# Extended losses (reference kldiv_loss_op, margin_rank_loss_op,
+# hinge_loss_op, warpctc_op)
+# ---------------------------------------------------------------------------
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def kl_div(input, label, reduction: str = "mean"):
+    """input is log-probabilities, label is probabilities (kldiv_loss_op).
+    'mean' follows paddle: batchmean-style mean over all elements."""
+    input, label = _arr(input), _arr(label)
+    loss = jnp.where(label > 0, label * (jnp.log(jnp.maximum(label, 1e-30))
+                                         - input), 0.0)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean"):
+    loss = jnp.maximum(0.0, -_arr(label) * (_arr(input) - _arr(other))
+                       + margin)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean"):
+    input, label = _arr(input), _arr(label)
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean"):
+    sim = cosine_similarity(input1, input2, axis=-1)
+    loss = jnp.where(_arr(label) == 1, 1.0 - sim,
+                     jnp.maximum(0.0, sim - margin))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6,
+                        swap: bool = False, reduction: str = "mean"):
+    dp = pairwise_distance(anchor, positive, p, epsilon)
+    dn = pairwise_distance(anchor, negative, p, epsilon)
+    if swap:
+        dn = jnp.minimum(dn, pairwise_distance(positive, negative, p,
+                                               epsilon))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank: int = 0, reduction: str = "mean"):
+    """CTC forward loss via the standard alpha recursion in log space
+    (reference warpctc_op semantics; norm_by_times=False).
+
+    log_probs: (T, B, C) log-softmax outputs; labels: (B, S) padded with
+    any value beyond label_lengths.  One lax.scan over time — the DP state
+    is the (B, 2S+1) alpha lattice, so the whole loss is one fused TPU
+    loop, no host round trips.
+    """
+    log_probs = _arr(log_probs)
+    labels = _arr(labels).astype(jnp.int32)
+    T, B, C = log_probs.shape
+    S = labels.shape[1]
+    NEG = jnp.asarray(-1e30, log_probs.dtype)
+
+    # extended label sequence: blank l1 blank l2 ... lS blank  (2S+1)
+    ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_len = 2 * label_lengths.astype(jnp.int32) + 1
+
+    # can alpha skip from s-2? only when ext[s] != blank and != ext[s-2]
+    can_skip = jnp.zeros((B, 2 * S + 1), bool)
+    if S > 1:
+        can_skip = can_skip.at[:, 3::2].set(labels[:, 1:] != labels[:, :-1])
+    elif S == 1:
+        pass
+    can_skip = can_skip.at[:, 1].set(False)
+
+    pos = jnp.arange(2 * S + 1)[None, :]
+    valid = pos < ext_len[:, None]
+
+    emit0 = jnp.take_along_axis(log_probs[0][:, None, :].repeat(
+        2 * S + 1, axis=1), ext[..., None], axis=-1)[..., 0]
+    alpha0 = jnp.where(pos <= 1, emit0, NEG)
+    alpha0 = jnp.where(valid, alpha0, NEG)
+
+    def step(alpha, lp_t):
+        # lp_t: (B, C) log probs at time t
+        emit = jnp.take_along_axis(lp_t[:, None, :].repeat(
+            2 * S + 1, axis=1), ext[..., None], axis=-1)[..., 0]
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]],
+                                   axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]],
+                                   axis=1)
+        a_shift2 = jnp.where(can_skip, a_shift2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+        new = jnp.where(valid, merged + emit, NEG)
+        return new, None
+
+    # keep per-step alphas: sequences shorter than T stop at their own
+    # input length, gathered at t = input_lengths - 1
+    def step_keep(alpha, lp_t):
+        new, _ = step(alpha, lp_t)
+        return new, new
+    _, alphas = lax.scan(step_keep, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)   # (T, B, 2S+1)
+    t_idx = (input_lengths.astype(jnp.int32) - 1)[None, :, None]
+    final = jnp.take_along_axis(alphas, jnp.broadcast_to(
+        t_idx, (1, B, 2 * S + 1)), axis=0)[0]                  # (B, 2S+1)
+    last = jnp.take_along_axis(final, (ext_len - 1)[:, None], axis=1)[:, 0]
+    second_last = jnp.take_along_axis(
+        final, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    # zero-length labels have a single lattice cell: no second path
+    second_last = jnp.where(ext_len >= 2, second_last, NEG)
+    ll = jnp.logaddexp(last, second_last)
+    loss = -ll
+    if reduction == "mean":   # paddle/torch: divide by label length
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    return _reduce(loss, reduction)

@@ -8,6 +8,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.dtype import convert_dtype
@@ -438,3 +439,501 @@ class TransformerEncoder(Layer):
         if self.norm is not None:
             src = self.norm(src)
         return src
+
+
+class TransformerDecoderLayer(Layer):
+    """Reference: nn/layer/transformer.py TransformerDecoderLayer —
+    self-attn (causal) + cross-attn + FFN, pre/post-LN switchable."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, dtype="float32"):
+        super().__init__()
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad,
+                                            dtype=dtype)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad,
+                                             dtype=dtype)
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype)
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype)
+        self.norm1 = LayerNorm(d_model, dtype=dtype)
+        self.norm2 = LayerNorm(d_model, dtype=dtype)
+        self.norm3 = LayerNorm(d_model, dtype=dtype)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.act_dropout = Dropout(
+            act_dropout if act_dropout is not None else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+        else:
+            tgt, new_cache = self.self_attn(tgt, attn_mask=tgt_mask,
+                                            cache=cache)
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.act_dropout(self.activation(
+            self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt if cache is None else (tgt, new_cache)
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        self.layers = LayerList([decoder_layer_fn()
+                                 for _ in range(num_layers)])
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                tgt = layer(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+            else:
+                tgt, c = layer(tgt, memory, tgt_mask=tgt_mask,
+                               memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(c)
+        if self.norm is not None:
+            tgt = self.norm(tgt)
+        return tgt if cache is None else (tgt, new_caches)
+
+
+class Transformer(Layer):
+    """Full encoder-decoder (reference nn/layer/transformer.py Transformer)."""
+
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation: str = "relu", normalize_before: bool = False):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        mk_enc = lambda: TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout, activation,
+            normalize_before=normalize_before)
+        mk_dec = lambda: TransformerDecoderLayer(
+            d_model, nhead, dim_feedforward, dropout, activation,
+            normalize_before=normalize_before)
+        norm = LayerNorm(d_model) if normalize_before else None
+        self.encoder = TransformerEncoder(mk_enc, num_encoder_layers,
+                                          norm=norm)
+        self.decoder = TransformerDecoder(
+            mk_dec, num_decoder_layers,
+            norm=LayerNorm(d_model) if normalize_before else None)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length: int):
+        mask = jnp.triu(jnp.full((length, length), float(jnp.finfo(
+            jnp.float32).min)), k=1)
+        return mask
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+
+# ---------------------------------------------------------------------------
+# Extended conv/pool/norm/activation layers (reference nn/layer/{conv,
+# pooling,norm,activation,vision,distance,loss}.py)
+# ---------------------------------------------------------------------------
+class Conv1D(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int,
+                 stride: int = 1, padding: int = 0, dilation: int = 1,
+                 groups: int = 1, weight_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        fan_in = in_channels * kernel_size // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kernel_size), dtype=dtype,
+            default_initializer=I.Uniform(-bound, bound), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), dtype=dtype, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound), attr=bias_attr)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride,
+                        self.padding, self.dilation, self.groups)
+
+
+class Conv3D(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 dtype="float32"):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k[0] * k[1] * k[2] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *k), dtype=dtype,
+            default_initializer=I.Uniform(-bound, bound), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), dtype=dtype, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound), attr=bias_attr)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride,
+                        self.padding, self.dilation, self.groups,
+                        self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    """Reference nn/layer/conv.py Conv2DTranspose (IOHW weights)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, output_padding=0, dilation=1,
+                 groups: int = 1, weight_attr=None, bias_attr=None,
+                 data_format="NCHW", dtype="float32"):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation = output_padding, dilation
+        self.groups = groups
+        self.data_format = data_format
+        fan_in = in_channels * k[0] * k[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, k[0], k[1]), dtype=dtype,
+            default_initializer=I.Uniform(-bound, bound), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), dtype=dtype, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound), attr=bias_attr)
+
+    def forward(self, x, output_size=None):
+        out_pad = self.output_padding
+        if output_size is not None:
+            # derive the extra padding so the output hits output_size
+            # exactly: out = (in-1)*s - 2p + d*(k-1) + 1 + out_pad
+            s = (self.stride, self.stride) \
+                if isinstance(self.stride, int) else tuple(self.stride)
+            p = (self.padding, self.padding) \
+                if isinstance(self.padding, int) else tuple(self.padding)
+            d = (self.dilation, self.dilation) \
+                if isinstance(self.dilation, int) else tuple(self.dilation)
+            hw = x.shape[2:4] if self.data_format == "NCHW" else x.shape[1:3]
+            k = self.weight.shape[2:4]
+            out_pad = []
+            for i in range(2):
+                base = (hw[i] - 1) * s[i] - 2 * p[i] \
+                    + d[i] * (k[i] - 1) + 1
+                extra = int(output_size[i]) - base
+                from ..framework.errors import enforce
+                enforce(0 <= extra < s[i] or (extra == 0 and s[i] == 1),
+                        f"output_size[{i}]={output_size[i]} unreachable "
+                        f"(base {base}, stride {s[i]})")
+                out_pad.append(extra)
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, out_pad,
+                                  self.dilation, self.groups,
+                                  self.data_format)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    """Per-sample, per-channel normalization (reference instance_norm_op)."""
+
+    def __init__(self, num_features: int, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.scale = None
+        else:
+            self.scale = self.create_parameter(
+                (num_features,), dtype=dtype,
+                default_initializer=I.Constant(1.0), attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (num_features,), dtype=dtype, is_bias=True, attr=bias_attr)
+
+    def forward(self, x):
+        x = x.__jax_array__() if hasattr(x, "__jax_array__") else x
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        if self.scale is not None:
+            y = y * self.scale.value.reshape(shape)
+        if self.bias is not None:
+            y = y + self.bias.value.reshape(shape)
+        return y
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class SpectralNorm(Layer):
+    """Weight spectral normalization via power iteration (reference
+    spectral_norm_op; stateful u/v buffers updated in train mode)."""
+
+    def __init__(self, weight_shape, dim: int = 0, power_iters: int = 1,
+                 epsilon: float = 1e-12, dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        from ..framework import random as fw_random
+        self.register_buffer("weight_u", jax.random.normal(
+            fw_random.next_key(), (h,), convert_dtype(dtype)))
+        self.register_buffer("weight_v", jax.random.normal(
+            fw_random.next_key(), (w,), convert_dtype(dtype)))
+
+    def forward(self, weight):
+        weight = weight.__jax_array__() if hasattr(weight, "__jax_array__") \
+            else weight
+        w = jnp.moveaxis(weight, self.dim, 0).reshape(weight.shape[self.dim],
+                                                      -1)
+        u, v = self._buffers["weight_u"], self._buffers["weight_v"]
+        for _ in range(self.power_iters):
+            v = w.T @ u
+            v = v / (jnp.linalg.norm(v) + self.epsilon)
+            u = w @ v
+            u = u / (jnp.linalg.norm(u) + self.epsilon)
+        if self.training:
+            self._update_buffer("weight_u", jax.lax.stop_gradient(u))
+            self._update_buffer("weight_v", jax.lax.stop_gradient(v))
+        sigma = u @ w @ v
+        return weight / sigma
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25,
+                 weight_attr=None, dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), dtype=dtype,
+            default_initializer=I.Constant(init), attr=weight_attr)
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Unflatten(Layer):
+    def __init__(self, axis: int, shape):
+        super().__init__()
+        self.axis, self.shape = axis, tuple(shape)
+
+    def forward(self, x):
+        x = x.__jax_array__() if hasattr(x, "__jax_array__") else x
+        ax = self.axis % x.ndim
+        return x.reshape(x.shape[:ax] + self.shape + x.shape[ax + 1:])
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.data_format = mode, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size, scale_factor, "bilinear", data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size, scale_factor, "nearest", data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format="NCHW"):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class GLULayer(Layer):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin: float = 1.0, reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin,
+                                      self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self.margin,
+                                       self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin: float = 1.0, p: float = 2.0,
+                 epsilon: float = 1e-6, swap: bool = False,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.p = margin, p
+        self.epsilon, self.swap, self.reduction = epsilon, swap, reduction
+
+    def forward(self, anchor, positive, negative):
+        return F.triplet_margin_loss(anchor, positive, negative,
+                                     self.margin, self.p, self.epsilon,
+                                     self.swap, self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction)

@@ -1,0 +1,323 @@
+"""Extended nn surface tests: torch golden parity for conv_transpose /
+conv3d / CTC / distance-losses, shape checks for the rest, a seq2seq
+Transformer smoke train.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+torch = pytest.importorskip("torch")
+R = np.random.RandomState(0)
+
+
+class TestConvFamily:
+    @pytest.mark.parametrize("stride,padding,output_padding", [
+        (1, 0, 0), (2, 1, 0), (2, 1, 1), (3, 2, 1)])
+    def test_conv2d_transpose_matches_torch(self, stride, padding,
+                                            output_padding):
+        x = R.randn(2, 3, 8, 8).astype(np.float32)
+        w = R.randn(3, 4, 3, 3).astype(np.float32)   # (in, out, kh, kw)
+        b = R.randn(4).astype(np.float32)
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+            stride=stride, padding=padding,
+            output_padding=output_padding).numpy()
+        got = np.asarray(F.conv2d_transpose(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), stride=stride,
+            padding=padding, output_padding=output_padding))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_grouped(self):
+        x = R.randn(1, 4, 6, 6).astype(np.float32)
+        w = R.randn(4, 2, 3, 3).astype(np.float32)   # groups=2: out=4
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2,
+            padding=1, groups=2).numpy()
+        got = np.asarray(F.conv2d_transpose(
+            jnp.asarray(x), jnp.asarray(w), stride=2, padding=1, groups=2))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv3d_matches_torch(self):
+        x = R.randn(2, 3, 5, 6, 7).astype(np.float32)
+        w = R.randn(4, 3, 3, 3, 3).astype(np.float32)
+        b = R.randn(4).astype(np.float32)
+        want = torch.nn.functional.conv3d(
+            torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+            stride=2, padding=1).numpy()
+        got = np.asarray(F.conv3d(jnp.asarray(x), jnp.asarray(w),
+                                  jnp.asarray(b), stride=2, padding=1))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv_layers_shapes(self):
+        pt.seed(0)
+        y = nn.Conv1D(3, 8, 3, padding=1)(jnp.zeros((2, 3, 16)))
+        assert y.shape == (2, 8, 16)
+        y = nn.Conv3D(2, 4, 3, padding=1)(jnp.zeros((1, 2, 4, 4, 4)))
+        assert y.shape == (1, 4, 4, 4, 4)
+        y = nn.Conv2DTranspose(4, 6, 4, stride=2, padding=1)(
+            jnp.zeros((1, 4, 8, 8)))
+        assert y.shape == (1, 6, 16, 16)
+        # output_size derives the output padding (paddle call form)
+        deconv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1)
+        assert deconv(jnp.zeros((1, 4, 5, 5))).shape == (1, 6, 9, 9)
+        assert deconv(jnp.zeros((1, 4, 5, 5)),
+                      output_size=(10, 10)).shape == (1, 6, 10, 10)
+
+
+class TestPoolNormAct:
+    def test_pool1d(self):
+        x = jnp.asarray(R.randn(2, 3, 16), jnp.float32)
+        assert nn.MaxPool1D(2)(x).shape == (2, 3, 8)
+        assert nn.AvgPool1D(4, stride=4)(x).shape == (2, 3, 4)
+        got = np.asarray(nn.MaxPool1D(2)(x))
+        want = torch.nn.functional.max_pool1d(
+            torch.from_numpy(np.asarray(x)), 2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_adaptive_max_pool(self):
+        x = jnp.asarray(R.randn(2, 3, 8, 8), jnp.float32)
+        got = np.asarray(nn.AdaptiveMaxPool2D((2, 2))(x))
+        want = torch.nn.functional.adaptive_max_pool2d(
+            torch.from_numpy(np.asarray(x)), (2, 2)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_instance_norm_matches_torch(self):
+        x = R.randn(2, 4, 8, 8).astype(np.float32)
+        pt.seed(0)
+        inorm = nn.InstanceNorm2D(4)
+        got = np.asarray(inorm(jnp.asarray(x)))
+        want = torch.nn.functional.instance_norm(
+            torch.from_numpy(x), weight=torch.ones(4),
+            bias=torch.zeros(4)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_spectral_norm_unit_sigma(self):
+        pt.seed(0)
+        w = jnp.asarray(R.randn(8, 16), jnp.float32)
+        sn = nn.SpectralNorm(w.shape, power_iters=20)
+        sn.train()
+        wn = sn(w)
+        s = np.linalg.svd(np.asarray(wn), compute_uv=False)
+        assert abs(s[0] - 1.0) < 1e-3
+
+    def test_prelu_pixelshuffle_glu(self):
+        pt.seed(0)
+        x = jnp.asarray(R.randn(2, 4, 4, 4), jnp.float32)
+        y = nn.PReLU(4, init=0.1)(x)
+        np.testing.assert_allclose(
+            np.asarray(y),
+            np.where(np.asarray(x) >= 0, np.asarray(x), 0.1 * np.asarray(x)),
+            rtol=1e-6)
+        ps = nn.PixelShuffle(2)(x)
+        assert ps.shape == (2, 1, 8, 8)
+        back = nn.PixelUnshuffle(2)(ps)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+        want = torch.nn.functional.pixel_shuffle(
+            torch.from_numpy(np.asarray(x)), 2).numpy()
+        np.testing.assert_allclose(np.asarray(ps), want)
+        g = nn.GLULayer(-1)(jnp.asarray(R.randn(2, 8), jnp.float32))
+        assert g.shape == (2, 4)
+
+    def test_upsample(self):
+        x = jnp.asarray(R.randn(1, 2, 4, 4), jnp.float32)
+        assert nn.Upsample(scale_factor=2)(x).shape == (1, 2, 8, 8)
+        assert nn.UpsamplingBilinear2D(size=(6, 6))(x).shape == (1, 2, 6, 6)
+        assert nn.Unflatten(1, (1, 2))(jnp.zeros((3, 2, 5))).shape \
+            == (3, 1, 2, 5)
+        assert nn.Identity()(x) is x
+
+
+class TestLosses:
+    def test_kl_div_matches_torch(self):
+        logp = torch.log_softmax(torch.randn(4, 5), dim=-1)
+        target = torch.softmax(torch.randn(4, 5), dim=-1)
+        want = torch.nn.functional.kl_div(logp, target,
+                                          reduction="mean").item()
+        got = float(F.kl_div(jnp.asarray(logp.numpy()),
+                             jnp.asarray(target.numpy()), "mean"))
+        assert abs(got - want) < 1e-5
+
+    def test_margin_ranking_matches_torch(self):
+        a, b = torch.randn(6), torch.randn(6)
+        y = torch.sign(torch.randn(6)) + 0.0
+        y[y == 0] = 1.0
+        want = torch.nn.functional.margin_ranking_loss(
+            a, b, y, margin=0.3).item()
+        got = float(nn.MarginRankingLoss(margin=0.3)(
+            jnp.asarray(a.numpy()), jnp.asarray(b.numpy()),
+            jnp.asarray(y.numpy())))
+        assert abs(got - want) < 1e-5
+
+    def test_triplet_and_cosine_losses(self):
+        a, p, n = (torch.randn(4, 8) for _ in range(3))
+        want = torch.nn.functional.triplet_margin_loss(a, p, n).item()
+        got = float(nn.TripletMarginLoss()(
+            jnp.asarray(a.numpy()), jnp.asarray(p.numpy()),
+            jnp.asarray(n.numpy())))
+        assert abs(got - want) < 1e-4
+        y = torch.tensor([1.0, -1.0, 1.0, -1.0])
+        want = torch.nn.functional.cosine_embedding_loss(a, p, y).item()
+        got = float(nn.CosineEmbeddingLoss()(
+            jnp.asarray(a.numpy()), jnp.asarray(p.numpy()),
+            jnp.asarray(y.numpy())))
+        assert abs(got - want) < 1e-4
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_ctc_loss_matches_torch(self, reduction):
+        T, B, C, S = 12, 3, 6, 5
+        g = torch.Generator().manual_seed(0)
+        logits = torch.randn(T, B, C, generator=g)
+        log_probs = torch.log_softmax(logits, dim=-1)
+        labels = torch.randint(1, C, (B, S), generator=g)
+        in_lens = torch.tensor([12, 10, 7])
+        lab_lens = torch.tensor([5, 3, 2])
+        want = torch.nn.functional.ctc_loss(
+            log_probs, labels, in_lens, lab_lens, blank=0,
+            reduction=reduction, zero_infinity=False)
+        got = F.ctc_loss(jnp.asarray(log_probs.numpy()),
+                         jnp.asarray(labels.numpy()),
+                         jnp.asarray(in_lens.numpy()),
+                         jnp.asarray(lab_lens.numpy()),
+                         blank=0, reduction=reduction)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_repeated_labels(self):
+        """Repeated labels exercise the no-skip rule (a-a needs a blank)."""
+        T, B, C = 10, 2, 5
+        g = torch.Generator().manual_seed(1)
+        log_probs = torch.log_softmax(torch.randn(T, B, C, generator=g), -1)
+        labels = torch.tensor([[2, 2, 3], [1, 1, 1]])
+        in_lens = torch.tensor([10, 10])
+        lab_lens = torch.tensor([3, 3])
+        want = torch.nn.functional.ctc_loss(
+            log_probs, labels, in_lens, lab_lens, blank=0,
+            reduction="none")
+        got = F.ctc_loss(jnp.asarray(log_probs.numpy()),
+                         jnp.asarray(labels.numpy()),
+                         jnp.asarray(in_lens.numpy()),
+                         jnp.asarray(lab_lens.numpy()), reduction="none")
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_zero_length_label(self):
+        """Empty targets: loss is -sum log p(blank), no doubled path."""
+        T, B, C = 6, 2, 4
+        g = torch.Generator().manual_seed(2)
+        log_probs = torch.log_softmax(torch.randn(T, B, C, generator=g), -1)
+        labels = torch.tensor([[1, 2], [0, 0]])
+        in_lens = torch.tensor([6, 6])
+        lab_lens = torch.tensor([2, 0])
+        want = torch.nn.functional.ctc_loss(
+            log_probs, labels, in_lens, lab_lens, blank=0, reduction="none")
+        got = F.ctc_loss(jnp.asarray(log_probs.numpy()),
+                         jnp.asarray(labels.numpy()),
+                         jnp.asarray(in_lens.numpy()),
+                         jnp.asarray(lab_lens.numpy()), reduction="none")
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_grad_finite(self):
+        T, B, C, S = 8, 2, 4, 3
+        logits = jnp.asarray(R.randn(T, B, C), jnp.float32)
+        labels = jnp.asarray(R.randint(1, C, (B, S)))
+        il = jnp.asarray([8, 6])
+        ll = jnp.asarray([3, 2])
+
+        def loss(lg):
+            return F.ctc_loss(jax.nn.log_softmax(lg, -1), labels, il, ll)
+
+        g = jax.grad(loss)(logits)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestDistanceOps:
+    def test_cosine_similarity_matches_torch(self):
+        a, b = torch.randn(4, 8), torch.randn(4, 8)
+        want = torch.nn.functional.cosine_similarity(a, b, dim=1).numpy()
+        got = np.asarray(nn.CosineSimilarity(axis=1)(
+            jnp.asarray(a.numpy()), jnp.asarray(b.numpy())))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_pairwise_distance_matches_torch(self):
+        a, b = torch.randn(4, 8), torch.randn(4, 8)
+        want = torch.nn.functional.pairwise_distance(a, b).numpy()
+        got = np.asarray(nn.PairwiseDistance()(
+            jnp.asarray(a.numpy()), jnp.asarray(b.numpy())))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestTransformer:
+    def test_seq2seq_forward_and_causal_mask(self):
+        pt.seed(0)
+        model = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=64)
+        model.eval()
+        src = jnp.asarray(R.randn(2, 7, 32), jnp.float32)
+        tgt = jnp.asarray(R.randn(2, 5, 32), jnp.float32)
+        mask = nn.Transformer.generate_square_subsequent_mask(5)
+        out = model(src, tgt, tgt_mask=mask)
+        assert out.shape == (2, 5, 32)
+        # causality: changing a later tgt step must not affect earlier outs
+        tgt2 = tgt.at[:, 3].add(1.0)
+        out2 = model(src, tgt2, tgt_mask=mask)
+        np.testing.assert_allclose(np.asarray(out[:, :3]),
+                                   np.asarray(out2[:, :3]),
+                                   rtol=1e-4, atol=1e-5)
+        assert not np.allclose(np.asarray(out[:, 3]), np.asarray(out2[:, 3]))
+
+    def test_decoder_incremental_cache_matches_full(self):
+        pt.seed(1)
+        d = 16
+        layer_fn = lambda: nn.TransformerDecoderLayer(d, 2, 32, dropout=0.0)
+        dec = nn.TransformerDecoder(layer_fn, 2)
+        dec.eval()
+        memory = jnp.asarray(R.randn(1, 6, d), jnp.float32)
+        tgt = jnp.asarray(R.randn(1, 4, d), jnp.float32)
+        # the cached path is causal by construction, so the full pass
+        # must mask the future too
+        full = dec(tgt, memory,
+                   tgt_mask=nn.Transformer.generate_square_subsequent_mask(4))
+        # incremental: feed one token at a time with kv caches
+        caches = [(jnp.zeros((1, 2, 0, d // 2)), jnp.zeros((1, 2, 0, d // 2)))
+                  for _ in range(2)]
+        outs = []
+        for t in range(4):
+            step_out, caches = dec(tgt[:, t:t + 1], memory, cache=caches)
+            outs.append(step_out)
+        inc = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(inc),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_transformer_trains(self):
+        pt.seed(2)
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        model.train()
+        params = model.state_dict()
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        state = opt.init(params)
+        src = jnp.asarray(R.randn(4, 6, 16), jnp.float32)
+        tgt = jnp.asarray(R.randn(4, 5, 16), jnp.float32)
+        want = jnp.asarray(R.randn(4, 5, 16), jnp.float32)
+
+        @jax.jit
+        def step(p, s):
+            def lf(q):
+                out = model.apply(q, src, tgt)
+                return jnp.mean((out - want) ** 2)
+            loss, g = jax.value_and_grad(lf)(p)
+            return (loss, *opt.apply_gradients(g, p, s))
+
+        losses = []
+        for _ in range(15):
+            loss, params, state = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
