@@ -35,6 +35,7 @@ from .mp_ops import (parallel_cross_entropy, parallel_log_softmax,  # noqa: F401
 from .parallel import (DataParallel, ParallelEnv, get_rank,  # noqa: F401
                        get_world_size, init_parallel_env, shard_batch,
                        device_put_sharded_variables)
+from .spawn import spawn  # noqa: F401
 from .random import (RNGStatesTracker, get_rng_state_tracker,  # noqa: F401
                      model_parallel_random_seed)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401

@@ -1,0 +1,60 @@
+"""paddle.distributed.spawn analog (reference: distributed/spawn.py —
+spawn one python process per device with env wiring, join on exit).
+
+TPU note: on real TPU pods a process maps to a HOST (all local chips belong
+to one process; jax.distributed handles the rest), so ``nprocs`` defaults to
+one per host-slot rather than per chip.  ``paddle_tpu.distributed.launch``
+remains the production entry point — spawn is the programmatic twin, wiring
+the same PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM env contract.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Sequence
+
+from ..framework.errors import enforce
+
+__all__ = ["spawn"]
+
+
+def _worker(fn, rank: int, nprocs: int, args, error_queue):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_TPU_SPAWN_RANK"] = str(rank)
+    try:
+        fn(*args)
+    except Exception:
+        error_queue.put((rank, traceback.format_exc()))
+        raise
+
+
+def spawn(func, args=(), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """Launch ``nprocs`` processes running ``func(*args)`` with paddle-style
+    rank env wiring.  Returns the context (list of processes) when
+    ``join=False``; otherwise joins and re-raises the first failure."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    enforce(nprocs >= 1, "spawn needs nprocs >= 1")
+    ctx = mp.get_context("spawn")      # never fork a process holding jax
+    error_queue = ctx.SimpleQueue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, tuple(args), error_queue),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    if not error_queue.empty():
+        rank, tb = error_queue.get()
+        raise RuntimeError(f"spawned rank {rank} failed:\n{tb}")
+    for p in procs:
+        enforce(p.exitcode == 0,
+                f"spawned process exited with code {p.exitcode}")
+    return procs

@@ -1,5 +1,6 @@
 """High-level API (reference: python/paddle/hapi)."""
 from . import callbacks  # noqa: F401
+from .flops import flops, summary  # noqa: F401
 from .model import Model  # noqa: F401
 from .callbacks import (Callback, CallbackList,  # noqa: F401
                         ProgBarLogger, ModelCheckpoint,

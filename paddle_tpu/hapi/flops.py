@@ -1,0 +1,89 @@
+"""Model FLOPs / summary utilities (reference: hapi/dynamic_flops.py
+``paddle.flops`` and hapi/model_summary.py ``paddle.summary``).
+
+TPU-first: instead of the reference's per-layer-type FLOP formulas (a hook
+table over Conv2D/Linear/...), the count comes from XLA itself —
+``jit(forward).lower(...).compile().cost_analysis()`` — so every op the
+compiler actually emits is counted, fusions included.  A formula-based
+estimate would drift from the real program; the compiler's own analysis
+cannot.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+
+__all__ = ["flops", "summary"]
+
+
+def _example_input(input_size, dtype):
+    dt = convert_dtype(dtype) if dtype else jnp.float32
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.zeros(tuple(input_size), dt)
+    return jnp.ones(tuple(input_size), dt)
+
+
+def flops(net, input_size: Sequence[int], custom_ops=None,
+          print_detail: bool = False, dtype=None) -> int:
+    """Total forward FLOPs of ``net`` on ``input_size`` (paddle.flops).
+
+    custom_ops is accepted for API parity; XLA's cost analysis already
+    covers every op so it is unused."""
+    was_training = net.training
+    net.eval()
+    try:
+        params = net.state_dict()
+        x = _example_input(input_size, dtype)
+
+        def fwd(p, x):
+            return net.apply(p, x)
+
+        compiled = jax.jit(fwd).lower(params, x).compile()
+        analyses = compiled.cost_analysis()
+        ca = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
+        total = int(ca.get("flops", 0))
+        if print_detail:
+            by_bytes = {k: v for k, v in ca.items()
+                        if k.startswith("bytes accessed")}
+            print(f"FLOPs: {total}")
+            for k, v in sorted(by_bytes.items()):
+                print(f"  {k}: {int(v)}")
+        return total
+    finally:
+        if was_training:
+            net.train()
+
+
+def summary(net, input_size=None, dtypes=None) -> dict:
+    """Layer-wise parameter summary (paddle.summary shape).
+
+    Returns {'total_params': N, 'trainable_params': N}; prints a table."""
+    total, trainable = 0, 0
+    lines = []
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        lines.append(f"  {name:48s} {str(tuple(p.shape)):24s} {n:>12,}")
+    header = f"{'Layer (param)':50s} {'Shape':24s} {'Param #':>12s}"
+    print(header)
+    print("-" * len(header))
+    print("\n".join(lines))
+    print("-" * len(header))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    if input_size is not None:
+        try:
+            f = flops(net, input_size,
+                      dtype=dtypes[0] if dtypes else None)
+            print(f"Forward FLOPs @ {tuple(input_size)}: {f:,}")
+        except Exception as e:  # cost analysis unavailable on some backends
+            print(f"(FLOPs unavailable: {e})")
+    return {"total_params": total, "trainable_params": trainable}

@@ -14,7 +14,7 @@ from .layers import (GELU, SiLU, AdaptiveAvgPool2D, AvgPool2D,  # noqa: F401
                      TransformerEncoder, TransformerEncoderLayer)
 from .layers import (AdaptiveMaxPool2D, AvgPool1D, Conv1D, Conv3D,  # noqa: F401
                      Conv2DTranspose, CosineEmbeddingLoss, CosineSimilarity,
-                     CTCLoss, GLULayer, HingeEmbeddingLoss, Identity,
+                     CTCLoss, GLU, HingeEmbeddingLoss, Identity,
                      InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
                      KLDivLoss, MarginRankingLoss, MaxPool1D,
                      PairwiseDistance, PixelShuffle, PixelUnshuffle, PReLU,

@@ -522,20 +522,43 @@ def normalize(x, p: float = 2.0, axis: int = 1, epsilon: float = 1e-12):
     return x / norm
 
 
+def _align_corners_matrix(in_size: int, out_size: int):
+    """(out, in) bilinear interpolation matrix with align_corners=True
+    sampling (endpoints map to endpoints) — built at trace time, so the
+    resize lowers to two matmuls."""
+    m = np.zeros((out_size, in_size), np.float32)
+    if out_size == 1 or in_size == 1:
+        m[:, 0] = 1.0
+        return m
+    for i in range(out_size):
+        pos = i * (in_size - 1) / (out_size - 1)
+        lo = int(np.floor(pos))
+        hi = min(lo + 1, in_size - 1)
+        frac = pos - lo
+        m[i, lo] += 1.0 - frac
+        m[i, hi] += frac
+    return m
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
-                data_format="NCHW"):
+                align_corners: bool = False, data_format="NCHW"):
     x = _arr(x)
     if data_format == "NCHW":
         n, c, h, w = x.shape
-        if size is None:
-            size = (int(h * scale_factor), int(w * scale_factor))
-        method = {"nearest": "nearest", "bilinear": "linear"}[mode]
-        return jax.image.resize(x, (n, c, size[0], size[1]), method=method)
-    n, h, w, c = x.shape
+    else:
+        n, h, w, c = x.shape
     if size is None:
         size = (int(h * scale_factor), int(w * scale_factor))
+    if align_corners and mode == "bilinear":
+        mh = jnp.asarray(_align_corners_matrix(h, size[0]), x.dtype)
+        mw = jnp.asarray(_align_corners_matrix(w, size[1]), x.dtype)
+        if data_format == "NCHW":
+            return jnp.einsum("oh,nchw,pw->ncop", mh, x, mw)
+        return jnp.einsum("oh,nhwc,pw->nopc", mh, x, mw)
     method = {"nearest": "nearest", "bilinear": "linear"}[mode]
-    return jax.image.resize(x, (n, size[0], size[1], c), method=method)
+    shape = (n, c, size[0], size[1]) if data_format == "NCHW" \
+        else (n, size[0], size[1], c)
+    return jax.image.resize(x, shape, method=method)
 
 
 def flatten(x, start_axis: int = 0, stop_axis: int = -1):
@@ -777,22 +800,17 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths,
     can_skip = jnp.zeros((B, 2 * S + 1), bool)
     if S > 1:
         can_skip = can_skip.at[:, 3::2].set(labels[:, 1:] != labels[:, :-1])
-    elif S == 1:
-        pass
-    can_skip = can_skip.at[:, 1].set(False)
 
     pos = jnp.arange(2 * S + 1)[None, :]
     valid = pos < ext_len[:, None]
 
-    emit0 = jnp.take_along_axis(log_probs[0][:, None, :].repeat(
-        2 * S + 1, axis=1), ext[..., None], axis=-1)[..., 0]
+    emit0 = jnp.take_along_axis(log_probs[0], ext, axis=1)
     alpha0 = jnp.where(pos <= 1, emit0, NEG)
     alpha0 = jnp.where(valid, alpha0, NEG)
 
     def step(alpha, lp_t):
         # lp_t: (B, C) log probs at time t
-        emit = jnp.take_along_axis(lp_t[:, None, :].repeat(
-            2 * S + 1, axis=1), ext[..., None], axis=-1)[..., 0]
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
         a_prev = alpha
         a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]],
                                    axis=1)
@@ -823,3 +841,50 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths,
     if reduction == "mean":   # paddle/torch: divide by label length
         return jnp.mean(loss / jnp.maximum(label_lengths, 1))
     return _reduce(loss, reduction)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None):
+    """Block/CSR-sparse attention (reference nn/functional/
+    sparse_attention.py:23, GPU-only op sparse_attention_op.cu).
+
+    q/k/v: (B, H, S, D); offset: (B, H, S+1); columns: (B, H, nnz).
+    TPU-native formulation: flatten the CSR pattern and compute the nnz
+    scores with gathers + segment softmax (segment_max/segment_sum over the
+    row ids) — one fused XLA program, no dynamic shapes.  Masks are
+    additive, matching the reference (use -inf to drop a position).
+    """
+    query, key = amp_state.cast_for_op("attention", _arr(query), _arr(key))
+    value = _arr(value)
+    S, D = query.shape[2], query.shape[3]
+    scale = D ** -0.5
+
+    def one(q, k, v, offset, cols, kpm, am):
+        nnz = cols.shape[0]
+        row = jnp.searchsorted(offset, jnp.arange(nnz), side="right") - 1
+        row = jnp.clip(row, 0, S - 1)
+        s = jnp.sum(q[row] * k[cols], axis=-1) * scale
+        if kpm is not None:
+            s = s + kpm[cols]
+        if am is not None:
+            s = s + am[row, cols]
+        m = jax.ops.segment_max(s, row, num_segments=S)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)     # empty rows
+        e = jnp.exp(s - m[row])
+        z = jax.ops.segment_sum(e, row, num_segments=S)
+        p = e / jnp.maximum(z[row], 1e-30)
+        return jax.ops.segment_sum(p[:, None] * v[cols], row,
+                                   num_segments=S)
+
+    def per_head(q, k, v, offset, cols, kpm, am):
+        return one(q, k, v, offset, cols, kpm, am)
+
+    # vmap over heads then batch; masks broadcast per batch
+    fn = jax.vmap(per_head, in_axes=(0, 0, 0, 0, 0, None, None))
+    kpm_axes = None if key_padding_mask is None else 0
+    fn2 = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, kpm_axes, None))
+    kpm = None if key_padding_mask is None else _arr(key_padding_mask)
+    am = None if attn_mask is None else _arr(attn_mask)
+    return fn2(query, key, value, _arr(sparse_csr_offset).astype(jnp.int32),
+               _arr(sparse_csr_columns).astype(jnp.int32), kpm, am)

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.dtype import convert_dtype
+from ..framework.errors import enforce
 from . import functional as F
 from . import initializer as I
 from .layer import Layer, LayerList, Parameter, ParameterList, Sequential  # noqa: F401
@@ -653,8 +654,7 @@ class Conv2DTranspose(Layer):
                 base = (hw[i] - 1) * s[i] - 2 * p[i] \
                     + d[i] * (k[i] - 1) + 1
                 extra = int(output_size[i]) - base
-                from ..framework.errors import enforce
-                enforce(0 <= extra < s[i] or (extra == 0 and s[i] == 1),
+                enforce(0 <= extra < max(s[i], 1),
                         f"output_size[{i}]={output_size[i]} unreachable "
                         f"(base {base}, stride {s[i]})")
                 out_pad.append(extra)
@@ -808,19 +808,23 @@ class Unflatten(Layer):
 
 class Upsample(Layer):
     def __init__(self, size=None, scale_factor=None, mode="nearest",
-                 data_format="NCHW"):
+                 align_corners: bool = False, data_format="NCHW"):
         super().__init__()
         self.size, self.scale_factor = size, scale_factor
-        self.mode, self.data_format = mode, data_format
+        self.mode, self.align_corners = mode, align_corners
+        self.data_format = data_format
 
     def forward(self, x):
         return F.interpolate(x, self.size, self.scale_factor, self.mode,
-                             self.data_format)
+                             self.align_corners, self.data_format)
 
 
 class UpsamplingBilinear2D(Upsample):
+    """align_corners=True bilinear — the reference class's semantics."""
+
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
-        super().__init__(size, scale_factor, "bilinear", data_format)
+        super().__init__(size, scale_factor, "bilinear",
+                         align_corners=True, data_format=data_format)
 
 
 class UpsamplingNearest2D(Upsample):
@@ -867,7 +871,7 @@ class PairwiseDistance(Layer):
         return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
 
 
-class GLULayer(Layer):
+class GLU(Layer):
     def __init__(self, axis: int = -1):
         super().__init__()
         self.axis = axis

@@ -1,0 +1,98 @@
+"""paddle.flops / summary, distributed.spawn, sparse_attention tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class TestFlops:
+    def test_flops_counts_matmuls(self):
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        f = pt.flops(net, (2, 8))
+        # 2 matmuls at 2*B*I*O flops each (XLA counts mul+add)
+        expected = 2 * 2 * 8 * 16 + 2 * 2 * 16 * 4
+        assert f >= expected
+        assert f < expected * 2  # no phantom work
+
+    def test_summary_counts_params(self, capsys):
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 4))
+        info = pt.summary(net)
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+        assert "Total params" in capsys.readouterr().out
+
+
+class TestSparseAttention:
+    def _qkv(self, B=2, H=2, S=4, D=8, seed=0):
+        r = np.random.RandomState(seed)
+        return tuple(jnp.asarray(r.randn(B, H, S, D), jnp.float32)
+                     for _ in range(3))
+
+    def test_dense_pattern_matches_sdpa(self):
+        q, k, v = self._qkv()
+        B, H, S, _ = q.shape
+        offset = jnp.broadcast_to(jnp.arange(0, (S + 1) * S, S),
+                                  (B, H, S + 1))
+        cols = jnp.broadcast_to(jnp.tile(jnp.arange(S), S), (B, H, S * S))
+        out = F.sparse_attention(q, k, v, offset, cols)
+        ref = F.scaled_dot_product_attention(q, k, v, training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_causal_pattern_matches_causal_sdpa(self):
+        q, k, v = self._qkv(seed=1)
+        B, H, S, _ = q.shape
+        offs, coll = np.zeros(S + 1, np.int64), []
+        for i in range(S):
+            coll += list(range(i + 1))
+            offs[i + 1] = len(coll)
+        offset = jnp.broadcast_to(jnp.asarray(offs), (B, H, S + 1))
+        cols = jnp.broadcast_to(jnp.asarray(coll), (B, H, len(coll)))
+        out = F.sparse_attention(q, k, v, offset, cols)
+        ref = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_key_padding_mask(self):
+        q, k, v = self._qkv(seed=2)
+        B, H, S, _ = q.shape
+        offset = jnp.broadcast_to(jnp.arange(0, (S + 1) * S, S),
+                                  (B, H, S + 1))
+        cols = jnp.broadcast_to(jnp.tile(jnp.arange(S), S), (B, H, S * S))
+        kpm = jnp.zeros((B, S)).at[:, -1].set(float("-inf"))
+        out = F.sparse_attention(q, k, v, offset, cols,
+                                 key_padding_mask=kpm)
+        ref = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=kpm[:, None, None, :], training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _spawn_target(path):
+    import os
+    with open(f"{path}/rank_{os.environ['PADDLE_TRAINER_ID']}", "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+
+def _spawn_failing():
+    raise ValueError("boom")
+
+
+class TestSpawn:
+    def test_spawn_runs_and_wires_env(self, tmp_path):
+        from paddle_tpu.distributed.spawn import spawn
+        spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+        assert (tmp_path / "rank_0").read_text() == "2"
+        assert (tmp_path / "rank_1").read_text() == "2"
+
+    def test_spawn_propagates_failure(self):
+        from paddle_tpu.distributed.spawn import spawn
+        with pytest.raises(RuntimeError, match="boom"):
+            spawn(_spawn_failing, nprocs=1)

@@ -121,13 +121,19 @@ class TestPoolNormAct:
         want = torch.nn.functional.pixel_shuffle(
             torch.from_numpy(np.asarray(x)), 2).numpy()
         np.testing.assert_allclose(np.asarray(ps), want)
-        g = nn.GLULayer(-1)(jnp.asarray(R.randn(2, 8), jnp.float32))
+        g = nn.GLU(-1)(jnp.asarray(R.randn(2, 8), jnp.float32))
         assert g.shape == (2, 4)
 
     def test_upsample(self):
         x = jnp.asarray(R.randn(1, 2, 4, 4), jnp.float32)
         assert nn.Upsample(scale_factor=2)(x).shape == (1, 2, 8, 8)
         assert nn.UpsamplingBilinear2D(size=(6, 6))(x).shape == (1, 2, 6, 6)
+        # UpsamplingBilinear2D is align_corners=True — torch golden
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(np.asarray(x)), size=(6, 6), mode="bilinear",
+            align_corners=True).numpy()
+        got = np.asarray(nn.UpsamplingBilinear2D(size=(6, 6))(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
         assert nn.Unflatten(1, (1, 2))(jnp.zeros((3, 2, 5))).shape \
             == (3, 1, 2, 5)
         assert nn.Identity()(x) is x
