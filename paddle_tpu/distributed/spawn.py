@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import queue
+import time
 import traceback
 from typing import Optional, Sequence
 
@@ -31,16 +33,21 @@ def _worker(fn, rank: int, nprocs: int, args, error_queue):
 
 
 def spawn(func, args=(), nprocs: int = -1, join: bool = True,
-          daemon: bool = False, **options):
+          daemon: bool = False, timeout: Optional[float] = None,
+          **options):
     """Launch ``nprocs`` processes running ``func(*args)`` with paddle-style
-    rank env wiring.  Returns the context (list of processes) when
-    ``join=False``; otherwise joins and re-raises the first failure."""
+    rank env wiring.  Returns the list of processes when ``join=False``;
+    otherwise monitors them, terminates the survivors as soon as any rank
+    fails (a crashed rank must not hang its blocked peers), and re-raises
+    the first failure."""
+    enforce(not options,
+            f"spawn got unsupported options {sorted(options)}")
     if nprocs == -1:
         nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     enforce(nprocs >= 1, "spawn needs nprocs >= 1")
     ctx = mp.get_context("spawn")      # never fork a process holding jax
-    error_queue = ctx.SimpleQueue()
-    procs = []
+    error_queue = ctx.Queue()          # buffered: a huge traceback must not
+    procs = []                         # block the child's put() mid-exit
     for rank in range(nprocs):
         p = ctx.Process(target=_worker,
                         args=(func, rank, nprocs, tuple(args), error_queue),
@@ -49,11 +56,37 @@ def spawn(func, args=(), nprocs: int = -1, join: bool = True,
         procs.append(p)
     if not join:
         return procs
-    for p in procs:
-        p.join()
-    if not error_queue.empty():
-        rank, tb = error_queue.get()
-        raise RuntimeError(f"spawned rank {rank} failed:\n{tb}")
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    failure = None
+    try:
+        while any(p.is_alive() for p in procs):
+            try:
+                failure = error_queue.get(timeout=0.2)
+                break
+            except queue.Empty:
+                pass
+            for p in procs:
+                if not p.is_alive() and p.exitcode not in (0, None):
+                    failure = (p.pid, f"exit code {p.exitcode}")
+                    break
+            if failure is not None:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                failure = ("-", f"spawn timed out after {timeout}s")
+                break
+    finally:
+        if failure is not None:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+        for p in procs:
+            p.join()
+    if failure is None and not error_queue.empty():
+        failure = error_queue.get()
+    if failure is not None:
+        raise RuntimeError(f"spawned rank {failure[0]} failed:\n"
+                           f"{failure[1]}")
     for p in procs:
         enforce(p.exitcode == 0,
                 f"spawned process exited with code {p.exitcode}")

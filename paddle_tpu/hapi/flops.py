@@ -35,7 +35,9 @@ def flops(net, input_size: Sequence[int], custom_ops=None,
 
     custom_ops is accepted for API parity; XLA's cost analysis already
     covers every op so it is unused."""
-    was_training = net.training
+    # save per-sublayer modes: a blanket train() afterwards would unfreeze
+    # sublayers deliberately left in eval (e.g. a frozen BN backbone)
+    modes = [(l, l.training) for l in net.sublayers(include_self=True)]
     net.eval()
     try:
         params = net.state_dict()
@@ -56,8 +58,8 @@ def flops(net, input_size: Sequence[int], custom_ops=None,
                 print(f"  {k}: {int(v)}")
         return total
     finally:
-        if was_training:
-            net.train()
+        for layer, mode in modes:
+            object.__setattr__(layer, "training", mode)
 
 
 def summary(net, input_size=None, dtypes=None) -> dict:

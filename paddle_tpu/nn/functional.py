@@ -549,6 +549,9 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         n, h, w, c = x.shape
     if size is None:
         size = (int(h * scale_factor), int(w * scale_factor))
+    enforce(not align_corners or mode == "bilinear",
+            f"align_corners is only valid for interpolating modes "
+            f"(bilinear), got mode={mode!r}")
     if align_corners and mode == "bilinear":
         mh = jnp.asarray(_align_corners_matrix(h, size[0]), x.dtype)
         mw = jnp.asarray(_align_corners_matrix(w, size[1]), x.dtype)
@@ -877,11 +880,8 @@ def sparse_attention(query, key, value, sparse_csr_offset,
         return jax.ops.segment_sum(p[:, None] * v[cols], row,
                                    num_segments=S)
 
-    def per_head(q, k, v, offset, cols, kpm, am):
-        return one(q, k, v, offset, cols, kpm, am)
-
     # vmap over heads then batch; masks broadcast per batch
-    fn = jax.vmap(per_head, in_axes=(0, 0, 0, 0, 0, None, None))
+    fn = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, None, None))
     kpm_axes = None if key_padding_mask is None else 0
     fn2 = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, kpm_axes, None))
     kpm = None if key_padding_mask is None else _arr(key_padding_mask)

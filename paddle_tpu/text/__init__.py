@@ -13,7 +13,12 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from . import datasets  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
 
 
 def viterbi_decode(potentials, transition, lengths=None,

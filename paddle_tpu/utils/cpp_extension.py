@@ -1,0 +1,101 @@
+"""Custom C++ op loading (reference: utils/cpp_extension/ — CppExtension /
+load building a shared lib from sources; framework/custom_operator.cc
+registration, E9).
+
+TPU-first shape of the feature: device kernels belong in Pallas (the E9
+custom-kernel mechanism); what C++ is for here is HOST-side ops — IO,
+tokenization, CPU-heavy pre/post-processing.  ``load()`` compiles sources
+with g++ into a .so exposed via ctypes (no pybind11 in this image), and
+``custom_op()`` wraps an exported symbol as a jax-callable that works
+INSIDE jit via ``jax.pure_callback`` — the analog of the reference's
+custom-op-in-graph registration.
+
+C ABI contract for custom_op: ``void f(const float* in, float* out,
+int64_t n)`` — elementwise/same-shape ops; richer signatures can be
+wrapped manually from the ctypes handle returned by ``load``.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+
+__all__ = ["load", "custom_op", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
+         extra_ldflags=(), verbose: bool = False) -> ctypes.CDLL:
+    """Compile ``sources`` (.cc/.cpp paths) into <build_dir>/<name>-<hash>.so
+    and return the loaded ctypes handle.  Recompiles only when sources
+    change (content-hash keyed), mirroring the reference's build cache."""
+    enforce(len(sources) > 0, "cpp_extension.load needs at least one source")
+    h = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join([*extra_cxx_cflags, *extra_ldflags]).encode())
+    so_path = os.path.join(get_build_directory(),
+                           f"{name}-{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *extra_cxx_cflags, *sources, "-o", so_path, *extra_ldflags]
+        if verbose:
+            print("compiling:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        enforce(proc.returncode == 0,
+                f"cpp_extension build failed:\n{proc.stderr}")
+    return ctypes.CDLL(so_path)
+
+
+_CTYPES = {
+    np.float32: ctypes.c_float,
+    np.float64: ctypes.c_double,
+    np.int32: ctypes.c_int32,
+    np.int64: ctypes.c_int64,
+}
+
+
+def custom_op(lib: ctypes.CDLL, symbol: str, dtype=np.float32) -> Callable:
+    """Wrap an exported ``void f(const T* in, T* out, int64_t n)`` symbol
+    as a jax-callable usable under jit (host callback; the graph sees a
+    same-shape op).  Gradients are not defined — wrap with
+    ``paddle_tpu.autograd.PyLayer``/``jax.custom_vjp`` if needed."""
+    fn = getattr(lib, symbol)
+    ct = _CTYPES[np.dtype(dtype).type]
+    fn.argtypes = [ctypes.POINTER(ct), ctypes.POINTER(ct), ctypes.c_int64]
+    fn.restype = None
+
+    def host(x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, dtype=dtype)
+        out = np.empty_like(x)
+        fn(x.ravel().ctypes.data_as(ctypes.POINTER(ct)),
+           out.ctypes.data_as(ctypes.POINTER(ct)),
+           ctypes.c_int64(x.size))
+        return out
+
+    def op(x):
+        x = jnp.asarray(x)
+        return jax.pure_callback(
+            host, jax.ShapeDtypeStruct(x.shape, np.dtype(dtype)), x,
+            vmap_method="sequential")
+
+    op.__name__ = symbol
+    return op

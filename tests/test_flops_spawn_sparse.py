@@ -85,6 +85,14 @@ def _spawn_failing():
     raise ValueError("boom")
 
 
+def _spawn_hang_or_fail():
+    import os
+    import time
+    if os.environ["PADDLE_TRAINER_ID"] == "0":
+        raise ValueError("rank0 crashed")
+    time.sleep(300)  # a peer blocked on rank 0 forever
+
+
 class TestSpawn:
     def test_spawn_runs_and_wires_env(self, tmp_path):
         from paddle_tpu.distributed.spawn import spawn
@@ -96,3 +104,18 @@ class TestSpawn:
         from paddle_tpu.distributed.spawn import spawn
         with pytest.raises(RuntimeError, match="boom"):
             spawn(_spawn_failing, nprocs=1)
+
+    def test_spawn_kills_blocked_peers_on_failure(self):
+        """A crashed rank must terminate survivors promptly, not hang the
+        parent in join (regression: unconditional join loop)."""
+        import time
+        from paddle_tpu.distributed.spawn import spawn
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="rank0 crashed"):
+            spawn(_spawn_hang_or_fail, nprocs=2)
+        assert time.monotonic() - t0 < 60
+
+    def test_spawn_rejects_unknown_options(self):
+        from paddle_tpu.distributed.spawn import spawn
+        with pytest.raises(Exception, match="unsupported options"):
+            spawn(_spawn_failing, nprocs=1, backend="nccl")

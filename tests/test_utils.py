@@ -1,0 +1,90 @@
+"""paddle.utils tests: deprecated/try_import/unique_name/run_check and the
+cpp_extension custom-op path (compile C++ at test time, call under jit)."""
+import os
+import textwrap
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.utils import cpp_extension, deprecated, try_import, unique_name
+
+
+def test_deprecated_warns():
+    @deprecated(update_to="paddle_tpu.new_api", since="0.1")
+    def old_api(x):
+        return x + 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api(1) == 2
+    assert any("deprecated" in str(x.message) for x in w)
+
+
+def test_try_import():
+    assert try_import("math") is not None
+    with pytest.raises(ImportError, match="definitely_not_a_module"):
+        try_import("definitely_not_a_module")
+
+
+def test_unique_name_generate_and_guard():
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+        assert unique_name.generate("fc") == "fc_1"
+        assert unique_name.generate("conv") == "conv_0"
+        with unique_name.guard():
+            assert unique_name.generate("fc") == "fc_0"  # fresh scope
+        assert unique_name.generate("fc") == "fc_2"      # restored
+
+
+def test_run_check():
+    assert pt.utils.run_check()
+
+
+@pytest.fixture(scope="module")
+def softsign_lib(tmp_path_factory):
+    src = tmp_path_factory.mktemp("ext") / "softsign.cc"
+    src.write_text(textwrap.dedent("""
+        #include <cstdint>
+        #include <cmath>
+        extern "C" void softsign(const float* in, float* out, int64_t n) {
+            for (int64_t i = 0; i < n; ++i)
+                out[i] = in[i] / (1.0f + std::fabs(in[i]));
+        }
+    """))
+    return cpp_extension.load("softsign_test", [str(src)])
+
+
+def test_cpp_extension_compiles_and_runs(softsign_lib):
+    op = cpp_extension.custom_op(softsign_lib, "softsign")
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+    got = np.asarray(op(x))
+    want = np.asarray(x) / (1.0 + np.abs(np.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cpp_extension_custom_op_under_jit(softsign_lib):
+    """The host op participates in a jitted program via pure_callback —
+    the reference's custom-op-in-graph registration analog."""
+    op = cpp_extension.custom_op(softsign_lib, "softsign")
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(op(x * 2.0) + 1.0)
+
+    x = jnp.asarray(np.random.RandomState(1).randn(16), jnp.float32)
+    got = float(f(x))
+    xx = np.asarray(x) * 2.0
+    want = float(np.sum(xx / (1.0 + np.abs(xx)) + 1.0))
+    assert abs(got - want) < 1e-4
+
+
+def test_cpp_extension_build_cache(softsign_lib, tmp_path):
+    """Same sources → same .so path (content-hash cache hit)."""
+    d = cpp_extension.get_build_directory()
+    before = {f for f in os.listdir(d) if f.startswith("softsign_test")}
+    assert len(before) == 1

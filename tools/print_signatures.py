@@ -50,6 +50,7 @@ _MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.device",
     "paddle_tpu.text",
+    "paddle_tpu.utils",
 ]
 
 
