@@ -22,12 +22,14 @@ from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import hapi  # noqa: F401
+from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401
 from .hapi import flops, summary  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
+from . import regularizer  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401

@@ -68,7 +68,14 @@ def spawn(func, args=(), nprocs: int = -1, join: bool = True,
                 pass
             for p in procs:
                 if not p.is_alive() and p.exitcode not in (0, None):
-                    failure = (p.pid, f"exit code {p.exitcode}")
+                    # the child's traceback may still be in the queue's
+                    # feeder pipe — give it a grace window before falling
+                    # back to the bare exit code
+                    try:
+                        failure = error_queue.get(timeout=2.0)
+                    except queue.Empty:
+                        failure = (f"pid {p.pid}",
+                                   f"exit code {p.exitcode}")
                     break
             if failure is not None:
                 break

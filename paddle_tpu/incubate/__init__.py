@@ -1,0 +1,9 @@
+"""paddle.incubate analog (reference: python/paddle/incubate — LookAhead /
+ModelAverage optimizers, incubate.nn fused transformer layers,
+softmax_mask_fuse ops)."""
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from ..nn.functional import (  # noqa: F401
+    softmax_mask_fuse_upper_triangle)
+
+__all__ = ["nn", "optimizer", "softmax_mask_fuse_upper_triangle"]

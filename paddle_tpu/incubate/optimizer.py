@@ -1,0 +1,105 @@
+"""Incubate optimizers (reference: incubate/optimizer/lookahead.py:26
+LookAhead, modelaverage.py:28 ModelAverage).
+
+Both wrap an inner optimizer and keep extra parameter EMAs/snapshots in
+their own state pytree, following this framework's functional
+init/apply_gradients contract — the whole update stays one jittable step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, one step back (Zhang et al. 2019).
+
+    Every ``k`` inner steps: slow += alpha * (fast - slow); fast = slow.
+    """
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        enforce(0.0 <= alpha <= 1.0, "alpha must be in [0, 1]")
+        enforce(k >= 1, "k must be >= 1")
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "slow": jax.tree_util.tree_map(
+                    lambda p: jnp.asarray(p, jnp.float32), params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, grads, params, state, lr=None):
+        fast, inner_state = self.inner.apply_gradients(
+            grads, params, state["inner"], lr=lr)
+        step = state["step"] + 1
+        sync = (step % self.k == 0)
+
+        def _blend(slow, f):
+            new_slow = slow + self.alpha * (jnp.asarray(f, jnp.float32)
+                                            - slow)
+            slow_out = jnp.where(sync, new_slow, slow)
+            f_out = jnp.where(sync, new_slow.astype(jnp.asarray(f).dtype),
+                              jnp.asarray(f))
+            return slow_out, f_out
+
+        flat_slow, treedef = jax.tree_util.tree_flatten(state["slow"])
+        flat_fast = treedef.flatten_up_to(fast)
+        pairs = [_blend(s, f) for s, f in zip(flat_slow, flat_fast)]
+        new_slow = treedef.unflatten([p[0] for p in pairs])
+        new_fast = treedef.unflatten([p[1] for p in pairs])
+        return new_fast, {"inner": inner_state, "slow": new_slow,
+                          "step": step}
+
+
+class ModelAverage:
+    """Maintain an EMA/window average of parameters for evaluation
+    (reference ModelAverage with average_window_rate semantics collapsed
+    to a numerically-equivalent running mean).
+
+    ``apply_gradients`` updates the running average alongside the inner
+    step; ``average()`` returns the averaged parameters (the reference's
+    ``apply()`` context swaps them in — here, functionally)."""
+
+    def __init__(self, inner_optimizer, average_window_rate: float = 0.15,
+                 min_average_window: int = 1,
+                 max_average_window: Optional[int] = None):
+        self.inner = inner_optimizer
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window or 10000
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "sum": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros_like(p, jnp.float32), params),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, grads, params, state, lr=None):
+        new_params, inner_state = self.inner.apply_gradients(
+            grads, params, state["inner"], lr=lr)
+        count = state["count"] + 1
+        # windowed running sum: decay old mass once past max_window, the
+        # streaming analog of the reference's restart-window scheme
+        keep = jnp.where(count > self.max_window,
+                         1.0 - 1.0 / self.max_window, 1.0)
+        new_sum = jax.tree_util.tree_map(
+            lambda s, p: keep * s + jnp.asarray(p, jnp.float32),
+            state["sum"], new_params)
+        return new_params, {"inner": inner_state, "sum": new_sum,
+                            "count": count}
+
+    def average(self, state, params):
+        """Averaged parameters, cast back to each param's dtype."""
+        eff = jnp.maximum(jnp.minimum(
+            state["count"], self.max_window).astype(jnp.float32), 1.0)
+        return jax.tree_util.tree_map(
+            lambda s, p: (s / eff).astype(jnp.asarray(p).dtype),
+            state["sum"], params)

@@ -10,6 +10,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _fans(shape):
@@ -105,6 +106,63 @@ class KaimingNormal(Initializer):
 constant = Constant
 uniform = Uniform
 normal = Normal
+
+
+class Assign(Initializer):
+    """Initialize from an explicit array (reference initializer/assign.py)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        v = jnp.asarray(self.value, dtype)
+        if tuple(v.shape) != tuple(shape):
+            raise ValueError(f"Assign value shape {v.shape} != {shape}")
+        return v
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference initializer/dirac.py):
+    out[i, i % in, center...] = 1 within each of ``groups`` blocks."""
+
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if len(shape) < 3:
+            raise ValueError("Dirac needs a conv-shaped (O, I, *k) weight")
+        out_ch, in_ch = shape[0], shape[1]
+        if out_ch % self.groups:
+            raise ValueError("out_channels must divide by groups")
+        w = np.zeros(shape, np.float32)
+        center = tuple(k // 2 for k in shape[2:])
+        per_group = out_ch // self.groups
+        for g in range(self.groups):
+            for i in range(min(per_group, in_ch)):
+                w[(g * per_group + i, i) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Orthogonal(Initializer):
+    """(Semi-)orthogonal matrix init via QR (reference
+    initializer/orthogonal.py); tensors are flattened to 2-D."""
+
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal needs >= 2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        n, m = max(rows, cols), min(rows, cols)
+        a = jax.random.normal(key, (n, m), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))     # unique decomposition
+        q = q.T if rows < cols else q
+        return (self.gain * q.reshape(shape)).astype(dtype)
 
 
 class ParamAttr:

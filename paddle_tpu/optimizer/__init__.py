@@ -99,7 +99,16 @@ class Optimizer:
                  apply_decay_param_fun: Optional[Callable[[str], bool]] = None):
         self._lr = learning_rate
         self._grad_clip = grad_clip
-        self._wd = float(weight_decay) if weight_decay else 0.0
+        # weight_decay: float (L2 semantics) or a regularizer instance
+        # (reference: optimizer accepts paddle.regularizer.L1Decay/L2Decay)
+        from ..regularizer import L1Decay, L2Decay
+        self._l1 = 0.0
+        if isinstance(weight_decay, L1Decay):
+            self._wd, self._l1 = 0.0, weight_decay.coeff
+        elif isinstance(weight_decay, L2Decay):
+            self._wd = weight_decay.coeff
+        else:
+            self._wd = float(weight_decay) if weight_decay else 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
         self.multi_precision = multi_precision
         self._parameters = list(parameters) if parameters is not None else None
@@ -150,24 +159,28 @@ class Optimizer:
         # param names, so apply_decay_param_fun gets real names)
         wd_tree = self._decay_tree(params)
 
-        def _upd(g, p, slots, master, wd):
+        def _upd(g, p, slots, master, wd, l1):
             if g is None:
                 return p, slots, master
             compute_p = master if master is not None else jnp.asarray(p)
             g32 = g.astype(jnp.float32)
+            if self._l1:   # L1Decay: lasso penalty as a gradient addition
+                g32 = g32 + l1 * jnp.sign(compute_p.astype(jnp.float32))
             new_p32, new_slots = self._update(
                 g32, compute_p.astype(jnp.float32), slots, lr_t, step, wd)
             if master is not None:
                 return new_p32.astype(jnp.asarray(p).dtype), new_slots, new_p32
             return new_p32.astype(jnp.asarray(p).dtype), new_slots, None
 
+        l1_tree = self._l1_tree(params)
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
         flat_m = treedef.flatten_up_to(state["master"])
         flat_w = treedef.flatten_up_to(wd_tree)
-        out = [_upd(g, p, s, m, w) for g, p, s, m, w in
-               zip(flat_g, flat_p, flat_s, flat_m, flat_w)]
+        flat_l1 = treedef.flatten_up_to(l1_tree)
+        out = [_upd(g, p, s, m, w, l1) for g, p, s, m, w, l1 in
+               zip(flat_g, flat_p, flat_s, flat_m, flat_w, flat_l1)]
         new_params = treedef.unflatten([o[0] for o in out])
         new_slots = treedef.unflatten([o[1] for o in out])
         new_master = treedef.unflatten([o[2] for o in out])
@@ -199,6 +212,20 @@ class Optimizer:
             lambda path, p: self._wd if (self._wd and (
                 fn is None or fn(_path_str(path)))) else 0.0,
             params)
+
+    def _l1_tree(self, params):
+        """Per-leaf L1Decay coefficients, gated by the same
+        apply_decay_param_fun as L2 decay."""
+        if not self._l1:
+            return jax.tree_util.tree_map(lambda p: 0.0, params)
+        fn = self._apply_decay_param_fun
+        if fn is None:
+            return jax.tree_util.tree_map(lambda p: self._l1, params)
+        saved_wd, self._wd = self._wd, self._l1
+        try:
+            return self._decay_tree(params)
+        finally:
+            self._wd = saved_wd
 
     # -- stateful API ------------------------------------------------------
     def _param_keys(self):

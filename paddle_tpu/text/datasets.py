@@ -2,9 +2,12 @@
 uci_housing,conll05,movielens,wmt14,wmt16}.py).
 
 The reference downloads corpora at construction; this environment has no
-egress, so each dataset loads from an explicit ``data_file`` when given and
-otherwise generates a deterministic synthetic stand-in with the same item
-schema — the same gating pattern as paddle_tpu.vision.datasets.MNIST.
+egress, so each dataset generates a deterministic synthetic stand-in with
+the same item schema — the gating pattern of paddle_tpu.vision.datasets.
+MNIST.  ``Imdb`` and ``UCIHousing`` additionally accept an explicit local
+``data_file`` (tar / whitespace table); the other corpora's wire formats
+are not parsed here — passing ``data_file`` to them raises rather than
+silently training on synthetic data.
 """
 from __future__ import annotations
 
@@ -43,9 +46,10 @@ class Imdb(Dataset):
             self.docs.append(rng.randint(
                 lo, lo + vocab_size // 2, seq_len).astype(np.int64))
 
-    @staticmethod
-    def _load_tar(path: str, mode: str):
+    def _load_tar(self, path: str, mode: str):
+        import zlib
         docs, labels = [], []
+        vocab = len(self.word_idx)
         with tarfile.open(path) as tf:
             for member in tf.getmembers():
                 if f"{mode}/pos" in member.name:
@@ -56,8 +60,11 @@ class Imdb(Dataset):
                     continue
                 data = tf.extractfile(member).read().decode(
                     "utf-8", "ignore").split()
+                # crc32 is stable across processes (builtin hash() is
+                # randomized by PYTHONHASHSEED) — reload-safe word ids
                 docs.append(np.asarray(
-                    [abs(hash(w)) % 5000 for w in data], np.int64))
+                    [zlib.crc32(w.encode()) % vocab for w in data],
+                    np.int64))
                 labels.append(y)
         return docs, np.asarray(labels, np.int64)
 
@@ -77,6 +84,9 @@ class Imikolov(Dataset):
                  min_word_freq: int = 50,
                  synthetic_size: Optional[int] = None,
                  vocab_size: int = 2000):
+        enforce(data_file is None,
+                "Imikolov corpus parsing is not supported in this "
+                "environment; omit data_file to use the synthetic stream")
         self.window_size = window_size
         n = synthetic_size or (4096 if mode == "train" else 512)
         rng = np.random.RandomState(11 if mode == "train" else 13)
@@ -106,6 +116,9 @@ class UCIHousing(Dataset):
                  synthetic_size: Optional[int] = None):
         if data_file and os.path.exists(data_file):
             raw = np.loadtxt(data_file).astype(np.float32)
+            # canonical 80/20 split by mode — train and test must differ
+            cut = int(len(raw) * 0.8)
+            raw = raw[:cut] if mode == "train" else raw[cut:]
         else:
             n = synthetic_size or (404 if mode == "train" else 102)
             rng = np.random.RandomState(17 if mode == "train" else 19)
@@ -132,6 +145,9 @@ class Conll05st(Dataset):
     def __init__(self, data_file: Optional[str] = None,
                  synthetic_size: Optional[int] = None, seq_len: int = 30,
                  vocab_size: int = 5000):
+        enforce(data_file is None,
+                "Conll05st corpus parsing is not supported in this "
+                "environment; omit data_file for the synthetic schema")
         n = synthetic_size or 1024
         rng = np.random.RandomState(23)
         self.words = rng.randint(0, vocab_size,
@@ -154,6 +170,9 @@ class Movielens(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  synthetic_size: Optional[int] = None,
                  num_users: int = 943, num_movies: int = 1682):
+        enforce(data_file is None,
+                "Movielens corpus parsing is not supported in this "
+                "environment; omit data_file for the synthetic schema")
         n = synthetic_size or (8192 if mode == "train" else 1024)
         rng = np.random.RandomState(29 if mode == "train" else 31)
         self.users = rng.randint(0, num_users, n).astype(np.int64)

@@ -52,12 +52,15 @@ def run_check() -> bool:
     import jax
     import jax.numpy as jnp
 
+    from ..framework.errors import enforce
+
     dev = jax.devices()[0]
     x = jnp.ones((128, 128))
     y = (x @ x).block_until_ready()
-    assert float(y[0, 0]) == 128.0
+    enforce(float(y[0, 0]) == 128.0, "matmul sanity check failed")
     jitted = jax.jit(lambda a: (a @ a).sum())
-    assert float(jitted(x)) == 128.0 * 128 * 128
+    enforce(float(jitted(x)) == 128.0 * 128 * 128,
+            "jitted matmul sanity check failed")
     print(f"paddle_tpu is installed successfully on {dev.platform} "
           f"({getattr(dev, 'device_kind', 'cpu')})")
     return True

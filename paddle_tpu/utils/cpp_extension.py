@@ -55,13 +55,17 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
     so_path = os.path.join(get_build_directory(),
                            f"{name}-{h.hexdigest()[:12]}.so")
     if not os.path.exists(so_path):
+        # build to a private temp path and rename atomically: a concurrent
+        # load() must never dlopen a half-written .so
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               *extra_cxx_cflags, *sources, "-o", so_path, *extra_ldflags]
+               *extra_cxx_cflags, *sources, "-o", tmp_path, *extra_ldflags]
         if verbose:
             print("compiling:", " ".join(cmd))
         proc = subprocess.run(cmd, capture_output=True, text=True)
         enforce(proc.returncode == 0,
                 f"cpp_extension build failed:\n{proc.stderr}")
+        os.rename(tmp_path, so_path)
     return ctypes.CDLL(so_path)
 
 

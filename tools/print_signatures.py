@@ -50,6 +50,8 @@ _MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.device",
     "paddle_tpu.text",
+    "paddle_tpu.incubate",
+    "paddle_tpu.regularizer",
     "paddle_tpu.utils",
 ]
 
