@@ -21,8 +21,10 @@ from . import optimizer  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import cost_model  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401

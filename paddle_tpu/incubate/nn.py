@@ -30,6 +30,10 @@ class FusedMultiHeadAttention(Layer):
                  normalize_before: bool = False, epsilon: float = 1e-5,
                  dtype="float32"):
         super().__init__()
+        from ..framework.errors import enforce
+        enforce(embed_dim % num_heads == 0,
+                f"embed_dim {embed_dim} must divide by num_heads "
+                f"{num_heads}")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads

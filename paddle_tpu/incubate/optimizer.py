@@ -60,9 +60,11 @@ class LookAhead:
 
 
 class ModelAverage:
-    """Maintain an EMA/window average of parameters for evaluation
-    (reference ModelAverage with average_window_rate semantics collapsed
-    to a numerically-equivalent running mean).
+    """Maintain a windowed average of parameters for evaluation (reference
+    ModelAverage).  The window at update ``t`` is
+    ``clip(rate * t, min_average_window, max_average_window)`` — the
+    reference's growing-window rule — realized as a streaming sum whose
+    old mass decays once the window saturates.
 
     ``apply_gradients`` updates the running average alongside the inner
     step; ``average()`` returns the averaged parameters (the reference's
@@ -76,6 +78,10 @@ class ModelAverage:
         self.min_window = min_average_window
         self.max_window = max_average_window or 10000
 
+    def _window(self, count):
+        w = jnp.ceil(self.rate * count.astype(jnp.float32))
+        return jnp.clip(w, self.min_window, self.max_window)
+
     def init(self, params):
         return {"inner": self.inner.init(params),
                 "sum": jax.tree_util.tree_map(
@@ -86,10 +92,10 @@ class ModelAverage:
         new_params, inner_state = self.inner.apply_gradients(
             grads, params, state["inner"], lr=lr)
         count = state["count"] + 1
-        # windowed running sum: decay old mass once past max_window, the
-        # streaming analog of the reference's restart-window scheme
-        keep = jnp.where(count > self.max_window,
-                         1.0 - 1.0 / self.max_window, 1.0)
+        window = self._window(count)
+        # decay old mass once the sample count exceeds the current window
+        keep = jnp.where(count.astype(jnp.float32) > window,
+                         1.0 - 1.0 / window, 1.0)
         new_sum = jax.tree_util.tree_map(
             lambda s, p: keep * s + jnp.asarray(p, jnp.float32),
             state["sum"], new_params)
@@ -99,7 +105,8 @@ class ModelAverage:
     def average(self, state, params):
         """Averaged parameters, cast back to each param's dtype."""
         eff = jnp.maximum(jnp.minimum(
-            state["count"], self.max_window).astype(jnp.float32), 1.0)
+            state["count"].astype(jnp.float32),
+            self._window(state["count"])), 1.0)
         return jax.tree_util.tree_map(
             lambda s, p: (s / eff).astype(jnp.asarray(p).dtype),
             state["sum"], params)

@@ -26,7 +26,8 @@ from ..framework.errors import enforce
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "Subset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
-    "BatchSampler", "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+    "BatchSampler", "DistributedBatchSampler", "WeightedRandomSampler",
+    "DataLoader", "default_collate_fn",
 ]
 
 
@@ -133,6 +134,32 @@ class RandomSampler(Sampler):
         if self.replacement:
             return iter(np.random.randint(0, n, self.num_samples).tolist())
         return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    """Draw indices with the given per-sample weights (reference
+    fluid/dataloader WeightedRandomSampler)."""
+
+    def __init__(self, weights, num_samples: int, replacement: bool = True):
+        super().__init__()
+        self.weights = np.asarray(weights, np.float64)
+        enforce(np.all(self.weights >= 0), "weights must be non-negative")
+        enforce(self.weights.sum() > 0, "weights must not all be zero")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        enforce(replacement
+                or num_samples <= int(np.count_nonzero(self.weights)),
+                "cannot draw more samples than nonzero weights without "
+                "replacement")
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
 
     def __len__(self):
         return self.num_samples

@@ -1,0 +1,37 @@
+"""paddle.onnx analog (reference: python/paddle/onnx/export.py:21
+``paddle.onnx.export`` via paddle2onnx).
+
+This environment ships no ``onnx`` package (and installs are not
+permitted), so ONNX serialization is gated: ``export`` raises with the
+TPU-native alternative spelled out.  The deployment path of this framework
+is ``paddle_tpu.jit.save`` — a StableHLO artifact that needs no model code
+and feeds XLA-based serving directly (SURVEY L9: XLA is the engine).
+"""
+from __future__ import annotations
+
+import importlib.util
+
+__all__ = ["export", "onnx_available"]
+
+
+def onnx_available() -> bool:
+    return importlib.util.find_spec("onnx") is not None
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export ``layer`` to ONNX (reference onnx/export.py:21).
+
+    Requires the ``onnx`` package; unavailable in this build — use
+    ``paddle_tpu.jit.save(layer, path, input_spec)`` for a
+    StableHLO serving artifact instead."""
+    if not onnx_available():
+        raise RuntimeError(
+            "paddle_tpu.onnx.export requires the 'onnx' package, which is "
+            "not installed in this environment (and package installs are "
+            "disabled). Use paddle_tpu.jit.save(layer, path, input_spec) "
+            "to produce a StableHLO serving artifact — the TPU-native "
+            "deployment format consumed by paddle_tpu.inference.")
+    raise NotImplementedError(
+        "onnx graph building is not implemented; jit.save is the "
+        "supported export path")

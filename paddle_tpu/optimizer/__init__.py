@@ -191,10 +191,12 @@ class Optimizer:
     def update(self, grads, params, state):
         return self.apply_gradients(grads, params, state)
 
-    def _decay_tree(self, params):
-        """Per-leaf weight-decay coefficients; apply_decay_param_fun receives
-        the dotted key path (real parameter names when params is the
-        state_dict-style dict pytree)."""
+    def _decay_tree(self, params, coeff=None):
+        """Per-leaf decay coefficients (``coeff`` defaults to the L2
+        weight decay); apply_decay_param_fun receives the dotted key path
+        (real parameter names when params is the state_dict-style dict
+        pytree)."""
+        coeff = self._wd if coeff is None else coeff
         fn = self._apply_decay_param_fun
 
         def _path_str(path):
@@ -209,23 +211,14 @@ class Optimizer:
             return ".".join(parts)
 
         return jax.tree_util.tree_map_with_path(
-            lambda path, p: self._wd if (self._wd and (
+            lambda path, p: coeff if (coeff and (
                 fn is None or fn(_path_str(path)))) else 0.0,
             params)
 
     def _l1_tree(self, params):
         """Per-leaf L1Decay coefficients, gated by the same
         apply_decay_param_fun as L2 decay."""
-        if not self._l1:
-            return jax.tree_util.tree_map(lambda p: 0.0, params)
-        fn = self._apply_decay_param_fun
-        if fn is None:
-            return jax.tree_util.tree_map(lambda p: self._l1, params)
-        saved_wd, self._wd = self._wd, self._l1
-        try:
-            return self._decay_tree(params)
-        finally:
-            self._wd = saved_wd
+        return self._decay_tree(params, coeff=self._l1)
 
     # -- stateful API ------------------------------------------------------
     def _param_keys(self):

@@ -33,7 +33,9 @@ class Imdb(Dataset):
         enforce(mode in ("train", "test"), "mode must be train|test")
         self.mode = mode
         self.word_idx = {f"w{i}": i for i in range(vocab_size)}
-        if data_file and os.path.exists(data_file):
+        if data_file is not None:
+            enforce(os.path.exists(data_file),
+                    f"Imdb data_file {data_file!r} does not exist")
             self.docs, self.labels = self._load_tar(data_file, mode)
             return
         n = synthetic_size or (2048 if mode == "train" else 256)
@@ -52,6 +54,8 @@ class Imdb(Dataset):
         vocab = len(self.word_idx)
         with tarfile.open(path) as tf:
             for member in tf.getmembers():
+                if not member.isfile():
+                    continue
                 if f"{mode}/pos" in member.name:
                     y = 1
                 elif f"{mode}/neg" in member.name:
@@ -114,7 +118,9 @@ class UCIHousing(Dataset):
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  synthetic_size: Optional[int] = None):
-        if data_file and os.path.exists(data_file):
+        if data_file is not None:
+            enforce(os.path.exists(data_file),
+                    f"UCIHousing data_file {data_file!r} does not exist")
             raw = np.loadtxt(data_file).astype(np.float32)
             # canonical 80/20 split by mode — train and test must differ
             cut = int(len(raw) * 0.8)

@@ -62,10 +62,14 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
                *extra_cxx_cflags, *sources, "-o", tmp_path, *extra_ldflags]
         if verbose:
             print("compiling:", " ".join(cmd))
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        enforce(proc.returncode == 0,
-                f"cpp_extension build failed:\n{proc.stderr}")
-        os.rename(tmp_path, so_path)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            enforce(proc.returncode == 0,
+                    f"cpp_extension build failed:\n{proc.stderr}")
+            os.rename(tmp_path, so_path)
+        finally:
+            if os.path.exists(tmp_path):   # failed build: no orphan files
+                os.unlink(tmp_path)
     return ctypes.CDLL(so_path)
 
 

@@ -1,0 +1,50 @@
+"""cost_model / onnx-gating / WeightedRandomSampler tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def test_cost_model_profiles_flops_and_time():
+    from paddle_tpu.cost_model import CostModel
+
+    def fn(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((64, 64))
+    b = jnp.ones((64, 64))
+    out = CostModel().profile_measure(fn, (a, b))
+    assert out["flops"] >= 2 * 64 ** 3 * 0.9
+    assert out["time"] > 0
+    assert out["bytes_accessed"] > 0
+
+
+def test_onnx_export_gated_with_guidance():
+    pt.seed(0)
+    net = nn.Linear(2, 2)
+    if pt.onnx.onnx_available():
+        pytest.skip("onnx installed; gate test not applicable")
+    with pytest.raises(RuntimeError, match="jit.save"):
+        pt.onnx.export(net, "/tmp/x.onnx")
+
+
+def test_weighted_random_sampler_respects_weights():
+    from paddle_tpu.io import WeightedRandomSampler
+    np.random.seed(0)
+    s = WeightedRandomSampler([0.0, 1.0, 9.0], num_samples=3000,
+                              replacement=True)
+    draws = np.asarray(list(iter(s)))
+    assert len(s) == 3000 and draws.shape == (3000,)
+    assert 0 not in np.unique(draws)
+    frac2 = np.mean(draws == 2)
+    assert 0.85 < frac2 < 0.95
+
+    s2 = WeightedRandomSampler([1.0, 1.0], num_samples=2,
+                               replacement=False)
+    assert sorted(list(iter(s2))) == [0, 1]
+    with pytest.raises(Exception, match="without replacement"):
+        WeightedRandomSampler([1.0], num_samples=2, replacement=False)

@@ -102,7 +102,9 @@ class TestIncubateOptimizers:
     def test_model_average_tracks_mean(self):
         from paddle_tpu.incubate.optimizer import ModelAverage
         model, loss_fn = _quadratic()
+        # rate=1.0: window == count, so the average is the exact mean
         opt = ModelAverage(pt.optimizer.SGD(learning_rate=0.05),
+                           average_window_rate=1.0,
                            max_average_window=100)
         params = model.trainable_variables()
         state = opt.init(params)
@@ -116,6 +118,28 @@ class TestIncubateOptimizers:
         np.testing.assert_allclose(
             np.asarray(jax.tree_util.tree_leaves(avg)[0]),
             np.mean(history, axis=0), rtol=1e-5)
+
+    def test_model_average_window_rate_limits_window(self):
+        """rate < 1 keeps a growing-window average: recent params dominate
+        once count exceeds rate*count's clip."""
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        model, loss_fn = _quadratic()
+        opt = ModelAverage(pt.optimizer.SGD(learning_rate=0.0),
+                           average_window_rate=0.2,
+                           min_average_window=1, max_average_window=4)
+        params = model.trainable_variables()
+        state = opt.init(params)
+        g = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # params never change (lr=0); run well past window saturation so
+        # the streaming sum converges to window * param
+        for _ in range(60):
+            params, state = opt.apply_gradients(g, params, state)
+        avg = opt.average(state, params)
+        # constant params: windowed mean must equal the constant
+        for a, p in zip(jax.tree_util.tree_leaves(avg),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(p),
+                                       rtol=1e-4)
 
 
 class TestIncubateNN:

@@ -50,6 +50,8 @@ _MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.device",
     "paddle_tpu.text",
+    "paddle_tpu.cost_model",
+    "paddle_tpu.onnx",
     "paddle_tpu.incubate",
     "paddle_tpu.regularizer",
     "paddle_tpu.utils",
