@@ -30,13 +30,14 @@ class CostModel:
         None uses the default."""
         if device is not None:
             try:
-                jax.devices(device)
+                dev = jax.devices(device)[0]
             except RuntimeError as e:
                 raise RuntimeError(
                     f"device {device!r} unavailable: {e}") from e
-            jitted = jax.jit(fn, backend=device)
-        else:
-            jitted = jax.jit(fn)
+            # placing the inputs pins the computation to the backend
+            # (jit's backend= kwarg is deprecated)
+            example_args = jax.device_put(tuple(example_args), dev)
+        jitted = jax.jit(fn)
         compiled = jitted.lower(*example_args).compile()
         analyses = compiled.cost_analysis()
         ca = analyses[0] if isinstance(analyses, (list, tuple)) else analyses

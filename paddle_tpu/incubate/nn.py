@@ -31,7 +31,7 @@ class FusedMultiHeadAttention(Layer):
                  dtype="float32"):
         super().__init__()
         from ..framework.errors import enforce
-        enforce(embed_dim % num_heads == 0,
+        enforce(num_heads > 0 and embed_dim % num_heads == 0,
                 f"embed_dim {embed_dim} must divide by num_heads "
                 f"{num_heads}")
         self.embed_dim = embed_dim

@@ -148,6 +148,7 @@ class WeightedRandomSampler(Sampler):
         self.weights = np.asarray(weights, np.float64)
         enforce(np.all(self.weights >= 0), "weights must be non-negative")
         enforce(self.weights.sum() > 0, "weights must not all be zero")
+        enforce(num_samples > 0, "num_samples must be positive")
         self.num_samples = num_samples
         self.replacement = replacement
         enforce(replacement

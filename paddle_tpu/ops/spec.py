@@ -223,10 +223,167 @@ def _populate() -> None:
         ref=lambda x: np.cumsum(x, axis=1),
         sample=lambda rng: (_r(rng, 3, 4),)))
 
+    # -- extended corpus (tensor_ops.py / linalg.py, round 4) -------------
+    unary("logsumexp", lambda x: pt.logsumexp(x, axis=1),
+          lambda x: np.log(np.sum(np.exp(x), axis=1)))
+    unary("expm1", pt.expm1, np.expm1)
+    unary("log2", pt.log2, np.log2, sample=lambda rng: (_pos(rng, 3, 4),))
+    unary("log10", pt.log10, np.log10,
+          sample=lambda rng: (_pos(rng, 3, 4),))
+    unary("asin", pt.asin, np.arcsin,
+          sample=lambda rng: (rng.uniform(-0.9, 0.9, (3, 4)).astype(
+              np.float32),))
+    unary("acos", pt.acos, np.arccos,
+          sample=lambda rng: (rng.uniform(-0.9, 0.9, (3, 4)).astype(
+              np.float32),))
+    unary("atan", pt.atan, np.arctan)
+    unary("sinh", pt.sinh, np.sinh)
+    unary("cosh", pt.cosh, np.cosh)
+    unary("tan", pt.tan, np.tan,
+          sample=lambda rng: (rng.uniform(-1.0, 1.0, (3, 4)).astype(
+              np.float32),))
+    unary("deg2rad", pt.deg2rad, np.deg2rad)
+    unary("rad2deg", pt.rad2deg, np.rad2deg)
+    unary("frac", pt.frac, lambda x: x - np.trunc(x), grad_wrt=())
+    unary("erfinv", pt.erfinv,
+          sample=lambda rng: (rng.uniform(-0.8, 0.8, (3, 4)).astype(
+              np.float32),),
+          ref=lambda x: np.vectorize(_erfinv_scalar)(x).astype(np.float64),
+          rtol=1e-4, atol=1e-5, grad_rtol=2e-2, grad_atol=2e-3)
+    unary("logit", lambda x: pt.logit(x),
+          lambda x: np.log(x) - np.log1p(-x),
+          sample=lambda rng: (rng.uniform(0.1, 0.9, (3, 4)).astype(
+              np.float32),))
+    unary("stanh", pt.stanh,
+          lambda x: 1.7159 * np.tanh(0.67 * x))
+    unary("trace", pt.trace, np.trace,
+          sample=lambda rng: (_r(rng, 4, 4),))
+    unary("diagonal", lambda x: pt.diagonal(x, offset=1),
+          lambda x: np.diagonal(x, offset=1),
+          sample=lambda rng: (_r(rng, 4, 4),))
+    unary("median", lambda x: pt.median(x, axis=1),
+          lambda x: np.median(x, axis=1),
+          sample=lambda rng: (_r(rng, 3, 5),), grad_wrt=())
+    unary("quantile", lambda x: pt.quantile(x, 0.25, axis=1),
+          lambda x: np.quantile(x, 0.25, axis=1),
+          sample=lambda rng: (_r(rng, 3, 5),), grad_wrt=())
+    unary("amax", lambda x: pt.amax(x, axis=1),
+          lambda x: np.max(x, axis=1), grad_wrt=())
+    unary("amin", lambda x: pt.amin(x, axis=1),
+          lambda x: np.min(x, axis=1), grad_wrt=())
+    unary("moveaxis", lambda x: pt.moveaxis(x, 0, 1),
+          lambda x: np.moveaxis(x, 0, 1))
+    unary("rot90", lambda x: pt.rot90(x),
+          lambda x: np.rot90(x), sample=lambda rng: (_r(rng, 3, 4),))
+    unary("repeat_interleave",
+          lambda x: pt.repeat_interleave(x, 2, axis=1),
+          lambda x: np.repeat(x, 2, axis=1))
+    def _with_nans(rng):
+        x = _r(rng, 3, 5)
+        x[0, 1] = np.nan
+        x[2, 3] = np.nan
+        return (x,)
+
+    unary("nanmean", lambda x: pt.nanmean(x, axis=1),
+          lambda x: np.nanmean(x, axis=1), sample=_with_nans, grad_wrt=())
+    binary("hypot", pt.hypot, np.hypot)
+    binary("copysign", pt.copysign, np.copysign, grad_wrt=(0,))
+    binary("lerp", lambda x, y: pt.lerp(x, y, 0.3),
+           lambda x, y: x + 0.3 * (y - x))
+    binary("kron", pt.kron, np.kron,
+           sample=lambda rng: (_r(rng, 2, 3), _r(rng, 3, 2)))
+    binary("inner", pt.inner, np.inner,
+           sample=lambda rng: (_r(rng, 4), _r(rng, 4)))
+    binary("mv", pt.mv, lambda m, v: m @ v,
+           sample=lambda rng: (_r(rng, 3, 4), _r(rng, 4)))
+    binary("tensordot", lambda a, b: pt.tensordot(a, b, axes=1),
+           lambda a, b: np.tensordot(a, b, axes=1),
+           sample=lambda rng: (_r(rng, 3, 4), _r(rng, 4, 5)))
+    binary("addmm_default",
+           lambda i, a: pt.addmm(i, a, np.eye(4, dtype=np.float32)),
+           lambda i, a: i + a,
+           sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)))
+    register_op(OpSpec(
+        name="gcd", fn=pt.gcd, ref=np.gcd,
+        sample=lambda rng: (rng.randint(1, 40, (6,)),
+                            rng.randint(1, 40, (6,))), grad_wrt=()))
+    register_op(OpSpec(
+        name="searchsorted",
+        fn=lambda e, v: pt.searchsorted(e, v),
+        ref=lambda e, v: np.searchsorted(e, v),
+        sample=lambda rng: (np.sort(_r(rng, 6)), _r(rng, 4)),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="linalg.det", fn=pt.linalg.det, ref=np.linalg.det,
+        sample=lambda rng: (_r(rng, 3, 3) + 3 * np.eye(3, dtype=np.float32),),
+        grad_wrt=(0,), rtol=1e-4, atol=1e-4,
+        grad_rtol=2e-2, grad_atol=2e-3))
+    register_op(OpSpec(
+        name="linalg.inv", fn=pt.linalg.inv, ref=np.linalg.inv,
+        sample=lambda rng: (_r(rng, 3, 3) + 3 * np.eye(3, dtype=np.float32),),
+        grad_wrt=(0,), rtol=1e-4, atol=1e-4,
+        grad_rtol=2e-2, grad_atol=2e-3))
+    register_op(OpSpec(
+        name="linalg.solve",
+        fn=pt.linalg.solve, ref=np.linalg.solve,
+        sample=lambda rng: (_r(rng, 3, 3) + 3 * np.eye(3, dtype=np.float32),
+                            _r(rng, 3, 2)),
+        grad_wrt=(0, 1), rtol=1e-4, atol=1e-4,
+        grad_rtol=2e-2, grad_atol=2e-3))
+    register_op(OpSpec(
+        name="linalg.multi_dot",
+        fn=lambda a, b, c: pt.linalg.multi_dot([a, b, c]),
+        ref=lambda a, b, c: a @ b @ c,
+        sample=lambda rng: (_r(rng, 2, 3), _r(rng, 3, 4), _r(rng, 4, 2)),
+        grad_wrt=(0, 1, 2), rtol=1e-4, atol=1e-5))
+    unary("nn.functional.relu6", F.relu6, lambda x: np.clip(x, 0, 6),
+          grad_rtol=2e-2, grad_atol=2e-3)
+    unary("nn.functional.elu", F.elu,
+          lambda x: np.where(x > 0, x, np.exp(x) - 1))
+    unary("nn.functional.mish", F.mish,
+          lambda x: x * np.tanh(np.log1p(np.exp(x))))
+    unary("nn.functional.softplus", F.softplus,
+          lambda x: np.log1p(np.exp(x)))
+    unary("nn.functional.hardsigmoid", F.hardsigmoid,
+          lambda x: np.clip(x / 6 + 0.5, 0, 1), grad_rtol=2e-2,
+          grad_atol=2e-3)
+    unary("nn.functional.glu", lambda x: F.glu(x, axis=-1),
+          lambda x: x[..., :x.shape[-1] // 2]
+          / (1 + np.exp(-x[..., x.shape[-1] // 2:])),
+          sample=lambda rng: (_r(rng, 3, 8),))
+    register_op(OpSpec(
+        name="nn.functional.cosine_similarity",
+        fn=lambda a, b: F.cosine_similarity(a, b, axis=1),
+        ref=lambda a, b: np.sum(a * b, 1)
+        / (np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1)),
+        sample=lambda rng: (_r(rng, 3, 8), _r(rng, 3, 8)),
+        grad_wrt=(0, 1)))
+    register_op(OpSpec(
+        name="nn.functional.kl_div",
+        fn=lambda i, t: F.kl_div(i, t, reduction="mean"),
+        ref=lambda i, t: np.mean(np.where(
+            t > 0, t * (np.log(np.maximum(t, 1e-30)) - i), 0.0)),
+        sample=lambda rng: (np.log(_np_softmax(_r(rng, 4, 5))),
+                            _np_softmax(_r(rng, 4, 5))),
+        grad_wrt=(0,)))
+
 
 def _erf_scalar(x: float) -> float:
     import math
     return math.erf(float(x))
+
+
+def _erfinv_scalar(y: float) -> float:
+    # bisection on erf — dependency-free numpy reference
+    import math
+    lo, hi = -6.0, 6.0
+    for _ in range(80):
+        mid = (lo + hi) / 2
+        if math.erf(mid) < y:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2
 
 
 def _np_softmax(x):
