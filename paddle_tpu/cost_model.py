@@ -35,8 +35,16 @@ class CostModel:
                 raise RuntimeError(
                     f"device {device!r} unavailable: {e}") from e
             # placing the inputs pins the computation to the backend
-            # (jit's backend= kwarg is deprecated)
-            example_args = jax.device_put(tuple(example_args), dev)
+            # (jit's backend= kwarg is deprecated); a zero-arg fn is
+            # pinned via default_device instead
+            if example_args:
+                example_args = jax.device_put(tuple(example_args), dev)
+            else:
+                fn_orig = fn
+
+                def fn(*a):
+                    with jax.default_device(dev):
+                        return fn_orig(*a)
         jitted = jax.jit(fn)
         compiled = jitted.lower(*example_args).compile()
         analyses = compiled.cost_analysis()

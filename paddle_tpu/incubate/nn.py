@@ -32,8 +32,8 @@ class FusedMultiHeadAttention(Layer):
         super().__init__()
         from ..framework.errors import enforce
         enforce(num_heads > 0 and embed_dim % num_heads == 0,
-                f"embed_dim {embed_dim} must divide by num_heads "
-                f"{num_heads}")
+                f"num_heads must be positive and divide embed_dim "
+                f"(got num_heads={num_heads}, embed_dim={embed_dim})")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads

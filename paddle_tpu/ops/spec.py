@@ -305,8 +305,9 @@ def _populate() -> None:
            sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)))
     register_op(OpSpec(
         name="gcd", fn=pt.gcd, ref=np.gcd,
-        sample=lambda rng: (rng.randint(1, 40, (6,)),
-                            rng.randint(1, 40, (6,))), grad_wrt=()))
+        sample=lambda rng: (rng.randint(1, 40, (6,)).astype(np.int32),
+                            rng.randint(1, 40, (6,)).astype(np.int32)),
+        grad_wrt=()))
     register_op(OpSpec(
         name="searchsorted",
         fn=lambda e, v: pt.searchsorted(e, v),

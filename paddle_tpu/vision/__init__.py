@@ -1,3 +1,4 @@
 """paddle_tpu.vision (reference: python/paddle/vision)."""
 from . import datasets, transforms  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401

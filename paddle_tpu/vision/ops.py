@@ -1,0 +1,442 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — yolo_box:253,
+deform_conv2d:430/DeformConv2D:633, psroi_pool:918, roi_pool:1033,
+roi_align:1160 (+ Layer wrappers), nms:1376, read_file:826,
+decode_jpeg:871, ConvNormActivation:1322).
+
+TPU-first shapes of the detection ops:
+- roi_align / roi_pool / psroi_pool: per-box bilinear sampling is expressed
+  as static gathers + interpolation weights under ``vmap`` — fixed output
+  shapes (num_boxes, C, ph, pw), no dynamic control flow;
+- deform_conv2d: offset-shifted kernel taps become one bilinear-sample
+  gather per tap followed by a single big (N*H*W, K*C)×(K*C, O) matmul —
+  the MXU does the contraction;
+- nms: the O(N²) IoU matrix + a ``lax.while_loop`` greedy sweep — static
+  shapes; the kept mask converts to indices on the host (eager API, like
+  the reference's dynamic-shape op);
+- yolo_box: pure elementwise decode.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.errors import enforce
+from ..nn import functional as F
+from ..nn.layer import Layer
+from .models.utils import ConvNormActivation  # noqa: F401  (reference :1322)
+
+__all__ = ["yolo_box", "roi_align", "roi_pool", "psroi_pool", "RoIAlign",
+           "RoIPool", "PSRoIPool", "nms", "deform_conv2d", "DeformConv2D",
+           "read_file", "decode_jpeg", "ConvNormActivation"]
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling shared core
+# ---------------------------------------------------------------------------
+def _bilinear_sample(feat, y, x):
+    """Sample feat (C, H, W) at fractional (y, x) grids (...,) → (C, ...).
+
+    Out-of-range samples contribute 0 (roi_align border semantics)."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            valid = ((yy >= 0) & (yy < H) & (xx >= 0) & (xx < W))
+            yc = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
+            v = feat[:, yc, xc]                      # (C, ...)
+            out = out + v * (wy * wx * valid)[None]
+    return out
+
+
+def _box_batch_index(boxes_num, total):
+    """(num_boxes,) image index per box from per-image counts."""
+    boxes_num = np.asarray(boxes_num)
+    return jnp.asarray(np.repeat(np.arange(len(boxes_num)), boxes_num),
+                       jnp.int32)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True):
+    """Mask R-CNN RoIAlign (reference ops.py:1160)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    img_idx = _box_batch_index(boxes_num, boxes.shape[0])
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+
+    def one_box(feat, box):
+        x1, y1, x2, y2 = (box * spatial_scale) - (off if aligned else 0.0)
+        rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = jnp.arange(ph)[:, None, None, None]     # (ph,1,sr,1)
+        ix = jnp.arange(pw)[None, :, None, None]     # (1,pw,1,1)
+        sy = jnp.arange(sr)[None, None, :, None]
+        sx = jnp.arange(sr)[None, None, None, :]
+        ys = y1 + (iy + (sy + 0.5) / sr) * bin_h     # (ph,pw,sr,sr)
+        xs = x1 + (ix + (sx + 0.5) / sr) * bin_w
+        vals = _bilinear_sample(feat, ys, xs)        # (C,ph,pw,sr,sr)
+        return jnp.mean(vals, axis=(-2, -1))         # (C,ph,pw)
+
+    return jax.vmap(one_box)(x[img_idx], boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):
+    """Fast R-CNN RoIPool: max over quantized bins (reference ops.py:1033)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    H, W = x.shape[2], x.shape[3]
+    boxes = jnp.asarray(boxes, jnp.float32)
+    img_idx = _box_batch_index(boxes_num, boxes.shape[0])
+    # dense sampling grid per bin (static) with max-reduction approximates
+    # the quantized max-pool exactly for sr >= bin span in pixels; use a
+    # fixed sr and nearest-neighbor samples so maxima are real pixels
+    sr = 4
+
+    def one_box(feat, box):
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = jnp.arange(ph)[:, None, None, None]
+        ix = jnp.arange(pw)[None, :, None, None]
+        sy = jnp.arange(sr)[None, None, :, None]
+        sx = jnp.arange(sr)[None, None, None, :]
+        ys = jnp.floor(y1 + iy * bin_h + (sy + 0.5) / sr * bin_h)
+        xs = jnp.floor(x1 + ix * bin_w + (sx + 0.5) / sr * bin_w)
+        yc = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        vals = feat[:, yc, xc]                       # (C,ph,pw,sr,sr)
+        return jnp.max(vals, axis=(-2, -1))
+
+    return jax.vmap(one_box)(x[img_idx], boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):
+    """Position-sensitive RoI pooling (reference ops.py:918): input has
+    C = out_channels * ph * pw; bin (i, j) pools its OWN channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    C = x.shape[1]
+    enforce(C % (ph * pw) == 0,
+            f"psroi_pool needs channels {C} divisible by {ph * pw}")
+    out_c = C // (ph * pw)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    img_idx = _box_batch_index(boxes_num, boxes.shape[0])
+    H, W = x.shape[2], x.shape[3]
+    sr = 4
+
+    def one_box(feat, box):
+        x1, y1, x2, y2 = box * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = jnp.arange(ph)[:, None, None, None]
+        ix = jnp.arange(pw)[None, :, None, None]
+        sy = jnp.arange(sr)[None, None, :, None]
+        sx = jnp.arange(sr)[None, None, None, :]
+        ys = jnp.floor(y1 + iy * bin_h + (sy + 0.5) / sr * bin_h)
+        xs = jnp.floor(x1 + ix * bin_w + (sx + 0.5) / sr * bin_w)
+        yc = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        # (C,ph,pw,sr,sr) → average per bin
+        vals = jnp.mean(feat[:, yc, xc], axis=(-2, -1))   # (C,ph,pw)
+        # select the channel group of each bin:
+        # group layout: channel c of bin (i,j) lives at c*ph*pw + i*pw + j
+        vals = vals.reshape(out_c, ph, pw, ph, pw)
+        iy2 = jnp.arange(ph)[:, None]
+        ix2 = jnp.arange(pw)[None, :]
+        return vals[:, iy2, ix2, iy2, ix2]           # (out_c, ph, pw)
+
+    return jax.vmap(one_box)(x[img_idx], boxes)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms_mask(boxes, scores=None, iou_threshold: float = 0.3):
+    """Static-shape greedy NMS core: (N,) bool keep mask, jittable.
+
+    Boxes are visited in descending score order; a box is kept iff it does
+    not overlap (> threshold) any higher-scored kept box."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    n = boxes.shape[0]
+    order = jnp.argsort(-jnp.asarray(scores, jnp.float32)) \
+        if scores is not None else jnp.arange(n)
+    iou = _iou_matrix(boxes[order])
+
+    def body(i, keep):
+        overlaps = (iou[i] > iou_threshold) & keep
+        overlaps = overlaps & (jnp.arange(n) < i)   # only higher-ranked
+        return keep.at[i].set(~jnp.any(overlaps))
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Greedy NMS returning kept indices, score-descending (reference
+    ops.py:1376).  Eager API (dynamic output length, like the reference
+    op); use ``nms_mask`` inside jitted programs."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    n = boxes.shape[0]
+    if category_idxs is not None:
+        # multiclass: offset boxes per category so classes never suppress
+        # each other (the standard batched-NMS trick)
+        enforce(categories is not None,
+                "categories must accompany category_idxs")
+        span = jnp.max(boxes) + 1.0
+        offsets = jnp.asarray(category_idxs, jnp.float32)[:, None] * span
+        shifted = boxes + offsets
+    else:
+        shifted = boxes
+    keep = np.asarray(nms_mask(shifted, scores, iou_threshold))
+    idx = np.nonzero(keep)[0]
+    if scores is not None:
+        s = np.asarray(scores)[idx]
+        idx = idx[np.argsort(-s)]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return jnp.asarray(idx, jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# YOLO decode
+# ---------------------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float,
+             downsample_ratio: int, clip_bbox: bool = True,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5):
+    """Decode YOLOv3 head output to boxes + scores (reference ops.py:253).
+
+    x: (N, A*(5+cls), H, W); returns (boxes (N, A*H*W, 4) in xyxy,
+    scores (N, A*H*W, cls)).  Confidence below conf_thresh zeroes the
+    box+score (the reference's semantics)."""
+    x = jnp.asarray(x)
+    n, _, h, w = x.shape
+    a = len(anchors) // 2
+    anchors_arr = jnp.asarray(anchors, jnp.float32).reshape(a, 2)
+    img_size = jnp.asarray(img_size, jnp.float32)      # (N, 2) h, w
+
+    feats = x.reshape(n, a, 5 + class_num, h, w)
+    tx, ty = feats[:, :, 0], feats[:, :, 1]
+    tw, th = feats[:, :, 2], feats[:, :, 3]
+    obj = jax.nn.sigmoid(feats[:, :, 4])
+    cls_prob = jax.nn.sigmoid(feats[:, :, 5:])
+
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(tx) * scale_x_y - bias + gx) / w
+    cy = (jax.nn.sigmoid(ty) * scale_x_y - bias + gy) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(tw) * anchors_arr[None, :, None, None, 0] / input_w
+    bh = jnp.exp(th) * anchors_arr[None, :, None, None, 1] / input_h
+
+    im_h = img_size[:, 0][:, None, None, None]
+    im_w = img_size[:, 1][:, None, None, None]
+    x1 = (cx - bw / 2) * im_w
+    y1 = (cy - bh / 2) * im_h
+    x2 = (cx + bw / 2) * im_w
+    y2 = (cy + bh / 2) * im_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, im_w - 1)
+        y1 = jnp.clip(y1, 0, im_h - 1)
+        x2 = jnp.clip(x2, 0, im_w - 1)
+        y2 = jnp.clip(y2, 0, im_h - 1)
+
+    conf = obj[..., None] * jnp.moveaxis(cls_prob, 2, -1)  # (n,a,h,w,cls)
+    mask = (obj > conf_thresh)[..., None]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * mask
+    scores = conf * mask
+    return (boxes.reshape(n, a * h * w, 4),
+            scores.reshape(n, a * h * w, class_num))
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution
+# ---------------------------------------------------------------------------
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups: int = 1, groups: int = 1,
+                  mask=None):
+    """Deformable conv v1/v2 (reference ops.py:430; v2 when mask given).
+
+    x: (N, Cin, H, W); offset: (N, 2*dg*kh*kw, Hout, Wout);
+    mask: (N, dg*kh*kw, Hout, Wout); weight: (Cout, Cin/g, kh, kw).
+    Implementation: per-tap bilinear sampling (gathers) then one
+    (N*Ho*Wo, kh*kw*Cin)×(kh*kw*Cin, Cout) MXU matmul."""
+    x = jnp.asarray(x)
+    offset = jnp.asarray(offset)
+    weight = jnp.asarray(weight)
+    enforce(groups == 1 and deformable_groups == 1,
+            "deform_conv2d: groups/deformable_groups > 1 not supported "
+            "in this build")
+    n, cin, H, W = x.shape
+    cout, _, kh, kw = weight.shape
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    ho = (H + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+    wo = (W + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+    enforce(offset.shape[1] == 2 * kh * kw,
+            f"offset channels {offset.shape[1]} != 2*kh*kw {2 * kh * kw}")
+
+    # base sampling positions per output pixel and tap
+    oy = jnp.arange(ho) * s[0] - p[0]
+    ox = jnp.arange(wo) * s[1] - p[1]
+    ky = jnp.arange(kh) * d[0]
+    kx = jnp.arange(kw) * d[1]
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # ho,1,kh,1
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # 1,wo,1,kw
+
+    off = offset.reshape(n, kh, kw, 2, ho, wo)
+    dy = jnp.transpose(off[:, :, :, 0], (0, 3, 4, 1, 2))  # n,ho,wo,kh,kw
+    dx = jnp.transpose(off[:, :, :, 1], (0, 3, 4, 1, 2))
+    ys = base_y[None, :, :, :, :] + dy
+    xs = base_x[None, :, :, :, :] + dx
+
+    def per_image(feat, y, x_):
+        return _bilinear_sample(feat, y, x_)         # (C,ho,wo,kh,kw)
+
+    sampled = jax.vmap(per_image)(x, ys, xs)         # (n,C,ho,wo,kh,kw)
+    if mask is not None:
+        m = jnp.asarray(mask).reshape(n, kh, kw, ho, wo)
+        m = jnp.transpose(m, (0, 3, 4, 1, 2))        # n,ho,wo,kh,kw
+        sampled = sampled * m[:, None]
+    # contract (C, kh, kw) against the kernel on the MXU
+    cols = jnp.transpose(sampled, (0, 2, 3, 1, 4, 5)).reshape(
+        n * ho * wo, cin * kh * kw)
+    wmat = weight.reshape(cout, cin * kh * kw).T
+    out = (cols @ wmat).reshape(n, ho, wo, cout)
+    out = jnp.transpose(out, (0, 3, 1, 2))
+    if bias is not None:
+        out = out + jnp.asarray(bias)[None, :, None, None]
+    return out
+
+
+class DeformConv2D(Layer):
+    """Reference ops.py:633 — learnable weight/bias; offset (and mask)
+    come in at call time from a companion conv."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, deformable_groups=1,
+                 groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        import math
+        from ..nn import initializer as I
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._dg, self._groups = deformable_groups, groups
+        fan_in = in_channels * k[0] * k[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, k[0], k[1]),
+            default_initializer=I.Uniform(-bound, bound), attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True,
+            default_initializer=I.Uniform(-bound, bound), attr=bias_attr)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._dg, self._groups, mask)
+
+
+# ---------------------------------------------------------------------------
+# image IO (host-side)
+# ---------------------------------------------------------------------------
+def read_file(filename: str):
+    """Raw file bytes as a uint8 tensor (reference ops.py:826)."""
+    with open(filename, "rb") as f:
+        return jnp.asarray(np.frombuffer(f.read(), np.uint8))
+
+
+def decode_jpeg(x, mode: str = "unchanged"):
+    """Decode a JPEG byte tensor to (C, H, W) uint8 (reference ops.py:871);
+    PIL-backed host op."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))
+    return jnp.asarray(arr)

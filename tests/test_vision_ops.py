@@ -1,0 +1,215 @@
+"""paddle.vision.ops tests: analytic references (no torchvision in-image).
+
+- roi_align on a linear feature map must reproduce the bin-center values
+  exactly (bilinear interpolation of a linear function is exact);
+- deform_conv2d with zero offsets must equal the plain convolution;
+- nms against a hand-worked suppression example; yolo_box against a
+  manual decode.
+"""
+import io
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import ops as V
+
+
+class TestRoIAlign:
+    def test_linear_feature_exact(self):
+        # f(y, x) = 2y + 3x: bilinear sampling is exact, so each output
+        # bin equals f at the mean of its sample points = bin center
+        H = W = 16
+        yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+        feat = (2 * yy + 3 * xx)[None, None]          # (1,1,H,W)
+        box = np.asarray([[2.0, 2.0, 10.0, 10.0]], np.float32)
+        out = np.asarray(V.roi_align(jnp.asarray(feat), jnp.asarray(box),
+                                     [1], output_size=4, aligned=True))
+        assert out.shape == (1, 1, 4, 4)
+        # aligned=True: sampling coords are box*scale - 0.5
+        x1 = y1 = 2.0 - 0.5
+        bin_sz = 8.0 / 4
+        for i in range(4):
+            for j in range(4):
+                cy = y1 + (i + 0.5) * bin_sz
+                cx = x1 + (j + 0.5) * bin_sz
+                np.testing.assert_allclose(out[0, 0, i, j], 2 * cy + 3 * cx,
+                                           rtol=1e-5)
+
+    def test_batching_by_boxes_num(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 8, 8),
+                        jnp.float32)
+        boxes = jnp.asarray([[0, 0, 4, 4], [1, 1, 6, 6], [2, 2, 7, 7]],
+                            jnp.float32)
+        out = V.roi_align(x, boxes, [1, 2], output_size=2)
+        assert out.shape == (3, 3, 2, 2)
+        # box 0 samples image 0; boxes 1-2 sample image 1
+        out_swapped = V.roi_align(x[::-1], boxes, [2, 1], output_size=2)
+        assert not np.allclose(np.asarray(out), np.asarray(out_swapped))
+
+
+class TestRoIPool:
+    def test_constant_regions(self):
+        feat = np.zeros((1, 1, 8, 8), np.float32)
+        feat[:, :, :4] = 1.0
+        feat[:, :, 4:] = 5.0
+        box = np.asarray([[0.0, 0.0, 7.0, 7.0]], np.float32)
+        out = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(box),
+                                    [1], output_size=2))
+        np.testing.assert_allclose(out[0, 0], [[1, 1], [5, 5]])
+
+    def test_psroi_pool_selects_bin_groups(self):
+        ph = pw = 2
+        out_c = 3
+        C = out_c * ph * pw
+        # channel c*4 + i*2 + j is constant (c*100 + i*10 + j)
+        feat = np.zeros((1, C, 8, 8), np.float32)
+        for c in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    feat[0, c * ph * pw + i * pw + j] = c * 100 + i * 10 + j
+        box = np.asarray([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = np.asarray(V.psroi_pool(jnp.asarray(feat), jnp.asarray(box),
+                                      [1], output_size=2))
+        for c in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    assert out[0, c, i, j] == c * 100 + i * 10 + j
+
+
+class TestNMS:
+    def test_greedy_suppression(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                             [20, 20, 30, 30], [21, 21, 29, 29]],
+                            jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7, 0.95])
+        keep = np.asarray(V.nms(boxes, 0.3, scores=scores))
+        # box 3 beats box 2 (overlap), box 0 beats box 1
+        assert set(keep.tolist()) == {0, 3}
+        assert keep.tolist()[0] == 3  # score-descending order
+
+    def test_multiclass_does_not_cross_suppress(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8])
+        cats = jnp.asarray([0, 1])
+        keep = np.asarray(V.nms(boxes, 0.3, scores=scores,
+                                category_idxs=cats, categories=[0, 1]))
+        assert set(keep.tolist()) == {0, 1}
+
+    def test_top_k_and_jittable_mask(self):
+        boxes = jnp.asarray([[0, 0, 4, 4], [10, 10, 14, 14],
+                             [20, 20, 24, 24]], jnp.float32)
+        scores = jnp.asarray([0.5, 0.9, 0.7])
+        keep = np.asarray(V.nms(boxes, 0.5, scores=scores, top_k=2))
+        assert keep.tolist() == [1, 2]
+        mask = jax.jit(lambda b, s: V.nms_mask(b, s, 0.5))(boxes, scores)
+        assert np.asarray(mask).all()   # disjoint boxes all kept
+
+
+class TestYoloBox:
+    def test_decode_matches_manual(self):
+        n, a, cls, h, w = 1, 2, 3, 4, 4
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, a * (5 + cls), h, w).astype(np.float32)
+        anchors = [10, 13, 16, 30]
+        img = np.asarray([[128, 128]], np.int32)
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                   anchors, cls, conf_thresh=0.0,
+                                   downsample_ratio=32, clip_bbox=False)
+        assert boxes.shape == (1, a * h * w, 4)
+        assert scores.shape == (1, a * h * w, cls)
+        # manual decode of anchor 0, cell (0, 0)
+        f = x.reshape(n, a, 5 + cls, h, w)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        cx = sig(f[0, 0, 0, 0, 0]) / w
+        cy = sig(f[0, 0, 1, 0, 0]) / h
+        bw = np.exp(f[0, 0, 2, 0, 0]) * anchors[0] / (32 * w)
+        bh = np.exp(f[0, 0, 3, 0, 0]) * anchors[1] / (32 * h)
+        want = [(cx - bw / 2) * 128, (cy - bh / 2) * 128,
+                (cx + bw / 2) * 128, (cy + bh / 2) * 128]
+        np.testing.assert_allclose(np.asarray(boxes)[0, 0], want, rtol=1e-4)
+        # conf = sigmoid(obj) * sigmoid(cls)
+        want_s = sig(f[0, 0, 4, 0, 0]) * sig(f[0, 0, 5, 0, 0])
+        np.testing.assert_allclose(np.asarray(scores)[0, 0, 0], want_s,
+                                   rtol=1e-5)
+
+    def test_conf_thresh_zeroes(self):
+        x = np.full((1, 7, 2, 2), -10.0, np.float32)  # obj ~ 0
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray([[64, 64]]),
+                                   [10, 13], 2, conf_thresh=0.5,
+                                   downsample_ratio=32)
+        assert float(jnp.sum(jnp.abs(boxes))) == 0.0
+        assert float(jnp.sum(scores)) == 0.0
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 3, 8, 8), jnp.float32)
+        w = jnp.asarray(rng.randn(4, 3, 3, 3), jnp.float32)
+        b = jnp.asarray(rng.randn(4), jnp.float32)
+        offset = jnp.zeros((2, 2 * 9, 8, 8))
+        out = V.deform_conv2d(x, offset, w, b, padding=1)
+        ref = F.conv2d(x, w, b, padding=1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mask_scales_contribution(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(1, 2, 6, 6), jnp.float32)
+        w = jnp.asarray(rng.randn(3, 2, 3, 3), jnp.float32)
+        offset = jnp.zeros((1, 18, 6, 6))
+        full = V.deform_conv2d(x, offset, w, padding=1)
+        half = V.deform_conv2d(x, offset, w, padding=1,
+                               mask=jnp.full((1, 9, 6, 6), 0.5))
+        np.testing.assert_allclose(np.asarray(half), 0.5 * np.asarray(full),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_integer_offset_shifts(self):
+        """A constant (0, +1) x-offset equals convolving the x-shifted
+        image (interior pixels)."""
+        rng = np.random.RandomState(2)
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0] = rng.randn(8, 8)
+        w = jnp.asarray(rng.randn(1, 1, 3, 3), jnp.float32)
+        offset = np.zeros((1, 18, 8, 8), np.float32)
+        offset[0, 1::2] = 1.0    # dx = +1 for every tap
+        out = V.deform_conv2d(jnp.asarray(x), jnp.asarray(offset), w,
+                              padding=1)
+        shifted = np.zeros_like(x)
+        shifted[0, 0, :, :-1] = x[0, 0, :, 1:]
+        ref = F.conv2d(jnp.asarray(shifted), w, padding=1)
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 1:-1, 1:-2],
+                                   np.asarray(ref)[0, 0, 1:-1, 1:-2],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_deform_conv2d_layer(self):
+        pt.seed(0)
+        layer = V.DeformConv2D(3, 8, 3, padding=1)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 8, 8),
+                        jnp.float32)
+        offset = jnp.zeros((1, 18, 8, 8))
+        assert layer(x, offset).shape == (1, 8, 8, 8)
+
+
+class TestImageIO:
+    def test_read_and_decode_jpeg(self, tmp_path):
+        from PIL import Image
+        # smooth gradient: JPEG-friendly, so the roundtrip stays tight
+        yy, xx = np.mgrid[0:10, 0:12]
+        arr = np.stack([yy * 20, xx * 20, yy * 10 + xx * 10],
+                       axis=-1).astype(np.uint8)
+        p = tmp_path / "img.jpg"
+        Image.fromarray(arr).save(str(p), quality=95)
+        raw = V.read_file(str(p))
+        assert raw.dtype == jnp.uint8 and raw.ndim == 1
+        img = V.decode_jpeg(raw, mode="rgb")
+        assert img.shape == (3, 10, 12)
+        # lossy but close
+        diff = np.abs(np.asarray(img, np.int32)
+                      - np.transpose(arr, (2, 0, 1)).astype(np.int32))
+        assert diff.mean() < 30

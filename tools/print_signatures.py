@@ -38,6 +38,7 @@ _MODULES = [
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.vision.models",
+    "paddle_tpu.vision.ops",
     "paddle_tpu.models",
     "paddle_tpu.hapi",
     "paddle_tpu.profiler",
