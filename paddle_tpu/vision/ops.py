@@ -17,7 +17,7 @@ TPU-first shapes of the detection ops:
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
@@ -26,7 +26,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.errors import enforce
-from ..nn import functional as F
 from ..nn.layer import Layer
 from .models.utils import ConvNormActivation  # noqa: F401  (reference :1322)
 
@@ -63,6 +62,9 @@ def _bilinear_sample(feat, y, x):
 def _box_batch_index(boxes_num, total):
     """(num_boxes,) image index per box from per-image counts."""
     boxes_num = np.asarray(boxes_num)
+    enforce(int(boxes_num.sum()) == int(total),
+            f"sum(boxes_num)={int(boxes_num.sum())} must equal the number "
+            f"of boxes {int(total)}")
     return jnp.asarray(np.repeat(np.arange(len(boxes_num)), boxes_num),
                        jnp.int32)
 
@@ -80,7 +82,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     off = 0.5 if aligned else 0.0
 
     def one_box(feat, box):
-        x1, y1, x2, y2 = (box * spatial_scale) - (off if aligned else 0.0)
+        x1, y1, x2, y2 = (box * spatial_scale) - off
         rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
         rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
         bin_h, bin_w = rh / ph, rw / pw
@@ -266,7 +268,7 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
         idx = idx[np.argsort(-s)]
     if top_k is not None:
         idx = idx[:top_k]
-    return jnp.asarray(idx, jnp.int64)
+    return jnp.asarray(idx)   # canonical index dtype (int32 w/o x64)
 
 
 # ---------------------------------------------------------------------------
@@ -278,19 +280,32 @@ def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float,
              iou_aware_factor: float = 0.5):
     """Decode YOLOv3 head output to boxes + scores (reference ops.py:253).
 
-    x: (N, A*(5+cls), H, W); returns (boxes (N, A*H*W, 4) in xyxy,
-    scores (N, A*H*W, cls)).  Confidence below conf_thresh zeroes the
-    box+score (the reference's semantics)."""
+    x: (N, A*(5+cls), H, W) — or (N, A*(6+cls), H, W) with iou_aware,
+    where the leading A channels are per-anchor IoU logits
+    (yolo_box_util.h GetIoUIndex layout).  Returns (boxes (N, A*H*W, 4)
+    in xyxy, scores (N, A*H*W, cls)).  Confidence below conf_thresh
+    zeroes the box+score (the reference's semantics)."""
     x = jnp.asarray(x)
-    n, _, h, w = x.shape
+    n, c, h, w = x.shape
     a = len(anchors) // 2
     anchors_arr = jnp.asarray(anchors, jnp.float32).reshape(a, 2)
     img_size = jnp.asarray(img_size, jnp.float32)      # (N, 2) h, w
 
+    if iou_aware:
+        enforce(c == a * (6 + class_num),
+                f"iou_aware yolo_box expects {a * (6 + class_num)} "
+                f"channels, got {c}")
+        iou = jax.nn.sigmoid(x[:, :a])                 # (n, a, h, w)
+        x = x[:, a:]
+    else:
+        enforce(c == a * (5 + class_num),
+                f"yolo_box expects {a * (5 + class_num)} channels, got {c}")
     feats = x.reshape(n, a, 5 + class_num, h, w)
     tx, ty = feats[:, :, 0], feats[:, :, 1]
     tw, th = feats[:, :, 2], feats[:, :, 3]
     obj = jax.nn.sigmoid(feats[:, :, 4])
+    if iou_aware:   # conf = obj^(1-f) * iou^f (yolo_box_kernel.cc:80)
+        obj = (obj ** (1.0 - iou_aware_factor)) * (iou ** iou_aware_factor)
     cls_prob = jax.nn.sigmoid(feats[:, :, 5:])
 
     gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]

@@ -107,3 +107,186 @@ class RandomHorizontalFlip:
         if np.random.rand() < self.prob:
             return np.asarray(x)[:, ::-1].copy()
         return np.asarray(x)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return np.asarray(x)[::-1].copy()
+        return np.asarray(x)
+
+
+class Pad:
+    """Pad HW(C) images (reference transforms Pad; constant mode)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        l, t, r, b = self.padding
+        pad = [(t, b), (l, r)] + [(0, 0)] * (x.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(x, pad, constant_values=self.fill)
+        return np.pad(x, pad, mode=self.padding_mode)
+
+
+class Grayscale:
+    """RGB HWC -> grayscale with `num_output_channels` copies."""
+
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, x):
+        orig_dtype = np.asarray(x).dtype
+        x = np.asarray(x, np.float32)
+        g = (0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2])
+        g = np.clip(g, 0, 255)
+        out = np.stack([g] * self.num_output_channels, axis=-1)
+        return out.astype(np.uint8) if orig_dtype == np.uint8 else out
+
+
+def _jitter_out(y, orig_dtype):
+    """uint8 inputs clip back to uint8 [0,255]; float inputs stay float
+    clipped to their natural [0,1] range."""
+    if orig_dtype == np.uint8:
+        return np.clip(y, 0, 255).astype(np.uint8)
+    return np.clip(y, 0.0, 1.0).astype(orig_dtype)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, x):
+        if self.value == 0:
+            return np.asarray(x)
+        orig = np.asarray(x).dtype
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return _jitter_out(np.asarray(x, np.float32) * alpha, orig)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, x):
+        if self.value == 0:
+            return np.asarray(x)
+        orig = np.asarray(x).dtype
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        x = np.asarray(x, np.float32)
+        mean = x.mean()
+        return _jitter_out(mean + alpha * (x - mean), orig)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, x):
+        if self.value == 0:
+            return np.asarray(x)
+        orig = np.asarray(x).dtype
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        x = np.asarray(x, np.float32)
+        gray = (0.299 * x[..., 0] + 0.587 * x[..., 1]
+                + 0.114 * x[..., 2])[..., None]
+        return _jitter_out(gray + alpha * (x - gray), orig)
+
+
+class HueTransform:
+    """Approximate hue jitter by rotating RGB channels toward the rolled
+    image (cheap host-side analog; reference uses HSV rotation)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, x):
+        if self.value == 0:
+            return np.asarray(x)
+        orig = np.asarray(x).dtype
+        alpha = np.abs(np.random.uniform(-self.value, self.value))
+        x = np.asarray(x, np.float32)
+        rolled = np.roll(x, 1, axis=-1)
+        return _jitter_out((1 - alpha) * x + alpha * rolled, orig)
+
+
+class ColorJitter:
+    """Compose brightness/contrast/saturation/hue jitters in random order
+    (reference transforms ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, x):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            x = self.ts[i](x)
+        return x
+
+
+class RandomResizedCrop:
+    """Random scale/aspect crop then resize (reference
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        h, w = x.shape[0], x.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = x[i:i + ch, j:j + cw]
+                return Resize(self.size)(crop)
+        return Resize(self.size)(CenterCrop(min(h, w))(x))
+
+
+class RandomRotation:
+    """Rotate by a random multiple-of-90-free angle via coordinate
+    mapping (nearest-neighbor, constant fill)."""
+
+    def __init__(self, degrees):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        h, w = x.shape[0], x.shape[1]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = cy + (yy - cy) * np.cos(angle) + (xx - cx) * np.sin(angle)
+        xs = cx - (yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle)
+        yn = np.clip(np.round(ys), 0, h - 1).astype(np.int64)
+        xn = np.clip(np.round(xs), 0, w - 1).astype(np.int64)
+        valid = (ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1)
+        out = x[yn, xn]
+        return np.where(valid[(...,) + (None,) * (x.ndim - 2)], out, 0)
+
+
+__all__ += ["RandomVerticalFlip", "Pad", "Grayscale", "BrightnessTransform",
+            "ContrastTransform", "SaturationTransform", "HueTransform",
+            "ColorJitter", "RandomResizedCrop", "RandomRotation"]

@@ -137,6 +137,30 @@ class TestYoloBox:
         np.testing.assert_allclose(np.asarray(scores)[0, 0, 0], want_s,
                                    rtol=1e-5)
 
+    def test_iou_aware_decode(self):
+        """iou_aware: leading A channels are IoU logits; conf =
+        sigmoid(obj)^(1-f) * sigmoid(iou)^f (yolo_box_kernel.cc:80)."""
+        n, a, cls, h, w = 1, 2, 2, 2, 2
+        rng = np.random.RandomState(3)
+        x = rng.randn(n, a * (6 + cls), h, w).astype(np.float32)
+        boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray([[64, 64]]),
+                                   [10, 13, 16, 30], cls, conf_thresh=0.0,
+                                   downsample_ratio=32, iou_aware=True,
+                                   iou_aware_factor=0.4)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        iou0 = sig(x[0, 0, 0, 0])                   # anchor 0, cell (0,0)
+        body = x[:, a:].reshape(n, a, 5 + cls, h, w)
+        obj0 = sig(body[0, 0, 4, 0, 0])
+        cls0 = sig(body[0, 0, 5, 0, 0])
+        want = (obj0 ** 0.6) * (iou0 ** 0.4) * cls0
+        np.testing.assert_allclose(np.asarray(scores)[0, 0, 0], want,
+                                   rtol=1e-5)
+        # wrong channel count raises loudly
+        with pytest.raises(Exception, match="channels"):
+            V.yolo_box(jnp.asarray(x), jnp.asarray([[64, 64]]),
+                       [10, 13, 16, 30], cls, conf_thresh=0.0,
+                       downsample_ratio=32)
+
     def test_conf_thresh_zeroes(self):
         x = np.full((1, 7, 2, 2), -10.0, np.float32)  # obj ~ 0
         boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray([[64, 64]]),

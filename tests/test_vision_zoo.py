@@ -96,6 +96,32 @@ def test_mobilenet_v2_trains_one_step():
     assert losses[-1] < losses[0]
 
 
+def test_transform_family():
+    """New transforms: shapes, ranges, determinism under seed."""
+    from paddle_tpu.vision import transforms as T
+
+    np.random.seed(0)
+    img = (np.random.rand(24, 24, 3) * 255).astype(np.uint8)
+    assert T.Pad(2)(img).shape == (28, 28, 3)
+    assert T.Pad((1, 2))(img).shape == (28, 26, 3)
+    g = T.Grayscale()(img)
+    assert g.shape == (24, 24, 1) and g.dtype == np.uint8
+    assert T.Grayscale(3)(img).shape == (24, 24, 3)
+    assert T.RandomResizedCrop(12)(img).shape == (12, 12, 3)
+    rot = T.RandomRotation(30)(img)
+    assert rot.shape == img.shape
+    out = T.ColorJitter(0.3, 0.3, 0.3, 0.1)(img)
+    assert out.shape == img.shape and out.dtype == np.uint8
+    flip = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(flip, img[::-1])
+    # full pipeline composes into a CHW float tensor
+    np.random.seed(1)
+    pipe = T.Compose([T.RandomResizedCrop(16), T.ColorJitter(0.2),
+                      T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = pipe(img)
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32
+
+
 def test_adaptive_avg_pool_non_divisible():
     """The general adaptive-pool path (matmul formulation) matches a numpy
     reference on a non-divisible 14→4 bin layout (GoogLeNet aux head)."""
