@@ -34,10 +34,12 @@ def _load_idx_labels(path: str) -> np.ndarray:
 
 def _synthetic_digits(n: int, seed: int, image_hw=(28, 28)):
     """Deterministic learnable stand-in for MNIST: each class is a distinct
-    localized blob pattern plus noise."""
-    rng = np.random.RandomState(seed)
+    pattern plus per-sample noise.  The class prototypes come from a FIXED
+    seed shared by every split — train and test must agree on what the
+    classes look like; only the sampling noise differs by ``seed``."""
     h, w = image_hw
-    protos = rng.rand(10, h, w).astype(np.float32)
+    protos = np.random.RandomState(1234).rand(10, h, w).astype(np.float32)
+    rng = np.random.RandomState(seed)
     labels = rng.randint(0, 10, n).astype(np.int64)
     base = protos[labels]
     imgs = np.clip(base + 0.3 * rng.randn(n, h, w).astype(np.float32), 0, 1)
@@ -86,8 +88,10 @@ class Cifar10(Dataset):
                  synthetic_size: Optional[int] = None):
         self.transform = transform
         n = synthetic_size or (2048 if mode == "train" else 256)
+        # fixed-seed prototypes shared by all splits; per-split noise
+        protos = np.random.RandomState(4321).rand(10, 32, 32, 3).astype(
+            np.float32)
         rng = np.random.RandomState(13 if mode == "train" else 17)
-        protos = rng.rand(10, 32, 32, 3).astype(np.float32)
         self.labels = rng.randint(0, 10, n).astype(np.int64)
         imgs = np.clip(protos[self.labels] +
                        0.25 * rng.randn(n, 32, 32, 3).astype(np.float32), 0, 1)

@@ -149,8 +149,7 @@ class Grayscale:
     def __call__(self, x):
         orig_dtype = np.asarray(x).dtype
         x = np.asarray(x, np.float32)
-        g = (0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2])
-        g = np.clip(g, 0, 255)
+        g = np.clip(_rgb_to_gray(x), 0, 255)
         out = np.stack([g] * self.num_output_channels, axis=-1)
         return out.astype(np.uint8) if orig_dtype == np.uint8 else out
 
@@ -163,27 +162,46 @@ def _jitter_out(y, orig_dtype):
     return np.clip(y, 0.0, 1.0).astype(orig_dtype)
 
 
+def _rgb_to_gray(x):
+    """ITU-R BT.601 luma, trailing-channel RGB."""
+    return 0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2]
+
+
+def _factor_range(value):
+    """Paddle jitter-value semantics: scalar v → [max(0, 1-v), 1+v];
+    (lo, hi) pair passes through.  Returns None when inactive."""
+    if isinstance(value, (tuple, list)):
+        lo, hi = float(value[0]), float(value[1])
+    else:
+        if value == 0:
+            return None
+        lo, hi = max(0.0, 1.0 - value), 1.0 + value
+    if lo == hi == 1.0:
+        return None
+    return lo, hi
+
+
 class BrightnessTransform:
     def __init__(self, value):
-        self.value = value
+        self.range = _factor_range(value)
 
     def __call__(self, x):
-        if self.value == 0:
+        if self.range is None:
             return np.asarray(x)
         orig = np.asarray(x).dtype
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(*self.range)
         return _jitter_out(np.asarray(x, np.float32) * alpha, orig)
 
 
 class ContrastTransform:
     def __init__(self, value):
-        self.value = value
+        self.range = _factor_range(value)
 
     def __call__(self, x):
-        if self.value == 0:
+        if self.range is None:
             return np.asarray(x)
         orig = np.asarray(x).dtype
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(*self.range)
         x = np.asarray(x, np.float32)
         mean = x.mean()
         return _jitter_out(mean + alpha * (x - mean), orig)
@@ -191,16 +209,15 @@ class ContrastTransform:
 
 class SaturationTransform:
     def __init__(self, value):
-        self.value = value
+        self.range = _factor_range(value)
 
     def __call__(self, x):
-        if self.value == 0:
+        if self.range is None:
             return np.asarray(x)
         orig = np.asarray(x).dtype
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(*self.range)
         x = np.asarray(x, np.float32)
-        gray = (0.299 * x[..., 0] + 0.587 * x[..., 1]
-                + 0.114 * x[..., 2])[..., None]
+        gray = _rgb_to_gray(x)[..., None]
         return _jitter_out(gray + alpha * (x - gray), orig)
 
 
@@ -209,13 +226,18 @@ class HueTransform:
     image (cheap host-side analog; reference uses HSV rotation)."""
 
     def __init__(self, value):
-        self.value = value
+        if isinstance(value, (tuple, list)):
+            self.range = (float(value[0]), float(value[1]))
+        elif value == 0:
+            self.range = None
+        else:
+            self.range = (-float(value), float(value))
 
     def __call__(self, x):
-        if self.value == 0:
+        if self.range is None:
             return np.asarray(x)
         orig = np.asarray(x).dtype
-        alpha = np.abs(np.random.uniform(-self.value, self.value))
+        alpha = np.abs(np.random.uniform(*self.range))
         x = np.asarray(x, np.float32)
         rolled = np.roll(x, 1, axis=-1)
         return _jitter_out((1 - alpha) * x + alpha * rolled, orig)
