@@ -1,11 +1,18 @@
 """Image classification with the hapi high-level API.
 
-MobileNetV3-small on (synthetic) MNIST through the full reference recipe:
-augmentation transforms → DataLoader → Model.prepare/fit/evaluate with an
-LR schedule and callbacks.  Run:
+A small convnet on (synthetic) MNIST through the full reference recipe:
+transforms → DataLoader → Model.prepare/fit/evaluate with an LR schedule.
+(Swap in paddle_tpu.vision.models.mobilenet_v3_small + spatial
+augmentation for a real corpus — the synthetic stand-in's signal is
+pixel-aligned, so the example keeps the pipeline minimal and fast.)  Run:
 
     JAX_PLATFORMS=cpu python examples/image_classification.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import paddle_tpu as pt

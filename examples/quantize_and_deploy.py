@@ -10,6 +10,10 @@
 Run:  JAX_PLATFORMS=cpu python examples/quantize_and_deploy.py
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tempfile
 
 import numpy as np
@@ -59,8 +63,8 @@ def main():
     model.eval()
     int8_acc = float(jnp.mean(
         jnp.argmax(model(x_all), 1).astype(jnp.int32) == y_all))
-    print(f"int8 accuracy: {int8_acc:.3f} "
-          f"(weights stored as {model._sub_layers['0']._buffers['qweight'].dtype})")
+    n_int8 = sum(isinstance(l, Q.Int8Linear) for l in model.sublayers())
+    print(f"int8 accuracy: {int8_acc:.3f} ({n_int8} Int8Linear layers)")
 
     # --- export + serve -------------------------------------------------
     fresh = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))

@@ -7,6 +7,11 @@ only the hybrid_configs degrees change.  Run:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/train_gpt_hybrid.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import jax
@@ -42,6 +47,17 @@ def main():
     B, S = 8, 128
     rng = np.random.RandomState(0)
 
+    def sample_batch():
+        # learnable corpus: deterministic next-token rule 80% of the time
+        # (uniform-random tokens would leave nothing to predict)
+        ids = np.empty((B, S), np.int32)
+        ids[:, 0] = rng.randint(0, 1024, B)
+        for t in range(1, S):
+            det = (ids[:, t - 1] * 31 + 7) % 1024
+            noise = rng.randint(0, 1024, B)
+            ids[:, t] = np.where(rng.rand(B) < 0.8, det, noise)
+        return jnp.asarray(ids)
+
     def train_step(params, state, ids, key):
         def loss_fn(p):
             with fw_random.key_scope(key):
@@ -53,14 +69,16 @@ def main():
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
     key = jax.random.key(0)
-    for step in range(20):
-        ids = dist.shard_batch(jnp.asarray(
-            rng.randint(0, 1024, (B, S)), jnp.int32))
+    for step in range(30):
+        # labels = input ids: the model applies the causal one-token
+        # shift internally (standard causal-LM convention)
+        ids = dist.shard_batch(sample_batch())
         loss, params, state = jitted(params, state, ids,
                                      jax.random.fold_in(key, step))
-        if step % 5 == 0 or step == 19:
+        if step % 5 == 0 or step == 29:
             print(f"step {step:3d}  loss {float(loss):.4f}")
-    print("done — loss should be dropping from ~6.9")
+    print("done — next-token loss dropping from ~ln(1024)=6.93 toward the "
+          "~2.0 entropy of the 80/20 markov rule")
 
 
 if __name__ == "__main__":

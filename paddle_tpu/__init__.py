@@ -35,6 +35,7 @@ from . import regularizer  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
+from . import version  # noqa: F401
 from .framework import (CPUPlace, TPUPlace, get_device, load, save, seed,  # noqa: F401
                         set_device)
 from .framework.dtype import convert_dtype

@@ -44,6 +44,17 @@ from ..nn.layer import Layer, Parameter
 from ..nn.layers import Dropout, LayerNorm
 
 
+def shift_labels(labels, ignore_index: int = -100):
+    """Causal-LM label shift: position t is scored against token t+1.
+
+    ``labels`` is the same (B, S) id tensor as the input (the standard
+    causal-LM calling convention); the roll keeps the (B, S) shape so
+    sp/pp shardings are untouched, and the final position is masked with
+    ``ignore_index`` (consumed by parallel_cross_entropy)."""
+    shifted = jnp.roll(labels, -1, axis=1)
+    return shifted.at[:, -1].set(ignore_index)
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 50304          # padded to a multiple of 128 for the MXU
@@ -337,7 +348,8 @@ class GPTForCausalLM(Layer):
         if labels is None:
             return logits
         loss = parallel_cross_entropy(
-            logits.astype(jnp.float32), labels, reduction="mean")
+            logits.astype(jnp.float32), shift_labels(labels),
+            reduction="mean")
         if aux_losses:
             loss = loss + self.config.moe_aux_weight * sum(aux_losses)
         return loss, logits

@@ -158,8 +158,11 @@ class GPTPipeline:
     def loss_and_grads(self, state, input_ids, labels, key):
         """Mean causal-LM loss over the batch + grads in state layout."""
         M = self.num_microbatches
+        from .gpt import shift_labels
         ids_mb = pp_mod.split_microbatches(input_ids, M)
-        labels_mb = pp_mod.split_microbatches(labels, M)
+        # causal shift happens BEFORE the microbatch split (batch-axis
+        # split: every microbatch keeps full sequences)
+        labels_mb = pp_mod.split_microbatches(shift_labels(labels), M)
         rest, stacked = state["rest"], state["stacked"]
 
         def embed_all(rest_):

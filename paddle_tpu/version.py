@@ -1,0 +1,25 @@
+"""paddle.version analog (reference: python/paddle/version.py generated at
+build time — full_version/major/minor/patch/rc + show())."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "tpu-native"
+with_gpu = "OFF"          # source-compat fields: this build targets TPU
+with_tpu = "ON"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print(f"with_tpu: {with_tpu}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

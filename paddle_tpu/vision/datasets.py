@@ -32,18 +32,23 @@ def _load_idx_labels(path: str) -> np.ndarray:
     return data.astype(np.int64)
 
 
-def _synthetic_digits(n: int, seed: int, image_hw=(28, 28)):
-    """Deterministic learnable stand-in for MNIST: each class is a distinct
+def _synthetic_classes(n: int, seed: int, shape, proto_seed: int,
+                       noise: float = 0.3, num_classes: int = 10):
+    """Deterministic learnable class data: each class is a distinct
     pattern plus per-sample noise.  The class prototypes come from a FIXED
     seed shared by every split — train and test must agree on what the
     classes look like; only the sampling noise differs by ``seed``."""
-    h, w = image_hw
-    protos = np.random.RandomState(1234).rand(10, h, w).astype(np.float32)
+    protos = np.random.RandomState(proto_seed).rand(
+        num_classes, *shape).astype(np.float32)
     rng = np.random.RandomState(seed)
-    labels = rng.randint(0, 10, n).astype(np.int64)
-    base = protos[labels]
-    imgs = np.clip(base + 0.3 * rng.randn(n, h, w).astype(np.float32), 0, 1)
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    imgs = np.clip(protos[labels]
+                   + noise * rng.randn(n, *shape).astype(np.float32), 0, 1)
     return (imgs * 255).astype(np.uint8), labels
+
+
+def _synthetic_digits(n: int, seed: int, image_hw=(28, 28)):
+    return _synthetic_classes(n, seed, image_hw, proto_seed=1234)
 
 
 class MNIST(Dataset):
@@ -88,14 +93,9 @@ class Cifar10(Dataset):
                  synthetic_size: Optional[int] = None):
         self.transform = transform
         n = synthetic_size or (2048 if mode == "train" else 256)
-        # fixed-seed prototypes shared by all splits; per-split noise
-        protos = np.random.RandomState(4321).rand(10, 32, 32, 3).astype(
-            np.float32)
-        rng = np.random.RandomState(13 if mode == "train" else 17)
-        self.labels = rng.randint(0, 10, n).astype(np.int64)
-        imgs = np.clip(protos[self.labels] +
-                       0.25 * rng.randn(n, 32, 32, 3).astype(np.float32), 0, 1)
-        self.images = (imgs * 255).astype(np.uint8)
+        self.images, self.labels = _synthetic_classes(
+            n, seed=13 if mode == "train" else 17, shape=(32, 32, 3),
+            proto_seed=4321, noise=0.25)
 
     def __getitem__(self, idx):
         img = self.images[idx]

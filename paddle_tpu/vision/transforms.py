@@ -237,7 +237,11 @@ class HueTransform:
         if self.range is None:
             return np.asarray(x)
         orig = np.asarray(x).dtype
-        alpha = np.abs(np.random.uniform(*self.range))
+        # blend weight = |sampled hue shift|: symmetric shifts blend the
+        # same amount; explicit (lo, hi) ranges pass through unfolded
+        alpha = np.clip(np.abs(np.random.uniform(*self.range)), 0.0, 1.0) \
+            if self.range[0] == -self.range[1] \
+            else np.clip(np.random.uniform(*self.range), 0.0, 1.0)
         x = np.asarray(x, np.float32)
         rolled = np.roll(x, 1, axis=-1)
         return _jitter_out((1 - alpha) * x + alpha * rolled, orig)

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# CI entry (component E10 — the analog of paddle_build.sh + parallel_UT_rule):
+#   tools/ci.sh [shard_index shard_count]
+#
+# Shards the test files deterministically across workers (sorted list,
+# round-robin) so a CI fleet can split the suite; no args = everything.
+# API-compat guard + bench smoke run in shard 0 only.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SHARD=${1:-0}
+SHARDS=${2:-1}
+
+mapfile -t FILES < <(ls tests/test_*.py | sort)
+SELECTED=()
+for i in "${!FILES[@]}"; do
+    if (( i % SHARDS == SHARD )); then
+        SELECTED+=("${FILES[$i]}")
+    fi
+done
+
+echo "shard ${SHARD}/${SHARDS}: ${#SELECTED[@]} files"
+python -m pytest "${SELECTED[@]}" -q
+
+if (( SHARD == 0 )); then
+    python tools/print_signatures.py --check
+    BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
+    echo "api-guard + bench smoke ok"
+fi
+echo "shard ${SHARD} green"

@@ -101,12 +101,28 @@ def collect() -> list[str]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if the live API drifted from API.spec")
     args = ap.parse_args()
     spec = "\n".join(collect()) + "\n"
     if args.update:
         with open(SPEC_PATH, "w") as f:
             f.write(spec)
         print(f"wrote {SPEC_PATH} ({spec.count(chr(10))} entries)")
+        return 0
+    if args.check:
+        with open(SPEC_PATH) as f:
+            want = f.read()
+        if spec != want:
+            live = set(spec.splitlines())
+            saved = set(want.splitlines())
+            for line in sorted(live - saved)[:10]:
+                print(f"+ {line}")
+            for line in sorted(saved - live)[:10]:
+                print(f"- {line}")
+            print("API drifted from API.spec — run --update and commit")
+            return 1
+        print(f"API.spec up to date ({spec.count(chr(10))} entries)")
         return 0
     sys.stdout.write(spec)
     return 0
