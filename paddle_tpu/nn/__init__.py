@@ -3,6 +3,7 @@ from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                          ClipGradByValue)
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .layer import Layer, LayerList, Parameter, ParameterList, Sequential  # noqa: F401
 from .layers import (GELU, SiLU, AdaptiveAvgPool2D, AvgPool2D,  # noqa: F401
                      BatchNorm1D, BatchNorm2D, BatchNorm3D, BCEWithLogitsLoss,

@@ -21,6 +21,7 @@ returned as the updated variables dict.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
@@ -127,6 +128,9 @@ class Parameter:
 
 # Thread-local scope used by apply() to collect in-trace buffer mutations.
 _scope = threading.local()
+
+# Monotonic hook-handle ids (removal must never free an id for reuse).
+_hook_ids = itertools.count()
 
 
 def _mutation_sink() -> Optional[Dict[str, Any]]:
@@ -331,12 +335,12 @@ class Layer:
 
     # -- hooks ------------------------------------------------------------
     def register_forward_post_hook(self, hook):
-        handle = len(self._forward_post_hooks)
+        handle = next(_hook_ids)   # never reused, even after removals
         self._forward_post_hooks[handle] = hook
         return handle
 
     def register_forward_pre_hook(self, hook):
-        handle = len(self._forward_pre_hooks)
+        handle = next(_hook_ids)
         self._forward_pre_hooks[handle] = hook
         return handle
 

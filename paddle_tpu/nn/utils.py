@@ -1,0 +1,126 @@
+"""paddle.nn.utils (reference: nn/utils/weight_norm_hook.py:155
+``weight_norm``/:202 ``remove_weight_norm``, spectral_norm_hook.py:131
+``spectral_norm``, clip_grad convenience).
+
+Reparameterization here rides the Layer forward-pre-hook mechanism: the
+wrapped layer keeps ``{name}_g`` (magnitude) and ``{name}_v`` (direction)
+Parameters, and the hook recomputes ``weight = g * v / ||v||`` before every
+forward — same contract as the reference's hook-based implementation, and
+the recompute fuses into the consumer matmul under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.errors import enforce
+from .layer import Layer, Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(v, dim: int):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (weight_norm_hook
+    :155).  g has the weight's shape collapsed to ``dim``.
+
+    The derived weight is refreshed by a forward-pre hook on every call;
+    read it after a forward (not between an ``apply`` and the next eager
+    call, when it may still hold the traced value)."""
+    enforce(name in layer._parameters,
+            f"layer has no parameter {name!r}")
+    w = layer._parameters[name].value
+    dim = dim % w.ndim
+    v = Parameter(w)
+    g = Parameter(_norm_except(w, dim))
+    layer._parameters[f"{name}_v"] = v
+    layer._parameters[f"{name}_g"] = g
+    del layer._parameters[name]
+
+    def _recompute(lyr, args):
+        vv = lyr._parameters[f"{name}_v"].value
+        gg = lyr._parameters[f"{name}_g"].value
+        # derived weight lives in the instance dict, NOT _parameters —
+        # state_dict/apply see only the (g, v) factors
+        object.__setattr__(lyr, name, Parameter(
+            gg * vv / jnp.maximum(_norm_except(vv, dim), 1e-12)))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__[f"_{name}_weight_norm_hook"] = (handle, dim)
+    _recompute(layer, ())         # materialize for immediate access
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold g*v/||v|| back into a plain parameter (weight_norm_hook:202)."""
+    key = f"_{name}_weight_norm_hook"
+    enforce(key in layer.__dict__, f"{name} is not weight-normed")
+    handle, dim = layer.__dict__.pop(key)
+    layer._forward_pre_hooks.pop(handle, None)
+    layer.__dict__.pop(name, None)      # drop the derived instance attr
+    v = layer._parameters.pop(f"{name}_v").value
+    g = layer._parameters.pop(f"{name}_g").value
+    layer._parameters[name] = Parameter(
+        g * v / jnp.maximum(_norm_except(v, dim), 1e-12))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
+                  eps: float = 1e-12, dim: int = 0) -> Layer:
+    """Divide ``layer.<name>`` by its largest singular value before every
+    forward (spectral_norm_hook:131), using the SpectralNorm layer's
+    power-iteration buffers."""
+    from .layers import SpectralNorm
+
+    enforce(name in layer._parameters, f"layer has no parameter {name!r}")
+    w = layer._parameters[name].value
+    sn = SpectralNorm(w.shape, dim=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer.__dict__[f"_{name}_spectral_norm"] = sn
+    layer._parameters[f"{name}_orig"] = layer._parameters.pop(name)
+
+    def _recompute(lyr, args):
+        sn.training = lyr.training
+        before = dict(sn._buffers)
+        out = sn(lyr._parameters[f"{name}_orig"].value)
+        # inside a jit trace the power-iteration buffer update would store
+        # tracers (sn lives outside apply's mutation sink) — keep the last
+        # eager u/v instead
+        import jax.core as _core
+        if any(isinstance(b, _core.Tracer) for b in sn._buffers.values()):
+            sn._buffers.clear()
+            sn._buffers.update(before)
+        object.__setattr__(lyr, name, Parameter(out))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer.__dict__[f"_{name}_spectral_norm_hook"] = handle
+    _recompute(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters) -> jax.Array:
+    """Flatten a parameter list into one vector (nn/utils/transform_
+    parameters.py)."""
+    return jnp.concatenate([jnp.ravel(p.value if isinstance(p, Parameter)
+                                      else p) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters) -> None:
+    """Write a flat vector back into the parameter list, in place."""
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if hasattr(p, "shape") else p.value.size
+        chunk = vec[offset:offset + n]
+        if isinstance(p, Parameter):
+            p.value = chunk.reshape(p.shape)
+        offset += n
+    enforce(offset == vec.size, "vector size mismatch")
+

@@ -237,11 +237,11 @@ class HueTransform:
         if self.range is None:
             return np.asarray(x)
         orig = np.asarray(x).dtype
-        # blend weight = |sampled hue shift|: symmetric shifts blend the
-        # same amount; explicit (lo, hi) ranges pass through unfolded
-        alpha = np.clip(np.abs(np.random.uniform(*self.range)), 0.0, 1.0) \
-            if self.range[0] == -self.range[1] \
-            else np.clip(np.random.uniform(*self.range), 0.0, 1.0)
+        # blend weight = |sampled hue shift|: this channel-roll analog has
+        # no direction, so the shift's MAGNITUDE drives the blend for both
+        # scalar and (lo, hi) forms (a (-0.5, -0.1) range jitters like
+        # (0.1, 0.5))
+        alpha = np.clip(np.abs(np.random.uniform(*self.range)), 0.0, 1.0)
         x = np.asarray(x, np.float32)
         rolled = np.roll(x, 1, axis=-1)
         return _jitter_out((1 - alpha) * x + alpha * rolled, orig)

@@ -1,0 +1,104 @@
+"""nn.utils tests: weight_norm/remove_weight_norm reparameterization,
+spectral_norm hook, parameter vector round trip."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn.utils import (parameters_to_vector, remove_weight_norm,
+                                 spectral_norm, vector_to_parameters,
+                                 weight_norm)
+
+
+def test_weight_norm_preserves_forward_then_scales():
+    pt.seed(0)
+    lin = nn.Linear(8, 4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8), jnp.float32)
+    y0 = np.asarray(lin(x))
+    weight_norm(lin, dim=1)
+    assert "weight_g" in lin._parameters and "weight_v" in lin._parameters
+    np.testing.assert_allclose(np.asarray(lin(x)), y0, rtol=1e-5,
+                               atol=1e-5)
+    # doubling g doubles the pre-bias output
+    lin._parameters["weight_g"].value = \
+        lin._parameters["weight_g"].value * 2.0
+    b = np.asarray(lin.bias.value)
+    np.testing.assert_allclose(np.asarray(lin(x)) - b, 2 * (y0 - b),
+                               rtol=1e-4, atol=1e-4)
+    remove_weight_norm(lin)
+    assert "weight_v" not in lin._parameters
+    np.testing.assert_allclose(np.asarray(lin(x)) - b, 2 * (y0 - b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_weight_norm_trains():
+    """g/v parameterization: gradients flow into both factors."""
+    pt.seed(1)
+    lin = nn.Linear(4, 4, bias_attr=False)
+    weight_norm(lin)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, 4), jnp.float32)
+    tgt = jnp.asarray(np.random.RandomState(2).randn(16, 4), jnp.float32)
+    params = lin.state_dict()
+    assert set(params) == {"weight_g", "weight_v"}
+    opt = pt.optimizer.Adam(learning_rate=5e-2)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((lin.apply(p, x) - tgt) ** 2)
+
+    l0 = float(loss_fn(params))
+    for _ in range(40):
+        g = jax.grad(loss_fn)(params)
+        params, state = opt.apply_gradients(g, params, state)
+    assert float(loss_fn(params)) < 0.5 * l0
+
+
+def test_spectral_norm_caps_sigma():
+    pt.seed(2)
+    lin = nn.Linear(8, 8)
+    lin.weight.value = lin.weight.value * 10.0
+    spectral_norm(lin)
+    lin.train()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8), jnp.float32)
+    for _ in range(20):
+        lin(x)
+    s = np.linalg.svd(np.asarray(lin.weight.value), compute_uv=False)
+    assert abs(s[0] - 1.0) < 5e-2
+
+
+def test_spectral_norm_survives_jit_then_eager():
+    """Tracing apply() must not leak tracers into the power-iteration
+    buffers (regression: eager forward after jit crashed)."""
+    pt.seed(4)
+    lin = nn.Linear(6, 6)
+    spectral_norm(lin)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6), jnp.float32)
+    params = lin.state_dict()
+    _ = jax.jit(lambda p, x: lin.apply(p, x))(params, x)
+    y = lin(x)                       # would raise UnexpectedTracerError
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_hook_handles_never_reused():
+    pt.seed(5)
+    lin = nn.Linear(2, 2)
+    weight_norm(lin)
+    calls = []
+    lin.register_forward_pre_hook(lambda l, a: calls.append(1))
+    remove_weight_norm(lin)
+    weight_norm(lin)                 # must NOT clobber the user hook
+    lin(jnp.zeros((1, 2)))
+    assert calls == [1]
+
+
+def test_parameter_vector_roundtrip():
+    pt.seed(3)
+    lin = nn.Linear(3, 2)
+    vec = parameters_to_vector(lin.parameters())
+    assert vec.shape == (3 * 2 + 2,)
+    vector_to_parameters(vec * 2.0, lin.parameters())
+    np.testing.assert_allclose(
+        np.asarray(parameters_to_vector(lin.parameters())),
+        np.asarray(vec) * 2.0, rtol=1e-6)

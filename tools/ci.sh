@@ -20,7 +20,11 @@ for i in "${!FILES[@]}"; do
 done
 
 echo "shard ${SHARD}/${SHARDS}: ${#SELECTED[@]} files"
-python -m pytest "${SELECTED[@]}" -q
+if (( ${#SELECTED[@]} )); then
+    python -m pytest "${SELECTED[@]}" -q
+else
+    echo "shard ${SHARD} has no files — nothing to run"
+fi
 
 if (( SHARD == 0 )); then
     python tools/print_signatures.py --check
