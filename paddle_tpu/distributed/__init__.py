@@ -19,9 +19,10 @@ rewrites, this package expresses as ONE SPMD program over a named
 from __future__ import annotations
 
 from . import fleet  # noqa: F401
-from .collective import (ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: F401
-                         barrier, broadcast, p2p_push, reduce,
-                         reduce_scatter, scatter, send_recv_permute, split)
+from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
+                         all_reduce_quantized, all_to_all, barrier,
+                         broadcast, p2p_push, reduce, reduce_scatter,
+                         scatter, send_recv_permute, split)
 from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         VocabParallelEmbedding, shard_constraint,
                         param_sharding, variables_sharding)
@@ -43,7 +44,8 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
                        set_hybrid_communicate_group)
 
 __all__ = [
-    "fleet", "ReduceOp", "all_gather", "all_reduce", "all_to_all", "barrier",
+    "fleet", "ReduceOp", "all_gather", "all_reduce",
+    "all_reduce_quantized", "all_to_all", "barrier", "spawn",
     "broadcast", "p2p_push", "reduce", "reduce_scatter", "scatter",
     "send_recv_permute", "split", "ColumnParallelLinear", "RowParallelLinear",
     "VocabParallelEmbedding", "shard_constraint", "param_sharding",

@@ -25,9 +25,9 @@ from jax import lax
 from .topology import axis_size
 
 __all__ = [
-    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
-    "all_to_all", "reduce", "scatter", "send_recv_permute", "barrier",
-    "split", "p2p_push",
+    "ReduceOp", "all_reduce", "all_reduce_quantized", "all_gather",
+    "reduce_scatter", "broadcast", "all_to_all", "reduce", "scatter",
+    "send_recv_permute", "barrier", "split", "p2p_push",
 ]
 
 
@@ -188,3 +188,45 @@ def barrier(group: Optional[str] = None):
     the barrier (reference collective/barrier_op.cc is an allreduce on a
     scalar; that trick is unnecessary here)."""
     return None
+
+
+def all_reduce_quantized(x, group: str = "dp", bits: int = 8,
+                         block_size: int = 256):
+    """Quantized sum all-reduce: block-wise absmax int8 quantization with
+    int16 transport — the psum payload is 2 bytes/element, HALF an f32
+    all-reduce's wire traffic (int8-on-the-wire would need a custom XLA
+    collective à la EQuARX; int16 is the best a stock psum can carry
+    without cross-lane overflow).
+
+    The TPU-native analog of the reference's gradient-compression
+    meta-optimizer (fleet dgc_optimizer.py / DGCMomentumOptimizer),
+    quantization scheme per EQuARX (PAPERS.md): one pmax agrees on
+    per-block scales, then the int8 payloads accumulate exactly in int16
+    (safe for groups up to 2^15/qmax ≈ 258 devices; larger groups fall
+    back to int32 transport automatically).
+
+    Compared to simply casting gradients to bf16 (same wire bytes), the
+    blockwise absmax scale bounds the error by the block's own range
+    (~1e-2 relative at 8 bits) instead of bf16's global 8-bit mantissa."""
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    qmax = float(2 ** (bits - 1) - 1)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % block_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(-1, block_size)
+    # one cheap collective agrees on per-block scales across the group
+    scale = lax.pmax(jnp.max(jnp.abs(blocks), axis=1), group)
+    scale = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(blocks / scale[:, None] * qmax), -qmax, qmax)
+    n_dev = lax.axis_size(group)
+    acc_dtype = jnp.int16 if n_dev * qmax < 2 ** 15 else jnp.int32
+    total = lax.psum(q.astype(acc_dtype), group)
+    out = total.astype(jnp.float32) * (scale[:, None] / qmax)
+    out = out.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(orig_shape).astype(orig_dtype)

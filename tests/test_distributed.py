@@ -69,6 +69,42 @@ class TestCollectives:
                       mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
         np.testing.assert_allclose(f(x), np.full(8, x.sum()))
 
+    def test_all_reduce_quantized_close_to_exact(self):
+        """EQuARX-style int8 allreduce: ~4x less wire traffic, numerics
+        within the int8 quantization error of the exact psum."""
+        mesh = make_mesh((8,), ("dp",))
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 512), jnp.float32)
+        exact = shard_map(lambda v: dist.all_reduce(v, group="dp"),
+                          mesh=mesh, in_specs=P("dp", None),
+                          out_specs=P("dp", None))(x)
+        quant = shard_map(
+            lambda v: dist.all_reduce_quantized(v, group="dp"),
+            mesh=mesh, in_specs=P("dp", None),
+            out_specs=P("dp", None))(x)
+        scale = float(jnp.max(jnp.abs(exact)))
+        err = float(jnp.max(jnp.abs(quant - exact))) / scale
+        assert err < 0.05, err
+        # gradient-sync usage: mean over the group stays close too
+        np.testing.assert_allclose(
+            np.asarray(quant) / 8, np.asarray(exact) / 8,
+            atol=0.05 * scale / 8)
+        # IN-mesh non-divisible block size exercises the pad/unpad path
+        y = jnp.asarray(rng.randn(8, 33), jnp.float32)
+        exact_y = shard_map(lambda v: dist.all_reduce(v, group="dp"),
+                            mesh=mesh, in_specs=P("dp", None),
+                            out_specs=P("dp", None))(y)
+        quant_y = shard_map(
+            lambda v: dist.all_reduce_quantized(v, group="dp"),
+            mesh=mesh, in_specs=P("dp", None),
+            out_specs=P("dp", None))(y)
+        sy = float(jnp.max(jnp.abs(exact_y)))
+        assert float(jnp.max(jnp.abs(quant_y - exact_y))) / sy < 0.05
+        # outside a mesh the op is the identity (paddle group semantics)
+        z = jnp.asarray(rng.randn(33), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(dist.all_reduce_quantized(z)), np.asarray(z))
+
     def test_all_gather_tiled(self):
         mesh = make_mesh((8,), ("dp",))
         x = jnp.arange(8.0)

@@ -29,6 +29,39 @@ def test_op_grad_matches_numeric(spec):
                atol=spec.grad_atol)
 
 
+def _all_float_sample(spec):
+    args = spec.sample(np.random.RandomState(2))
+    return all(np.issubdtype(np.asarray(a).dtype, np.floating)
+               for a in args)
+
+
+_BF16_SPECS = [s for s in _SPECS if _all_float_sample(s)]
+
+
+@pytest.mark.parametrize("spec", _BF16_SPECS,
+                         ids=[s.name for s in _BF16_SPECS])
+def test_op_bf16_close_to_f32(spec):
+    """bf16 dtype sweep (the TPU compute dtype): every float op must
+    run in bf16 and stay within bf16 rounding of its f32 result —
+    the reference OpTest's multi-dtype sweep, bf16-first."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    args = spec.sample(rng)
+    f32 = np.asarray(spec.fn(*args), np.float32)
+    bf16_args = [jnp.asarray(a, jnp.bfloat16) for a in args]
+    try:
+        out = np.asarray(spec.fn(*bf16_args), np.float32)
+    except (NotImplementedError, KeyError):
+        # LAPACK-backed factorizations are f32/f64-only — same dtype
+        # support as the reference's decomposition kernels
+        pytest.skip(f"{spec.name} has no bf16 kernel")
+    scale = max(1.0, float(np.max(np.abs(f32))))
+    assert np.max(np.abs(out - f32)) / scale < 0.1, (
+        f"{spec.name}: bf16 deviates "
+        f"{np.max(np.abs(out - f32)) / scale:.4f} from f32")
+
+
 def test_registry_nonempty_and_unique():
     names = [s.name for s in _SPECS]
     assert len(names) >= 40
