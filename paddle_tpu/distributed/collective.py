@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..framework.errors import enforce
 from .topology import axis_size
 
 __all__ = [
@@ -211,6 +212,9 @@ def all_reduce_quantized(x, group: str = "dp", bits: int = 8,
     x = _arr(x)
     if not _in_axis(group):
         return x
+    enforce(2 <= bits <= 16,
+            f"all_reduce_quantized supports 2..16 bits, got {bits} "
+            f"(wider payloads would overflow the integer transport)")
     qmax = float(2 ** (bits - 1) - 1)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)

@@ -32,7 +32,7 @@ from ..jit import InputSpec
 
 __all__ = ["InputSpec", "data", "Program", "program_guard",
            "default_main_program", "default_startup_program", "Executor",
-           "save_inference_model", "load_inference_model"]
+           "save_inference_model", "load_inference_model", "nn"]
 
 
 def data(name: str, shape: Sequence[Optional[int]], dtype="float32"):
@@ -49,10 +49,30 @@ class Program:
         self.name = name
         self._fn: Optional[Callable] = None
         self._jitted = None
+        # static.nn parameter store: layers created by the nn helpers are
+        # cached per program by deterministic build order, so a retrace
+        # (new batch shape) reuses the SAME weights instead of redrawing
+        self._nn_layers: Dict[str, Any] = {}
+        self._nn_counters: Dict[str, int] = {}
+
+    def _nn_slot(self, kind: str, name: Optional[str]) -> str:
+        if name:
+            return name
+        idx = self._nn_counters.get(kind, 0)
+        self._nn_counters[kind] = idx + 1
+        return f"{kind}_{idx}"
 
     def set_fn(self, fn: Callable) -> "Program":
         self._fn = fn
-        self._jitted = jax.jit(lambda feed: fn(**feed))
+
+        def _traced(feed):
+            # reset build-order counters so every (re)trace walks the
+            # helpers in the same deterministic sequence
+            self._nn_counters.clear()
+            with program_guard(self):
+                return fn(**feed)
+
+        self._jitted = jax.jit(_traced)
         return self
 
     def run(self, feed: Dict[str, Any]):
@@ -144,3 +164,68 @@ def load_inference_model(path_prefix: str, executor=None):
     feed_names = [s.name or f"input_{i}"
                   for i, s in enumerate(loaded.input_spec)]
     return loaded, feed_names, None
+
+
+class nn:
+    """paddle.static.nn source-compat namespace (reference static/nn/
+    common.py fc, input.py embedding, ...).
+
+    Helpers cache their layers on the current default Program keyed by
+    build order (or explicit ``name``), with weights materialized at
+    compile time (``jax.ensure_compile_time_eval``) — a jit retrace
+    reuses the same parameters, matching the reference's
+    program-owns-the-parameters model."""
+
+    @staticmethod
+    def _layer(kind, name, build):
+        prog = default_main_program()
+        slot = prog._nn_slot(kind, name)
+        if slot not in prog._nn_layers:
+            with jax.ensure_compile_time_eval():
+                prog._nn_layers[slot] = build()
+        return prog._nn_layers[slot]
+
+    @staticmethod
+    def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+           bias_attr=None, activation=None, name=None):
+        """Reference signature order (static/nn/common.py fc)."""
+        from ..nn import functional as F
+        from ..nn.layers import Linear
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        lead = x.shape[:num_flatten_dims]
+        flat = x.reshape(*lead, -1)
+        layer = nn._layer("fc", name, lambda: Linear(
+            flat.shape[-1], size, weight_attr=weight_attr,
+            bias_attr=bias_attr))
+        out = layer(flat)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+                  param_attr=None, dtype="float32", name=None):
+        from ..nn.layers import Embedding
+
+        layer = nn._layer("embedding", name, lambda: Embedding(
+            size[0], size[1], padding_idx=padding_idx,
+            weight_attr=param_attr, dtype=dtype))
+        return layer(input)
+
+    @staticmethod
+    def batch_norm(input, act=None, momentum: float = 0.9,
+                   epsilon: float = 1e-5, data_layout: str = "NCHW",
+                   name=None, **kw):
+        from ..nn import functional as F
+        from ..nn.layers import BatchNorm2D
+
+        enforce(not kw, f"batch_norm got unsupported kwargs {sorted(kw)}")
+        features = input.shape[1] if data_layout == "NCHW" \
+            else input.shape[-1]
+        layer = nn._layer("batch_norm", name, lambda: BatchNorm2D(
+            features, momentum=momentum, epsilon=epsilon,
+            data_format=data_layout))
+        out = layer(input)
+        return getattr(F, act)(out) if act else out

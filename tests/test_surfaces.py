@@ -136,6 +136,18 @@ class TestStaticFacade:
         (z,) = exe.run(prog, feed={"x": x, "y": y}, fetch_list=["z"])
         np.testing.assert_allclose(z, x @ y, rtol=1e-5)
 
+    def test_static_nn_namespace(self):
+        import jax.numpy as jnp
+        import paddle_tpu.static as static
+        pt.seed(0)
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 8), np.float32)
+        y = static.nn.fc(x, 16, activation="relu")
+        assert y.shape == (4, 16) and float(jnp.min(y)) >= 0
+        e = static.nn.embedding(jnp.asarray([[1, 2]]), size=(10, 6))
+        assert e.shape == (1, 2, 6)
+        bn = static.nn.batch_norm(jnp.ones((2, 3, 4, 4)))
+        assert bn.shape == (2, 3, 4, 4)
+
     def test_program_guard_swaps_default(self):
         import paddle_tpu.static as static
         p = static.Program("alt")
