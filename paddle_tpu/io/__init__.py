@@ -27,7 +27,7 @@ __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "Subset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
     "BatchSampler", "DistributedBatchSampler", "WeightedRandomSampler",
-    "DataLoader", "default_collate_fn",
+    "DataLoader", "default_collate_fn", "WorkerInfo", "get_worker_info",
 ]
 
 
@@ -261,11 +261,32 @@ def default_collate_fn(batch: List[Any]):
 # ---------------------------------------------------------------------------
 # Worker process loop (reference: fluid/dataloader/worker.py:255 _worker_loop)
 # ---------------------------------------------------------------------------
+class WorkerInfo:
+    """Reference fluid/dataloader/worker.py WorkerInfo: available inside
+    dataset code running in a DataLoader worker via get_worker_info()."""
+
+    def __init__(self, id: int, num_workers: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """None in the main process; the WorkerInfo inside a worker
+    (reference paddle.io.get_worker_info)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
-                 worker_init_fn, ring=None):
+                 worker_init_fn, ring=None, num_workers: int = 1):
     """With ``ring`` (the native shared-memory transport, io/native.py)
     batches cross as raw array buffers gathered into a shm slot — no
     pickling of payloads; otherwise the python mp.Queue carries them."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     np.random.seed((np.random.SeedSequence().entropy + worker_id) % (2**31))
@@ -354,14 +375,23 @@ class DataLoader:
         return gen
 
     def _iter_iterable(self):
-        batch = []
-        for sample in self.dataset:
-            batch.append(sample)
-            if len(batch) == self.batch_size:
+        # IterableDataset runs in-process (num_workers is a map-style
+        # knob here); present the canonical get_worker_info() sharding
+        # pattern with a single-worker view — one shard IS the stream
+        global _worker_info
+        prev = _worker_info
+        _worker_info = WorkerInfo(0, 1, self.dataset)
+        try:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
                 yield self.collate_fn(batch)
-                batch = []
-        if batch and not self.drop_last:
-            yield self.collate_fn(batch)
+        finally:
+            _worker_info = prev
 
     def _iter_single(self):
         for indices in self.batch_sampler:
@@ -391,7 +421,7 @@ class DataLoader:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queue, result_queue, self.collate_fn,
-                      wid, self.worker_init_fn, ring),
+                      wid, self.worker_init_fn, ring, self.num_workers),
                 daemon=True)
             w.start()
             workers.append(w)

@@ -148,6 +148,46 @@ class TestElastic:
         assert path is not None and path.endswith("step-7")
 
 
+class TestWorkerInfo:
+    def test_get_worker_info_in_workers(self):
+        from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+        assert get_worker_info() is None  # main process
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                wi = get_worker_info()
+                assert wi is not None and wi.num_workers == 2
+                return np.asarray([i, wi.id])
+
+            def __len__(self):
+                return 8
+
+        loader = DataLoader(DS(), batch_size=4, num_workers=2)
+        seen = set()
+        for batch in loader:
+            seen.update(np.asarray(batch)[:, 1].tolist())
+        assert seen and seen <= {0, 1}
+
+    def test_iterable_dataset_sees_single_worker_view(self):
+        """The canonical get_worker_info() sharding pattern must work on
+        the in-process IterableDataset path (one shard = the stream)."""
+        from paddle_tpu.io import DataLoader, IterableDataset, \
+            get_worker_info
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                wi = get_worker_info()
+                assert wi is not None
+                for i in range(wi.id, 8, wi.num_workers):  # shard pattern
+                    yield np.asarray([i])
+
+        out = [int(np.asarray(b)[0]) for b in
+               DataLoader(Stream(), batch_size=1, num_workers=2)]
+        assert out == list(range(8))
+        assert get_worker_info() is None   # restored after iteration
+
+
 class TestNativeDataLoader:
     def test_ring_transport_matches_queue(self):
         """Same data through the native shm ring and the python queue
