@@ -19,7 +19,8 @@ from ..framework.dtype import convert_dtype
 from . import state as _state
 from .state import BLACK_OPS, WHITE_OPS  # noqa: F401
 
-__all__ = ["auto_cast", "decorate", "GradScaler", "amp_guard"]
+__all__ = ["auto_cast", "decorate", "GradScaler", "amp_guard",
+           "is_bfloat16_supported", "is_float16_supported"]
 
 
 @contextlib.contextmanager
@@ -170,3 +171,15 @@ class GradScaler:
 
     def load_state_dict(self, sd):
         self._st = dict(sd)
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is the native TPU compute dtype; CPU XLA supports it too."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    """fp16 lowers on every XLA backend this build targets (incl. the
+    tunneled TPU platform, which reports a vendor name); bf16 is still
+    preferred on TPU — wider exponent, no loss scaling for most models."""
+    return True

@@ -888,3 +888,25 @@ def sparse_attention(query, key, value, sparse_csr_offset,
     am = None if attn_mask is None else _arr(attn_mask)
     return fn2(query, key, value, _arr(sparse_csr_offset).astype(jnp.int32),
                _arr(sparse_csr_columns).astype(jnp.int32), kpm, am)
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    """Smoothed one-hot targets (reference label_smooth_op):
+    (1-eps)*label + eps*prior (uniform prior by default).  Integer
+    one-hots are promoted to float — a 1/k prior must not truncate."""
+    label = _arr(label)
+    if not jnp.issubdtype(label.dtype, jnp.floating):
+        label = label.astype(jnp.float32)
+    k = label.shape[-1]
+    if prior_dist is None:
+        prior = jnp.full((k,), 1.0 / k, label.dtype)
+    else:
+        prior = _arr(prior_dist).reshape(-1).astype(label.dtype)
+    return (1.0 - epsilon) * label + epsilon * prior
+
+
+def square_error_cost(input, label):
+    """Elementwise (input - label)^2 (reference square_error_cost — the
+    static-graph regression staple)."""
+    d = _arr(input) - _arr(label)
+    return d * d

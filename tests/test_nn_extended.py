@@ -243,6 +243,36 @@ class TestLosses:
         assert np.all(np.isfinite(np.asarray(g)))
 
 
+class TestMiscFunctional:
+    def test_label_smooth(self):
+        oh = jnp.asarray([[0.0, 1.0, 0.0, 0.0]])
+        got = np.asarray(F.label_smooth(oh, epsilon=0.1))
+        np.testing.assert_allclose(got, [[0.025, 0.925, 0.025, 0.025]],
+                                   rtol=1e-6)
+        prior = jnp.asarray([0.4, 0.3, 0.2, 0.1])
+        got = np.asarray(F.label_smooth(oh, prior_dist=prior, epsilon=0.2))
+        np.testing.assert_allclose(
+            got, 0.8 * np.asarray(oh) + 0.2 * np.asarray(prior)[None],
+            rtol=1e-6)
+
+    def test_label_smooth_integer_one_hot(self):
+        """Integer one-hots must promote to float (a 1/k prior would
+        truncate to 0 in int dtype)."""
+        oh = jnp.asarray([[0, 1, 0, 0]], jnp.int32)
+        got = np.asarray(F.label_smooth(oh, epsilon=0.1))
+        np.testing.assert_allclose(got.sum(), 1.0, rtol=1e-6)
+
+    def test_square_error_cost(self):
+        got = np.asarray(F.square_error_cost(jnp.asarray([1.0, 2.0]),
+                                             jnp.asarray([3.0, 1.0])))
+        np.testing.assert_allclose(got, [4.0, 1.0])
+
+    def test_amp_dtype_probes(self):
+        import paddle_tpu as pt2
+        assert pt2.amp.is_bfloat16_supported() is True
+        assert isinstance(pt2.amp.is_float16_supported(), bool)
+
+
 class TestDistanceOps:
     def test_cosine_similarity_matches_torch(self):
         a, b = torch.randn(4, 8), torch.randn(4, 8)
