@@ -89,7 +89,22 @@ def matrix_power(x, n: int):
 
 
 def matrix_rank(x, tol=None, hermitian: bool = False):
-    return jnp.linalg.matrix_rank(_arr(x), tol=tol)
+    x = _arr(x)
+    if not hermitian:
+        return jnp.linalg.matrix_rank(x, tol=tol)
+    # hermitian path: rank from |eigenvalues| (handles negative eigvals,
+    # which a plain SVD-threshold via matrix_rank would also count, but
+    # the reference computes eigvalsh explicitly — match it)
+    w = jnp.abs(jnp.linalg.eigvalsh(x))
+    if tol is None:
+        tol = (w.max(axis=-1, keepdims=True)
+               * max(x.shape[-2], x.shape[-1])
+               * jnp.finfo(x.dtype).eps)
+    else:
+        tol = jnp.asarray(tol)
+        if tol.ndim > 0:
+            tol = tol[..., None]
+    return jnp.sum(w > tol, axis=-1)
 
 
 def multi_dot(xs):

@@ -201,18 +201,30 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
     return summed / counts
 
 
-def _adaptive_avg_matrix(in_size: int, out_size: int):
-    """(out, in) row-stochastic averaging matrix for one spatial axis.
+def _adaptive_edges(in_size: int, out_size: int):
+    """Bin o covers input rows [o*in//out, ceil((o+1)*in/out)) —
+    torch/paddle adaptive-pool semantics.  Single source of the
+    bin-boundary math for both the avg and max adaptive pools."""
+    o = np.arange(out_size)
+    return (o * in_size) // out_size, -(-((o + 1) * in_size) // out_size)
 
-    Bin i covers [floor(i*in/out), ceil((i+1)*in/out)) — torch/paddle
-    adaptive-pool semantics.  Built with numpy at trace time (static
-    shapes), so the general case lowers to two MXU matmuls."""
-    m = np.zeros((out_size, in_size), np.float32)
-    for i in range(out_size):
-        start = (i * in_size) // out_size
-        end = -(-((i + 1) * in_size) // out_size)
-        m[i, start:end] = 1.0 / (end - start)
-    return m
+
+def _adaptive_bins(in_size: int, out_size: int):
+    """Static (idx, mask) per bin, padded to the max bin span."""
+    start, end = _adaptive_edges(in_size, out_size)
+    span = int((end - start).max())
+    offs = start[:, None] + np.arange(span)[None, :]
+    return np.minimum(offs, in_size - 1), offs < end[:, None]
+
+
+def _adaptive_avg_matrix(in_size: int, out_size: int):
+    """(out, in) row-stochastic averaging matrix for one spatial axis,
+    built at trace time (static shapes), so the general case lowers to
+    two MXU matmuls."""
+    start, end = _adaptive_edges(in_size, out_size)
+    cols = np.arange(in_size)
+    m = ((cols >= start[:, None]) & (cols < end[:, None])).astype(np.float32)
+    return m / m.sum(axis=1, keepdims=True)
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
@@ -663,11 +675,28 @@ def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
         in_h, in_w = x.shape[2], x.shape[3]
     else:
         in_h, in_w = x.shape[1], x.shape[2]
-    enforce(in_h % out_h == 0 and in_w % out_w == 0,
-            "adaptive_max_pool2d requires divisible sizes")
-    return max_pool2d(x, (in_h // out_h, in_w // out_w),
-                      stride=(in_h // out_h, in_w // out_w),
-                      data_format=data_format)
+    if in_h % out_h == 0 and in_w % out_w == 0:  # fast reduce_window path
+        return max_pool2d(x, (in_h // out_h, in_w // out_w),
+                          stride=(in_h // out_h, in_w // out_w),
+                          data_format=data_format)
+    ih, mh = _adaptive_bins(in_h, out_h)
+    iw, mw = _adaptive_bins(in_w, out_w)
+    neg = jnp.asarray(jnp.finfo(x.dtype).min
+                      if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    if data_format == "NCHW":
+        xh = x[:, :, jnp.asarray(ih), :]            # (N,C,out_h,S,W)
+        xh = jnp.where(jnp.asarray(mh)[None, None, :, :, None], xh, neg)
+        xh = xh.max(axis=3)                         # (N,C,out_h,W)
+        xw = xh[:, :, :, jnp.asarray(iw)]           # (N,C,out_h,out_w,T)
+        xw = jnp.where(jnp.asarray(mw)[None, None, None, :, :], xw, neg)
+        return xw.max(axis=4)
+    xh = x[:, jnp.asarray(ih), :, :]                # (N,out_h,S,W,C)
+    xh = jnp.where(jnp.asarray(mh)[None, :, :, None, None], xh, neg)
+    xh = xh.max(axis=2)                             # (N,out_h,W,C)
+    xw = xh[:, :, jnp.asarray(iw), :]               # (N,out_h,out_w,T,C)
+    xw = jnp.where(jnp.asarray(mw)[None, None, :, :, None], xw, neg)
+    return xw.max(axis=3)
 
 
 def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):

@@ -829,7 +829,7 @@ class UpsamplingBilinear2D(Upsample):
 
 class UpsamplingNearest2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
-        super().__init__(size, scale_factor, "nearest", data_format)
+        super().__init__(size, scale_factor, "nearest", data_format=data_format)
 
 
 class PixelShuffle(Layer):

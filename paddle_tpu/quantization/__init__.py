@@ -419,8 +419,19 @@ class PostTrainingQuantization:
             else:
                 self.convert(sub)
                 continue
-            if isinstance(sub.input_quanter, FakeQuantMovingAverageAbsMax):
-                int8._buffers["in_scale"] = \
-                    sub.input_quanter._buffers["scale"]
+            if not isinstance(sub.input_quanter,
+                              FakeQuantMovingAverageAbsMax):
+                raise ValueError(
+                    "convert() needs a calibrated input observer on every "
+                    "quantized layer; run PostTrainingQuantization."
+                    "quantize(model, calibration_data) first (got "
+                    f"{type(sub.input_quanter).__name__} on {name!r})")
+            scale = sub.input_quanter._buffers["scale"]
+            if float(scale) <= 0.0:
+                raise ValueError(
+                    f"input observer on {name!r} was never calibrated "
+                    "(scale=0); pass at least one calibration batch to "
+                    "quantize() before convert()")
+            int8._buffers["in_scale"] = scale
             model._sub_layers[name] = int8
         return model

@@ -107,10 +107,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):
     H, W = x.shape[2], x.shape[3]
     boxes = jnp.asarray(boxes, jnp.float32)
     img_idx = _box_batch_index(boxes_num, boxes.shape[0])
-    # dense sampling grid per bin (static) with max-reduction approximates
-    # the quantized max-pool exactly for sr >= bin span in pixels; use a
-    # fixed sr and nearest-neighbor samples so maxima are real pixels
-    sr = 4
+    # Exact quantized max-pool, reference partitioning: bin bounds come
+    # from the UNclipped rounded RoI; each bin's pixel range is then
+    # clipped to the image (empty bins → 0).  Computed as a separable
+    # masked row-max then col-max over the full H (resp. W) axis, so it
+    # is exact for any box with no per-bin span bound.
 
     def one_box(feat, box):
         x1 = jnp.round(box[0] * spatial_scale)
@@ -120,18 +121,29 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):
         rh = jnp.maximum(y2 - y1 + 1, 1.0)
         rw = jnp.maximum(x2 - x1 + 1, 1.0)
         bin_h, bin_w = rh / ph, rw / pw
-        iy = jnp.arange(ph)[:, None, None, None]
-        ix = jnp.arange(pw)[None, :, None, None]
-        sy = jnp.arange(sr)[None, None, :, None]
-        sx = jnp.arange(sr)[None, None, None, :]
-        ys = jnp.floor(y1 + iy * bin_h + (sy + 0.5) / sr * bin_h)
-        xs = jnp.floor(x1 + ix * bin_w + (sx + 0.5) / sr * bin_w)
-        yc = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
-        xc = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
-        vals = feat[:, yc, xc]                       # (C,ph,pw,sr,sr)
-        return jnp.max(vals, axis=(-2, -1))
+        iy = jnp.arange(ph)[:, None]
+        ix = jnp.arange(pw)[:, None]
+        hs = jnp.clip(y1 + jnp.floor(iy * bin_h), 0, H)       # (ph,1)
+        he = jnp.clip(y1 + jnp.ceil((iy + 1) * bin_h), 0, H)
+        ws = jnp.clip(x1 + jnp.floor(ix * bin_w), 0, W)       # (pw,1)
+        we = jnp.clip(x1 + jnp.ceil((ix + 1) * bin_w), 0, W)
+        rows = jnp.arange(H)[None, :]
+        cols = jnp.arange(W)[None, :]
+        mask_h = (rows >= hs) & (rows < he)                   # (ph,H)
+        mask_w = (cols >= ws) & (cols < we)                   # (pw,W)
+        # rowmax[c,i,w] = max over bin i's rows; (C,1,H,W) masked → (C,ph,W)
+        rowmax = jnp.max(jnp.where(mask_h[None, :, :, None],
+                                   feat[:, None, :, :], -jnp.inf), axis=2)
+        # (C,ph,1,W) masked by (pw,W) → (C,ph,pw)
+        out = jnp.max(jnp.where(mask_w[None, None, :, :],
+                                rowmax[:, :, None, :], -jnp.inf), axis=3)
+        empty = (~jnp.any(mask_h, 1))[:, None] | (~jnp.any(mask_w, 1))[None]
+        return jnp.where(empty[None], 0.0, out)
 
-    return jax.vmap(one_box)(x[img_idx], boxes)
+    # lax.map (not vmap): the masked row-max intermediate is (C,ph,H,W)
+    # per box — batching it over hundreds of boxes would blow HBM, and
+    # each step already has plenty of inner parallelism for the VPU
+    return lax.map(lambda fb: one_box(*fb), (x[img_idx], boxes))
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):

@@ -87,6 +87,15 @@ class TestPoolNormAct:
             torch.from_numpy(np.asarray(x)), (2, 2)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-6)
 
+    def test_adaptive_max_pool_non_divisible(self):
+        # general path: output size does not divide input size
+        for out_size in [(3, 3), (5, 2), (7, 6)]:
+            x = jnp.asarray(R.randn(2, 3, 8, 9), jnp.float32)
+            got = np.asarray(nn.AdaptiveMaxPool2D(out_size)(x))
+            want = torch.nn.functional.adaptive_max_pool2d(
+                torch.from_numpy(np.asarray(x)), out_size).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
     def test_instance_norm_matches_torch(self):
         x = R.randn(2, 4, 8, 8).astype(np.float32)
         pt.seed(0)
@@ -137,6 +146,13 @@ class TestPoolNormAct:
         assert nn.Unflatten(1, (1, 2))(jnp.zeros((3, 2, 5))).shape \
             == (3, 1, 2, 5)
         assert nn.Identity()(x) is x
+        # regression: UpsamplingNearest2D used to pass data_format into
+        # align_corners positionally and raise on every forward
+        got = np.asarray(nn.UpsamplingNearest2D(scale_factor=2)(x))
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(np.asarray(x)), scale_factor=2,
+            mode="nearest").numpy()
+        np.testing.assert_allclose(got, want)
 
 
 class TestLosses:

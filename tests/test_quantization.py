@@ -131,6 +131,25 @@ def test_ptq_conv_model_preserves_bn_and_converts_conv():
     assert np.max(np.abs(got - ref)) / scale < 0.05
 
 
+def test_convert_without_calibration_raises():
+    """convert() must refuse uncalibrated models instead of silently
+    using in_scale=1.0 (which clips any |x|>1 activation)."""
+    import pytest
+    pt.seed(3)
+    model = _mlp()
+    ptq = Q.PostTrainingQuantization()
+    # quantize with zero calibration batches: observers stay at scale 0
+    ptq.quantize(model, [])
+    with pytest.raises(ValueError, match="never calibrated"):
+        ptq.convert(model)
+    # QAT wrappers with an abs_max input quanter also must not convert
+    model2 = _mlp()
+    qat = Q.ImperativeQuantAware(activation_quantize_type="abs_max")
+    qat.quantize(model2)
+    with pytest.raises(ValueError, match="calibrated input observer"):
+        Q.PostTrainingQuantization().convert(model2)
+
+
 def test_wide_bits_use_wider_storage():
     """bits > 8 must widen the storage dtype, not wrap modulo 256."""
     w = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)

@@ -157,6 +157,12 @@ class TestLinalg:
                                    a @ a @ a, rtol=1e-3, atol=1e-3)
         lowrank = np.outer(R.randn(4), R.randn(4)).astype(np.float32)
         assert int(pt.linalg.matrix_rank(lowrank, tol=1e-4)) == 1
+        # hermitian=True must count negative eigenvalues by magnitude
+        q, _ = np.linalg.qr(R.randn(4, 4))
+        herm = (q @ np.diag([3.0, -2.0, 1e-6, 0.0]) @ q.T).astype(np.float32)
+        herm = (herm + herm.T) / 2
+        assert int(pt.linalg.matrix_rank(herm, tol=1e-3,
+                                         hermitian=True)) == 2
         p = np.asarray(pt.linalg.pinv(lowrank, rcond=1e-5))  # f32 noise floor
         np.testing.assert_allclose(lowrank @ p @ lowrank, lowrank,
                                    rtol=1e-3, atol=1e-3)

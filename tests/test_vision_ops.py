@@ -62,6 +62,57 @@ class TestRoIPool:
                                     [1], output_size=2))
         np.testing.assert_allclose(out[0, 0], [[1, 1], [5, 5]])
 
+    def test_sharp_peak_not_missed(self):
+        # a single-pixel max must be found wherever it sits in the bin —
+        # the old fixed 4x4 sample grid could miss it entirely
+        feat = np.zeros((1, 1, 16, 16), np.float32)
+        feat[0, 0, 3, 5] = 100.0
+        feat[0, 0, 11, 13] = 7.0
+        box = np.asarray([[0.0, 0.0, 15.0, 15.0]], np.float32)
+        out = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(box),
+                                    [1], output_size=2))
+        np.testing.assert_allclose(out[0, 0], [[100, 0], [0, 7]])
+
+    def test_every_pixel_position_found(self):
+        # exhaustive: the max pixel is found at EVERY position of a bin
+        R = np.random.RandomState(0)
+        feat = R.rand(1, 2, 9, 9).astype(np.float32)  # non-divisible bins
+        box = np.asarray([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(box),
+                                    [1], output_size=2))
+        # bins: rows/cols 0..4 and 5..8 (rh=9, bin=4.5 → floor/ceil)
+        f = feat[0]
+        for c in range(2):
+            want = [[f[c, 0:5, 0:5].max(), f[c, 0:5, 4:9].max()],
+                    [f[c, 4:9, 0:5].max(), f[c, 4:9, 4:9].max()]]
+            np.testing.assert_allclose(out[0, c], want, rtol=1e-6)
+
+    def test_box_past_image_uses_unclipped_partition(self):
+        # bins are laid out over the UNclipped RoI (reference semantics);
+        # only each bin's pixel range is clipped to the image
+        R = np.random.RandomState(1)
+        feat = R.rand(1, 1, 8, 8).astype(np.float32)
+        box = np.asarray([[0.0, 0.0, 13.0, 13.0]], np.float32)
+        out = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(box),
+                                    [1], output_size=2))
+        f = feat[0, 0]   # rh=14 → bin=7: rows [0,7) and [7,14)→clip→[7,8)
+        want = [[f[0:7, 0:7].max(), f[0:7, 7:8].max()],
+                [f[7:8, 0:7].max(), f[7:8, 7:8].max()]]
+        np.testing.assert_allclose(out[0, 0], want, rtol=1e-6)
+        # a fully out-of-image bin yields 0
+        far = np.asarray([[0.0, 0.0, 31.0, 31.0]], np.float32)
+        out2 = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(far),
+                                     [1], output_size=4))
+        assert np.all(out2[0, 0, 2:, :] == 0) and np.all(out2[0, 0, :, 2:] == 0)
+
+    def test_nan_propagates(self):
+        feat = np.ones((1, 1, 8, 8), np.float32)
+        feat[0, 0, 2, 2] = np.nan
+        box = np.asarray([[0.0, 0.0, 7.0, 7.0]], np.float32)
+        out = np.asarray(V.roi_pool(jnp.asarray(feat), jnp.asarray(box),
+                                    [1], output_size=2))
+        assert np.isnan(out[0, 0, 0, 0])
+
     def test_psroi_pool_selects_bin_groups(self):
         ph = pw = 2
         out_c = 3
