@@ -1,0 +1,72 @@
+"""Annotate-then-run with paddle_tpu.distributed.auto_parallel.
+
+The reference flow (auto_parallel/interface.py): build a ProcessMesh,
+annotate a few key tensors with shard_tensor/shard_op, run — the planner
+completes the rest.  Here GSPMD is the planner: annotations become
+NamedSharding placements / with_sharding_constraint, and XLA's sharding
+propagation completes every intermediate.  Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/auto_parallel_annotate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
+
+force_virtual_cpu_mesh(8)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    # 2 (data) x 4 (model) logical process topology
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4).tolist(),
+                            dim_names=["dp", "mp"])
+    print(mesh, "->", mesh.jax_mesh)
+
+    R = np.random.RandomState(0)
+    w1 = dist.shard_tensor(                     # column-parallel
+        jnp.asarray(R.randn(64, 128), jnp.float32),
+        dist_attr={"process_mesh": mesh, "dims_mapping": [-1, 1]})
+    w2 = dist.shard_tensor(                     # row-parallel
+        jnp.asarray(R.randn(128, 64), jnp.float32),
+        dist_attr={"process_mesh": mesh, "dims_mapping": [1, -1]})
+    x = dist.shard_tensor(                      # batch-sharded
+        jnp.asarray(R.randn(16, 64), jnp.float32),
+        dist_attr={"process_mesh": mesh, "dims_mapping": [0, -1]})
+    y = jnp.asarray(R.randn(16, 64), jnp.float32)
+
+    def loss_fn(params, xb, yb):
+        h = jnp.tanh(xb @ params["w1"])
+        out = h @ params["w2"]
+        return jnp.mean((out - yb) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    params = {"w1": w1, "w2": w2}
+    for i in range(5):
+        loss, grads = step(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.05 * g,
+                                        params, grads)
+        print(f"step {i}: loss {float(loss):.4f}")
+
+    # shard_op: annotate one op's inputs/outputs explicitly
+    matmul = dist.shard_op(jnp.matmul, {
+        "process_mesh": mesh,
+        0: {"dims_mapping": [0, -1]},
+        1: {"dims_mapping": [-1, 1]},
+        "out_dims_mappings": [[0, 1]],
+    })
+    out = matmul(jnp.ones((8, 32)), jnp.ones((32, 16)))
+    print("shard_op output sharding:", out.sharding.spec)
+
+
+if __name__ == "__main__":
+    main()

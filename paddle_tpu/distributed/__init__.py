@@ -19,6 +19,8 @@ rewrites, this package expresses as ONE SPMD program over a named
 from __future__ import annotations
 
 from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
                          all_reduce_quantized, all_to_all, barrier,
                          broadcast, p2p_push, reduce, reduce_scatter,

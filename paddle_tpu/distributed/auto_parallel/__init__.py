@@ -1,0 +1,211 @@
+"""auto_parallel: annotate-then-run sharding.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py:39
+(``ProcessMesh``), interface.py:34 (``shard_tensor``), interface.py:73
+(``shard_op``).  There, annotations are recorded into a
+DistributedContext and a planner completes/partitions the program.
+
+TPU-native: GSPMD *is* the planner.  ``dims_mapping`` (dim i of the
+tensor is split over mesh dim ``dims_mapping[i]``; -1 = not split)
+translates directly to a ``PartitionSpec``; annotating is
+``jax.device_put`` on concrete arrays and
+``jax.lax.with_sharding_constraint`` under a trace, and XLA's SPMD
+propagation pass fills in every unannotated intermediate — the role of
+the reference's completion algorithm (auto_parallel/completion.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.errors import enforce
+from ..topology import get_mesh
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "get_default_mesh",
+           "set_default_mesh"]
+
+
+class ProcessMesh:
+    """N-d array of logical process ids (reference process_mesh.py:39).
+
+    ``topology``/``processes`` keep the reference's accessors; ``jax_mesh``
+    is the TPU-native payload: a ``jax.sharding.Mesh`` over the same
+    devices in the same topology, with ``dim_names`` as the axis names
+    (auto-named ``d0, d1, ...`` when not given).
+    """
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None):
+        enforce(isinstance(mesh, (list, tuple, np.ndarray)),
+                "mesh must be a (nested) list of process ids")
+        self._ids = np.asarray(mesh, dtype=np.int64)
+        self._dim_names = (list(dim_names) if dim_names is not None
+                           else [f"d{i}" for i in range(self._ids.ndim)])
+        enforce(len(self._dim_names) == self._ids.ndim,
+                f"dim_names has {len(self._dim_names)} entries for a "
+                f"{self._ids.ndim}-d mesh")
+        self._jax_mesh: Optional[Mesh] = None
+
+    @property
+    def topology(self) -> List[int]:
+        return list(self._ids.shape)
+
+    shape = topology
+
+    @property
+    def processes(self) -> List[int]:
+        return [int(i) for i in self._ids.reshape(-1)]
+
+    process_ids = processes
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            enforce(int(self._ids.max()) < len(devices),
+                    f"process id {int(self._ids.max())} out of range for "
+                    f"{len(devices)} devices")
+            dev_arr = np.asarray(devices, dtype=object)[self._ids]
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.topology}, "
+                f"dim_names={self._dim_names})")
+
+
+_default_mesh: Optional[ProcessMesh] = None
+
+
+def set_default_mesh(mesh: Optional[ProcessMesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[ProcessMesh]:
+    return _default_mesh
+
+
+def _resolve_mesh(process_mesh) -> Mesh:
+    """dist_attr process_mesh → jax Mesh: a ProcessMesh, a nested list
+    (reference style), or None → the default ProcessMesh, else the fleet
+    hybrid mesh."""
+    if isinstance(process_mesh, ProcessMesh):
+        return process_mesh.jax_mesh
+    if process_mesh is not None:
+        return ProcessMesh(process_mesh).jax_mesh
+    if _default_mesh is not None:
+        return _default_mesh.jax_mesh
+    mesh = get_mesh()
+    enforce(mesh is not None,
+            "no process_mesh given and neither auto_parallel's default "
+            "mesh nor the fleet mesh is initialized")
+    return mesh
+
+
+def _spec_from_dims_mapping(mesh: Mesh, dims_mapping: Sequence[int]) -> P:
+    """dims_mapping[i] = j means tensor dim i is split over mesh dim j
+    (-1 = replicated on that dim) — the reference's encoding, interface
+    docstring at interface.py:40-44."""
+    names = mesh.axis_names
+    entries = []
+    for j in dims_mapping:
+        if j == -1:
+            entries.append(None)
+        else:
+            enforce(0 <= j < len(names),
+                    f"dims_mapping entry {j} out of range for mesh dims "
+                    f"{names}")
+            entries.append(names[j])
+    used = [e for e in entries if e is not None]
+    enforce(len(used) == len(set(used)),
+            f"dims_mapping {list(dims_mapping)} maps one mesh dim to "
+            "multiple tensor dims")
+    return P(*entries)
+
+
+def _annotate(x, mesh: Mesh, spec: P):
+    arr = x.__jax_array__() if hasattr(x, "__jax_array__") else x
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(arr, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(arr, sharding)
+    return jax.device_put(arr, sharding)
+
+
+def shard_tensor(x, dist_attr: Optional[Dict[str, Any]] = None, **kw):
+    """Annotate ``x`` with a sharding (reference interface.py:34).
+
+    ``dist_attr = {"process_mesh": ..., "dims_mapping": [0, -1]}``.
+    Returns the annotated tensor: placed (eager) or constrained (traced);
+    unlike the reference the annotation is carried by the array itself,
+    not a side context."""
+    attr = dict(dist_attr or {})
+    attr.update(kw)
+    mesh = _resolve_mesh(attr.get("process_mesh"))
+    arr = x.__jax_array__() if hasattr(x, "__jax_array__") else jnp.asarray(x)
+    dm = attr.get("dims_mapping", [-1] * arr.ndim)
+    enforce(len(dm) == arr.ndim,
+            f"dims_mapping has {len(dm)} entries for a {arr.ndim}-d tensor")
+    return _annotate(arr, mesh, _spec_from_dims_mapping(mesh, dm))
+
+
+def shard_op(op_fn: Callable, dist_attr: Optional[Dict[Any, Any]] = None):
+    """Wrap ``op_fn`` so its inputs (and optionally outputs) are annotated
+    before/after the call (reference interface.py:73).
+
+    ``dist_attr`` keys: ``"process_mesh"``; per-input entries keyed by the
+    tensor object itself (reference style) or by positional index; and an
+    optional ``"out_dims_mappings": [ ... ]`` list for outputs.
+    """
+    attr = dict(dist_attr or {})
+    mesh = _resolve_mesh(attr.get("process_mesh"))
+    out_maps = attr.pop("out_dims_mappings", None)
+
+    def _lookup(i, a):
+        if i in attr:
+            return attr[i]
+        for k, v in attr.items():
+            if k is a or (hasattr(k, "__jax_array__")
+                          and k.__jax_array__() is a):
+                return v
+        return None
+
+    def wrapper(*args, **kwargs):
+        new_args = []
+        for i, a in enumerate(args):
+            cfg = _lookup(i, a)
+            if cfg is not None and "dims_mapping" in cfg:
+                a = _annotate(a, mesh,
+                              _spec_from_dims_mapping(
+                                  mesh, cfg["dims_mapping"]))
+            new_args.append(a)
+        out = op_fn(*new_args, **kwargs)
+        if out_maps is not None:
+            flat, tree = jax.tree_util.tree_flatten(out)
+            enforce(len(flat) == len(out_maps),
+                    f"out_dims_mappings has {len(out_maps)} entries for "
+                    f"{len(flat)} outputs")
+            flat = [o if m is None
+                    else _annotate(o, mesh, _spec_from_dims_mapping(mesh, m))
+                    for o, m in zip(flat, out_maps)]
+            out = jax.tree_util.tree_unflatten(tree, flat)
+        return out
+
+    return wrapper

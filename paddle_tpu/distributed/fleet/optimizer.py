@@ -1,0 +1,207 @@
+"""Strategy-driven optimizer composition.
+
+Reference: fleet_base.py:875 ``distributed_optimizer`` composes the
+meta-optimizer stack from ``DistributedStrategy`` flags —
+meta_optimizers/amp_optimizer.py:20 (dynamic loss scaling + skip-on-inf),
+gradient_merge_optimizer.py:20 (k-step gradient accumulation),
+sharding_optimizer.py:45 (ZeRO state sharding) — plus the dygraph
+``HybridParallelOptimizer`` (hybrid_parallel_optimizer.py:216).
+
+TPU-native: the composition is a pure functional wrapper around the inner
+optimizer's ``init``/``apply_gradients`` contract, so the whole stack stays
+jit/pjit-safe and the gradient-merge counter, loss-scale state and slot
+sharding all live in ONE state pytree that shards/checkpoints like any
+other.  The skip-on-inf is a ``jnp.where`` select (no host sync), exactly
+how the reference's ``update_loss_scaling`` op behaves on-device.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...amp import GradScaler
+from ..sharding import shard_optimizer_state
+from ..topology import get_mesh
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+class HybridParallelOptimizer:
+    """Functional meta-optimizer stack over ``inner``.
+
+    State layout::
+
+        {"inner": <inner opt state>,
+         "amp":   {scale, good, bad}          # when strategy.amp w/ scaling
+         "gm":    {"buf": grads-like, "step"} # when strategy.gradient_merge
+        }
+
+    ``apply_gradients(grads, params, state)`` applies, in order: unscale +
+    found_inf check (amp), k-step accumulation (gradient_merge), inner
+    update gated on ``do_update`` — parameters and inner state only change
+    on real update ticks and never on a nonfinite step.
+    """
+
+    def __init__(self, inner, strategy, model=None):
+        self._inner = inner
+        self._strategy = strategy
+        self._model = model
+        from . import _amp_dtype
+        amp_cfg = dict(strategy.amp_configs or {})
+        dtype = _amp_dtype(amp_cfg)
+        # loss scaling exists for fp16's narrow exponent; bf16 shares the
+        # f32 exponent range so the scaler stays off unless asked for
+        scale_on = bool(strategy.amp) and (
+            dtype == "float16" or "init_loss_scaling" in amp_cfg)
+        self._scaler = GradScaler(
+            enable=scale_on,
+            init_loss_scaling=float(amp_cfg.get("init_loss_scaling", 2.0 ** 15)),
+            incr_ratio=float(amp_cfg.get("incr_ratio", 2.0)),
+            decr_ratio=float(amp_cfg.get("decr_ratio", 0.5)),
+            incr_every_n_steps=int(amp_cfg.get("incr_every_n_steps", 1000)),
+            decr_every_n_nan_or_inf=int(
+                amp_cfg.get("decr_every_n_nan_or_inf", 2)))
+        gm_cfg = dict(strategy.gradient_merge_configs or {})
+        self._k = int(gm_cfg.get("k_steps", 1)) \
+            if strategy.gradient_merge else 1
+        self._gm_avg = bool(gm_cfg.get("avg", True))
+        self._shard = bool(strategy.sharding)
+
+    # -- delegation ---------------------------------------------------------
+    @property
+    def inner(self):
+        return self._inner
+
+    @property
+    def scaler(self) -> GradScaler:
+        return self._scaler
+
+    def __getattr__(self, name):  # get_lr/set_lr/state_dict passthrough
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    # -- functional contract ------------------------------------------------
+    def init(self, params) -> Dict[str, Any]:
+        state: Dict[str, Any] = {"inner": self._inner.init(params)}
+        if self._scaler.is_enable():
+            state["amp"] = self._scaler.init_state()
+        if self._k > 1:
+            state["gm"] = {
+                "buf": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+                "step": jnp.zeros((), jnp.int32),
+            }
+        if self._shard:
+            mesh = get_mesh()
+            axis = "sharding" if mesh is not None \
+                and "sharding" in mesh.axis_names else "dp"
+            state["inner"] = shard_optimizer_state(
+                state["inner"], params_layer=self._model, mesh=mesh,
+                axis=axis)
+        return state
+
+    def scale_loss(self, loss, state):
+        """Multiply the loss by the current loss scale (no-op when the
+        scaler is off) — call inside the loss fn before grad."""
+        if isinstance(state, dict) and "amp" in state:
+            return self._scaler.scale_value(loss, state["amp"])
+        return loss
+
+    def apply_gradients(self, grads, params, state, lr=None):
+        new_state = dict(state)
+        found_inf = jnp.zeros((), jnp.bool_)
+        if "amp" in state:
+            grads, found_inf = self._scaler.unscale_and_check(
+                grads, state["amp"])
+            new_state["amp"] = self._scaler.update_state(
+                state["amp"], found_inf)
+
+        if self._k > 1:
+            _none = lambda x: x is None  # noqa: E731  (None = frozen param)
+            buf, gstep = state["gm"]["buf"], state["gm"]["step"]
+            acc = jax.tree_util.tree_map(
+                lambda g, b: b if g is None
+                else b + jnp.where(found_inf, 0.0, g.astype(jnp.float32)),
+                grads, buf, is_leaf=_none)
+            gstep = gstep + jnp.where(found_inf, 0, 1)
+            do_update = gstep >= self._k
+            scale = 1.0 / self._k if self._gm_avg else 1.0
+            eff = jax.tree_util.tree_map(
+                lambda g, a: None if g is None
+                else (a * scale).astype(g.dtype),
+                grads, acc, is_leaf=_none)
+            new_state["gm"] = {
+                "buf": _tree_where(do_update,
+                                   jax.tree_util.tree_map(jnp.zeros_like,
+                                                          acc), acc),
+                "step": jnp.where(do_update, 0, gstep),
+            }
+        else:
+            do_update = ~found_inf
+            eff = grads
+
+        upd_params, upd_inner = self._inner.apply_gradients(
+            eff, params, state["inner"], lr=lr)
+        new_state["inner"] = _tree_where(do_update, upd_inner,
+                                         state["inner"])
+        return _tree_where(do_update, upd_params, params), new_state
+
+    def update(self, grads, params, state):
+        return self.apply_gradients(grads, params, state)
+
+    # -- stateful (dygraph-parity) path -------------------------------------
+    _hp_state: Optional[Dict[str, Any]] = None
+
+    def step(self, grads=None):
+        """Eager convenience over the bound-parameter inner optimizer
+        (mirrors Optimizer.step); the amp/gm state rides on ``self``."""
+        from ...framework.errors import enforce
+        from ...optimizer import LRScheduler
+        inner = self._inner
+        enforce(inner._parameters is not None,
+                "stateful step() needs parameters= at construction")
+        keys = inner._param_keys()
+        if grads is None:
+            grads = [p._grad for p in inner._parameters]
+        values = dict(zip(keys, (p.value for p in inner._parameters)))
+        gdict = dict(zip(keys, (None if not t.trainable else g
+                                for g, t in zip(grads, inner._parameters))))
+        if self._hp_state is None:
+            self._hp_state = self.init(values)   # ZeRO-sharded when asked
+            if inner._state is not None:         # adopt restored state
+                self._hp_state["inner"] = inner._state
+        lr = inner.get_lr() if isinstance(inner._lr, LRScheduler) else None
+        new_values, self._hp_state = self.apply_gradients(
+            gdict, values, self._hp_state, lr=lr)
+        inner._state = self._hp_state["inner"]
+        for p, k in zip(inner._parameters, keys):
+            p.value = new_values[k]
+            p._grad = None
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def state_dict(self):
+        """Inner state_dict plus the wrapper's amp/gm state — a restored
+        run must keep its decayed loss scale and accumulation buffer."""
+        sd = dict(self._inner.state_dict())
+        if self._hp_state is not None:
+            sd["hybrid"] = {k: v for k, v in self._hp_state.items()
+                            if k != "inner"}
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        hybrid = sd.pop("hybrid", None)
+        self._inner.set_state_dict(sd)
+        if hybrid is not None:
+            self._hp_state = dict(hybrid)
+            self._hp_state["inner"] = self._inner._state
