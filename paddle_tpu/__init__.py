@@ -442,7 +442,8 @@ def scatter(x, index, updates, overwrite=True):
     """Reference phi scatter kernel: with overwrite=False the destination rows
     are zeroed first (ScatterAssignAdd, paddle/phi/kernels/funcs/scatter.h),
     so result rows are the sum of updates only, not dest + updates."""
-    x, index, updates = _arr(x), _arr(index), _arr(updates)
+    x, index, updates = (jnp.asarray(_arr(x)), _arr(index),
+                         _arr(updates))
     if overwrite:
         return x.at[index].set(updates)
     return x.at[index].set(jnp.zeros((), x.dtype)).at[index].add(updates)

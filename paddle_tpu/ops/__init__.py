@@ -12,7 +12,8 @@ in Pallas (flash_attention.py).
 with the flag off everything lowers through the jnp reference semantics.
 """
 from ..framework import flags as _flags
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import (flash_attention,  # noqa: F401
+                              flash_attention_kvcache)
 from .fused import (fused_bias_dropout_residual_layer_norm,  # noqa: F401
                     fused_feedforward, rotary_position_embedding)
 

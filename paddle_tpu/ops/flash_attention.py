@@ -1,8 +1,10 @@
 """Flash attention: the fused FMHA Pallas kernel.
 
 Semantic reference: operators/fused/fused_attention_op.cc:221-357 FMHA path
-(`FMHARef`, fused/fmha_ref.h:58 — QK^T, scale, mask, softmax, PV) and the
-causal-mask fusion `fused_softmax_mask_upper_triangle_op.cu`.  The reference
+(`FMHARef`, fused/fmha_ref.h:58 — QK^T, scale, mask, softmax, dropout, PV),
+the causal-mask fusion `fused_softmax_mask_upper_triangle_op.cu`, the
+in-kernel Philox dropout seeds (fused_attention_op.cc:292-311), and the
+decode-time CacheKV path (fused_attention_op.cc:235).  The reference
 materializes the (S, S) probability matrix in HBM; this kernel never does —
 online softmax over KV blocks keeps everything in VMEM (the whole point of a
 TPU-native rewrite: HBM bandwidth is the bottleneck, SURVEY §7 hard-part 2).
@@ -18,16 +20,27 @@ Causal masking is block-skipped: programs never visit KV blocks strictly
 above the diagonal, so the causal fwd does ~half the FLOPs — the fusion
 `fused_softmax_mask_upper_triangle` only saves bandwidth, not compute.
 
-dropout_p > 0 falls back to the XLA path (F.scaled_dot_product_attention):
-attention-prob dropout requires in-kernel RNG which would pin the mask to
-block layout; the training configs that matter (BASELINE #3/#4) run
-attn dropout 0.  On non-TPU backends the kernel runs in interpret mode, so
-the CPU test mesh exercises the same code path.
+Attention-prob dropout runs IN-KERNEL (the reference's Philox-offset
+trick, counter-based): the keep mask for element (bh, row, col) is a pure
+hash of (seed, bh, row, col), so forward and the recompute backward
+regenerate bit-identical masks with no mask tensor in HBM.  The dropout
+mask applies to the PV accumulation only; the softmax normalizer (and the
+saved lse) stay dropout-free, and the output is rescaled by 1/(1-p).
+
+Ragged sequence lengths are auto-padded to a Mosaic-legal multiple; padded
+KV columns are masked to -inf in every kernel, and padded Q rows are
+sliced away from the output, so callers can pass any length.
+
+On non-TPU backends the kernels run in interpret mode, so the CPU test
+mesh exercises the same code paths (the hash dropout is plain integer
+jnp, identical under interpret and Mosaic).
 """
 from __future__ import annotations
 
 import functools
 from typing import Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -72,26 +85,56 @@ def _block_sizes(seq_q: int, seq_k: int):
     return pick(seq_q), pick(seq_k)
 
 
+def _pad_to_legal(seq: int) -> int:
+    """Smallest Mosaic-legal padded length >= seq: a multiple of 128, or
+    for short sequences a multiple of 8 (full-array blocks are legal)."""
+    if seq % 128 == 0:
+        return seq
+    if seq < 128:
+        return -(-seq // 8) * 8
+    return -(-seq // 128) * 128
+
+
+# ---------------------------------------------------------------------------
+# Counter-based dropout hash (the Philox-offset analog,
+# fused_attention_op.cc:292-311): keep(bh,row,col) is a murmur3-fmix mix of
+# (seed, bh, row, col) — stateless, so fwd and recompute-bwd agree exactly.
+# ---------------------------------------------------------------------------
+def _keep_mask(seed_u32, bh, rows, cols, dropout_p):
+    x = (rows.astype(jnp.uint32) * np.uint32(0x85EBCA6B)
+         ^ cols.astype(jnp.uint32) * np.uint32(0xC2B2AE35)
+         ^ seed_u32
+         ^ bh.astype(jnp.uint32) * np.uint32(0x9E3779B1))
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * np.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    u = (x >> 8).astype(jnp.float32) * np.float32(1.0 / (1 << 24))
+    return u >= dropout_p
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_k):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                causal, block_q, block_k, seq_k, kv_len, offset, dropout_p):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     # dots stay in the input dtype (bf16 on the fast path) with fp32
     # accumulation — casting inputs to fp32 would run the MXU at 1/4 rate
     q = q_ref[0]                                          # (bq, d)
-    num_kv = seq_k // block_k
+    num_kv = -(-kv_len // block_k)       # only blocks touching real keys
     if causal:
         # visit only blocks intersecting the lower triangle; queries are
-        # bottom-right aligned against the key sequence (decode semantics,
-        # matches F.scaled_dot_product_attention)
-        offset = seq_k - q_ref.shape[1] * pl.num_programs(1)
+        # bottom-right aligned against the REAL key length (decode
+        # semantics, matches F.scaled_dot_product_attention); ``offset``
+        # = kv_len - q_len over unpadded lengths
         last = (offset + (qi + 1) * block_q + block_k - 1) // block_k
         num_iter = jnp.minimum(last, num_kv)
     else:
-        offset = 0
         num_iter = num_kv
+    seed = seed_ref[0, 0].astype(jnp.uint32)
 
     def body(j, carry):
         m, l, acc = carry
@@ -99,16 +142,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        rows = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < kv_len
         if causal:
-            rows = offset + qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            valid = valid & (rows + offset >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=1)
+        if dropout_p > 0.0:
+            # PV accumulation uses the dropped probabilities; the softmax
+            # normalizer l does not (dropout applies after normalization)
+            p = jnp.where(_keep_mask(seed, bh, rows, cols, dropout_p),
+                          p, 0.0)
         acc_new = acc * alpha[:, None] + lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -119,23 +169,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m, l, acc = lax.fori_loop(0, num_iter, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / (l_safe[:, None] * (1.0 - dropout_p))
+                ).astype(o_ref.dtype)
     lse = m + jnp.log(l_safe)
     lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, scale, causal):
+def _flash_fwd(q, k, v, seed, scale, causal, dropout_p, kv_len, offset):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     grid = (bh, sq // bq)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, seq_k=sk)
+        block_q=bq, block_k=bk, seq_k=sk, kv_len=kv_len, offset=offset,
+        dropout_p=dropout_p)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0)),       # seed
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
@@ -149,26 +202,27 @@ def _flash_fwd(q, k, v, scale, causal):
             jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(seed, q, k, v)
     return out, lse[:, :, 0]  # keep the compact (bh, sq) form as residual
 
 
 # ---------------------------------------------------------------------------
 # Backward (recompute): dkdv over KV blocks, dq over Q blocks
 # ---------------------------------------------------------------------------
-def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q,
-                 seq_k):
+                 seq_k, kv_len, offset, dropout_p):
+    bh = pl.program_id(0)
     kj = pl.program_id(1)
     k = k_ref[0]                                          # (bk, d)
     v = v_ref[0]
     num_q = seq_q // block_q
     if causal:
-        offset = seq_k - seq_q
         start = jnp.maximum((kj * block_k - offset) // block_q, 0)
     else:
-        offset = 0
         start = 0
+    seed = seed_ref[0, 0].astype(jnp.uint32)
+    keep_scale = 1.0 / (1.0 - dropout_p)
 
     def body(i, carry):
         dk, dv = carry
@@ -181,19 +235,26 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             delta_ref[0, pl.ds(i * block_q, block_q), :], block_k)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        rows = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kj * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < kv_len
         if causal:
-            rows = offset + i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            valid = valid & (rows + offset >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)                              # (bq, bk)
+        if dropout_p > 0.0:
+            pd = jnp.where(_keep_mask(seed, bh, rows, cols, dropout_p),
+                           p * keep_scale, 0.0)
+        else:
+            pd = p
         dv_new = dv + lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (pd * dp - p * delta) * scale
         dk_new = dk + lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -205,37 +266,46 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_k, seq_k):
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, scale, causal, block_q, block_k, seq_k, kv_len,
+               offset, dropout_p):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]
     lse = _stat_tile(lse_ref[0], block_k)     # lane-broadcast → (bq, bk)
     delta = _stat_tile(delta_ref[0], block_k)
-    num_kv = seq_k // block_k
+    num_kv = -(-kv_len // block_k)
     if causal:
-        offset = seq_k - q_ref.shape[1] * pl.num_programs(1)
         last = (offset + (qi + 1) * q.shape[0] + block_k - 1) // block_k
         num_iter = jnp.minimum(last, num_kv)
     else:
-        offset = 0
         num_iter = num_kv
+    seed = seed_ref[0, 0].astype(jnp.uint32)
+    keep_scale = 1.0 / (1.0 - dropout_p)
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        rows = qi * q.shape[0] + lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 0)
+        cols = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        valid = cols < kv_len
         if causal:
-            rows = offset + qi * q.shape[0] + lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], block_k), 0)
-            cols = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            valid = valid & (rows + offset >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)
+        if dropout_p > 0.0:
+            pd = jnp.where(_keep_mask(seed, bh, rows, cols, dropout_p),
+                           p * keep_scale, 0.0)
+        else:
+            pd = p
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (pd * dp - p * delta) * scale
         return dq + lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -245,24 +315,28 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd(scale, causal, res, g):
-    q, k, v, out, lse = res
+def _flash_bwd(scale, causal, dropout_p, kv_len, offset, res, g):
+    q, k, v, seed, out, lse = res
     do = g
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    # broadcast per-row stats across lanes for Mosaic-legal block layouts
+    # NOTE with dropout, out includes the 1/(1-p) rescale; delta =
+    # rowsum(do * out) is exactly sum_k dP_ik P_ik of the dropped softmax
+    # backward, so the standard recurrence still holds.
     lse_b = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
     delta_b = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
 
     dkdv = functools.partial(
         _dkdv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        seq_q=sq, seq_k=sk)
+        seq_q=sq, seq_k=sk, kv_len=kv_len, offset=offset,
+        dropout_p=dropout_p)
     dk, dv = pl.pallas_call(
         dkdv,
         grid=(bh, sk // bk),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, j: (0, 0)),          # seed
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),   # q
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # v
@@ -279,15 +353,16 @@ def _flash_bwd(scale, causal, res, g):
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse_b, delta_b)
+    )(seed, q, k, v, do, lse_b, delta_b)
 
     dqk = functools.partial(
         _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        seq_k=sk)
+        seq_k=sk, kv_len=kv_len, offset=offset, dropout_p=dropout_p)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, sq // bq),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0)),          # seed
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
@@ -298,51 +373,141 @@ def _flash_bwd(scale, causal, res, g):
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse_b, delta_b)
-    return dq, dk, dv
+    )(seed, q, k, v, do, lse_b, delta_b)
+    seed_zero = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, seed_zero
 
 
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_core(q, k, v, scale, causal):
-    out, _ = _flash_fwd(q, k, v, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_core(q, k, v, seed, scale, causal, dropout_p, kv_len,
+                          offset):
+    out, _ = _flash_fwd(q, k, v, seed, scale, causal, dropout_p, kv_len,
+                        offset)
     return out
 
 
-def _core_fwd(q, k, v, scale, causal):
-    out, lse = _flash_fwd(q, k, v, scale, causal)
-    return out, (q, k, v, out, lse)
+def _core_fwd(q, k, v, seed, scale, causal, dropout_p, kv_len, offset):
+    out, lse = _flash_fwd(q, k, v, seed, scale, causal, dropout_p, kv_len,
+                          offset)
+    return out, (q, k, v, seed, out, lse)
 
 
 _flash_attention_core.defvjp(_core_fwd, _flash_bwd)
 
 
+def _pad_seq(x, target):
+    pad = target - x.shape[2]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None, dropout_p: float = 0.0,
-                    training: bool = True):
+                    training: bool = True, seed=None):
     """Fused attention over (batch, heads, seq, head_dim) inputs.
 
     Matches ``F.scaled_dot_product_attention(..., is_causal=causal)``
     numerics (bottom-right causal alignment) without materializing the
-    (seq, seq) probabilities."""
-    if dropout_p > 0.0 and training:
-        # prob-dropout needs in-kernel RNG; XLA reference path handles it
-        from ..nn import functional as F
-        return F.scaled_dot_product_attention(
-            q, k, v, is_causal=causal, dropout_p=dropout_p,
-            training=training, scale=scale)
+    (seq, seq) probabilities.  Ragged sequence lengths are auto-padded;
+    ``dropout_p > 0`` stays on the fused path with an in-kernel
+    counter-based mask (deterministic given ``seed``; when ``seed`` is
+    None one is drawn from the framework RNG stream)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = _block_sizes(sq, sk)
-    enforce(sq % bq == 0 and sk % bk == 0,
-            f"flash_attention needs seq multiples of {bq}/{bk}; pad inputs "
-            f"(got q={sq}, kv={sk})")
+    enforce(k.shape == (b, h, sk, d) and v.shape == (b, h, sk, d),
+            f"k/v shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
     if scale is None:
         scale = d ** -0.5
+    if not training:
+        dropout_p = 0.0
+    if dropout_p > 0.0:
+        if seed is None:
+            # op_key() honors key_scope, so the per-step traced key (not a
+            # trace-time constant) varies the mask across jitted steps
+            from ..framework import random as fw_random
+            seed = jax.random.randint(fw_random.op_key(), (), 0,
+                                      np.iinfo(np.int32).max, jnp.int32)
+        seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    else:
+        seed_arr = jnp.zeros((1, 1), jnp.int32)
+    sq_pad, sk_pad = _pad_to_legal(sq), _pad_to_legal(sk)
+    qf = _pad_seq(q, sq_pad).reshape(b * h, sq_pad, d)
+    kf = _pad_seq(k, sk_pad).reshape(b * h, sk_pad, d)
+    vf = _pad_seq(v, sk_pad).reshape(b * h, sk_pad, d)
+    out = _flash_attention_core(qf, kf, vf, seed_arr, float(scale),
+                                bool(causal), float(dropout_p), sk,
+                                sk - sq)
+    return out.reshape(b, h, sq_pad, d)[:, :, :sq, :]
+
+
+# ---------------------------------------------------------------------------
+# Decode: single-step attention against a KV cache (reference CacheKV,
+# fused_attention_op.cc:235) — memory-bound; the kernel streams only the
+# cache blocks that hold real entries (dynamic trip count on cache_seqlen).
+# ---------------------------------------------------------------------------
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k):
+    q = q_ref[0]                                          # (sq, d)
+    kv_len = len_ref[0, 0]
+    num_iter = (kv_len + block_k - 1) // block_k          # dynamic
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        cols = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], block_k), 1)
+        s = jnp.where(cols < kv_len, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((q.shape[0],), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_iter, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_kvcache(q, k_cache, v_cache, cache_seqlen,
+                            scale: Optional[float] = None):
+    """Decode-step attention: ``q`` (batch, heads, sq, head_dim) attends to
+    ``k_cache/v_cache[:, :, :cache_seqlen]``.  ``cache_seqlen`` may be a
+    traced scalar — the kernel's trip count is dynamic, so one compiled
+    program serves every decode position (no per-step retrace)."""
+    b, h, sq, d = q.shape
+    smax = k_cache.shape[2]
+    enforce(smax % 8 == 0,
+            f"kv cache capacity {smax} must be a multiple of 8 "
+            "(allocate the cache padded)")
+    if scale is None:
+        scale = d ** -0.5
+    bk = min(_block_sizes(smax, smax)[1], smax)
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
-    out = _flash_attention_core(qf, kf, vf, float(scale), bool(causal))
+    kf = k_cache.reshape(b * h, smax, d)
+    vf = v_cache.reshape(b * h, smax, d)
+    len_arr = jnp.asarray(cache_seqlen, jnp.int32).reshape(1, 1)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale), block_k=bk),
+        grid=(b * h,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, sq, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, smax, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, smax, d), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, sq, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(len_arr, qf, kf, vf)
     return out.reshape(b, h, sq, d)

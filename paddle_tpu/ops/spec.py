@@ -33,6 +33,7 @@ class OpSpec:
     atol: float = 1e-6
     grad_rtol: float = 5e-3
     grad_atol: float = 5e-4
+    bf16: bool = True              # False = dtype-limited (no bf16 kernel)
 
 
 _REGISTRY: List[OpSpec] = []
@@ -367,6 +368,657 @@ def _populate() -> None:
         sample=lambda rng: (np.log(_np_softmax(_r(rng, 4, 5))),
                             _np_softmax(_r(rng, 4, 5))),
         grad_wrt=(0,)))
+
+    _populate_round5(unary, binary)
+
+
+def _populate_round5(unary, binary) -> None:
+    """Round-5 corpus: the already-implemented tensor/linalg/fft/functional
+    ops, registered so the numpy-parity + numeric-grad + bf16 sweeps cover
+    them (VERDICT r4 #4; closes most of the api.yaml registration gap)."""
+    import scipy.special as sps
+
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+
+    def _ints(rng, lo, hi, *shape):
+        return rng.randint(lo, hi, shape).astype(np.int32)
+
+    def _bools(rng, *shape):
+        return rng.rand(*shape) > 0.5
+
+    # -- comparisons / logicals (grad-free) -------------------------------
+    for name, npf in [("equal", np.equal), ("not_equal", np.not_equal),
+                      ("greater_than", np.greater),
+                      ("greater_equal", np.greater_equal),
+                      ("less_than", np.less), ("less_equal", np.less_equal)]:
+        binary(name, getattr(pt, name), npf, grad_wrt=())
+    for name, npf in [("logical_and", np.logical_and),
+                      ("logical_or", np.logical_or),
+                      ("logical_xor", np.logical_xor)]:
+        register_op(OpSpec(
+            name=name, fn=getattr(pt, name), ref=npf,
+            sample=lambda rng: (_bools(rng, 3, 4), _bools(rng, 3, 4)),
+            grad_wrt=()))
+    register_op(OpSpec(
+        name="logical_not", fn=pt.logical_not, ref=np.logical_not,
+        sample=lambda rng: (_bools(rng, 3, 4),), grad_wrt=()))
+    for name, npf in [("bitwise_and", np.bitwise_and),
+                      ("bitwise_or", np.bitwise_or),
+                      ("bitwise_xor", np.bitwise_xor)]:
+        register_op(OpSpec(
+            name=name, fn=getattr(pt, name), ref=npf,
+            sample=lambda rng: (_ints(rng, 0, 16, 3, 4),
+                                _ints(rng, 0, 16, 3, 4)),
+            grad_wrt=()))
+    register_op(OpSpec(
+        name="isclose", fn=pt.isclose, ref=np.isclose,
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)), grad_wrt=()))
+
+    # -- predicates / reductions over bool --------------------------------
+    def _specials(rng):
+        x = _r(rng, 3, 4)
+        x[0, 0], x[1, 1], x[2, 2] = np.nan, np.inf, -np.inf
+        return (x,)
+
+    unary("isnan", pt.isnan, np.isnan, sample=_specials, grad_wrt=())
+    unary("isinf", pt.isinf, np.isinf, sample=_specials, grad_wrt=())
+    unary("isfinite", pt.isfinite, np.isfinite, sample=_specials,
+          grad_wrt=())
+    register_op(OpSpec(
+        name="all", fn=lambda x: pt.all(x, axis=1),
+        ref=lambda x: np.all(x, axis=1),
+        sample=lambda rng: (_bools(rng, 3, 4),), grad_wrt=()))
+    register_op(OpSpec(
+        name="any", fn=lambda x: pt.any(x, axis=1),
+        ref=lambda x: np.any(x, axis=1),
+        sample=lambda rng: (_bools(rng, 3, 4),), grad_wrt=()))
+
+    # -- index / argsort family (grad-free) -------------------------------
+    unary("argmax", lambda x: pt.argmax(x, axis=1),
+          lambda x: np.argmax(x, axis=1), grad_wrt=())
+    unary("argmin", lambda x: pt.argmin(x, axis=1),
+          lambda x: np.argmin(x, axis=1), grad_wrt=())
+    unary("argsort", lambda x: pt.argsort(x, axis=1),
+          lambda x: np.argsort(x, axis=1, kind="stable"), grad_wrt=())
+    unary("sort", lambda x: pt.sort(x, axis=1),
+          lambda x: np.sort(x, axis=1))
+    unary("topk", lambda x: pt.topk(x, 3, axis=1)[0],
+          lambda x: -np.sort(-x, axis=1)[:, :3],
+          sample=lambda rng: (_r(rng, 3, 6),), grad_wrt=())
+    unary("kthvalue", lambda x: pt.kthvalue(x, 2, axis=1)[0],
+          lambda x: np.sort(x, axis=1)[:, 1],
+          sample=lambda rng: (_r(rng, 3, 6),), grad_wrt=())
+    register_op(OpSpec(
+        name="mode", fn=lambda x: pt.mode(x, axis=1)[0],
+        ref=_np_mode_rows,
+        sample=lambda rng: (_ints(rng, 0, 3, 4, 7).astype(np.float32),),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="bincount", fn=pt.bincount, ref=np.bincount,
+        sample=lambda rng: (_ints(rng, 0, 8, 20),), grad_wrt=()))
+    register_op(OpSpec(
+        name="histogram",
+        fn=lambda x: pt.histogram(x, bins=5, min=-2.0, max=2.0),
+        ref=lambda x: np.histogram(x, bins=5, range=(-2.0, 2.0))[0],
+        sample=lambda rng: (_r(rng, 20),), grad_wrt=()))
+    register_op(OpSpec(
+        name="bucketize",
+        fn=lambda x, e: pt.bucketize(x, e),
+        ref=lambda x, e: np.searchsorted(e, x),
+        sample=lambda rng: (_r(rng, 8), np.sort(_r(rng, 5))),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="index_select",
+        fn=lambda x, i: pt.index_select(x, i, axis=1),
+        ref=lambda x, i: np.take(x, i, axis=1),
+        sample=lambda rng: (_r(rng, 3, 6), _ints(rng, 0, 6, 4)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="index_sample",
+        fn=pt.index_sample,
+        ref=lambda x, i: np.take_along_axis(x, i, axis=1),
+        sample=lambda rng: (_r(rng, 3, 6), _ints(rng, 0, 6, 3, 2)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="take_along_axis",
+        fn=lambda x, i: pt.take_along_axis(x, i, axis=1),
+        ref=lambda x, i: np.take_along_axis(x, i, axis=1),
+        sample=lambda rng: (_r(rng, 3, 6), _ints(rng, 0, 6, 3, 2)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="put_along_axis",
+        fn=lambda x, i, v: pt.put_along_axis(x, i, v, axis=1),
+        ref=_np_put_along_axis,
+        sample=lambda rng: (_r(rng, 3, 6), _ints(rng, 0, 6, 3, 2),
+                            _r(rng, 3, 2)),
+        grad_wrt=(0, 2)))
+    register_op(OpSpec(
+        name="gather_nd", fn=pt.gather_nd,
+        ref=lambda x, i: x[tuple(np.moveaxis(i, -1, 0))],
+        sample=lambda rng: (_r(rng, 4, 5),
+                            np.stack([_ints(rng, 0, 4, 3),
+                                      _ints(rng, 0, 5, 3)], -1)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="scatter",
+        fn=lambda x, i, u: pt.scatter(x, i, u),
+        ref=_np_scatter_overwrite,
+        sample=lambda rng: (_r(rng, 5, 3), np.asarray([0, 2], np.int32),
+                            _r(rng, 2, 3)),
+        grad_wrt=(0, 2)))
+    register_op(OpSpec(
+        name="multiplex",
+        fn=lambda a, b, i: pt.multiplex([a, b], i),
+        ref=lambda a, b, i: np.where((i == 0)[:, None] if i.ndim == 1
+                                     else (i == 0), a, b),
+        sample=lambda rng: (_r(rng, 4, 3), _r(rng, 4, 3),
+                            _ints(rng, 0, 2, 4)),
+        grad_wrt=()))
+
+    # -- shape manipulation ------------------------------------------------
+    unary("flip", lambda x: pt.flip(x, axis=0), lambda x: np.flip(x, 0))
+    unary("roll", lambda x: pt.roll(x, 2, axis=1),
+          lambda x: np.roll(x, 2, axis=1))
+    unary("tile", lambda x: pt.tile(x, (2, 3)),
+          lambda x: np.tile(x, (2, 3)))
+    unary("broadcast_to", lambda x: pt.broadcast_to(x, (3, 4)),
+          lambda x: np.broadcast_to(x, (3, 4)),
+          sample=lambda rng: (_r(rng, 1, 4),))
+    unary("expand", lambda x: pt.expand(x, (3, 4)),
+          lambda x: np.broadcast_to(x, (3, 4)),
+          sample=lambda rng: (_r(rng, 1, 4),))
+    unary("squeeze", lambda x: pt.squeeze(x, axis=1),
+          lambda x: np.squeeze(x, 1),
+          sample=lambda rng: (_r(rng, 3, 1, 4),))
+    unary("unsqueeze", lambda x: pt.unsqueeze(x, axis=1),
+          lambda x: np.expand_dims(x, 1))
+    unary("stack_pair", lambda x: pt.stack([x, x], axis=0),
+          lambda x: np.stack([x, x], 0))
+    unary("split", lambda x: pt.split(x, 2, axis=1)[0],
+          lambda x: np.split(x, 2, axis=1)[0])
+    unary("chunk", lambda x: pt.chunk(x, 2, axis=1)[1],
+          lambda x: np.array_split(x, 2, axis=1)[1])
+    unary("unbind", lambda x: pt.unbind(x, axis=0)[1],
+          lambda x: x[1])
+    unary("t", pt.t, lambda x: x.T)
+    unary("tril", pt.tril, np.tril, sample=lambda rng: (_r(rng, 4, 4),))
+    unary("triu", pt.triu, np.triu, sample=lambda rng: (_r(rng, 4, 4),))
+    unary("diag", pt.diag, np.diag, sample=lambda rng: (_r(rng, 4),))
+    unary("diagflat", pt.diagflat, np.diagflat,
+          sample=lambda rng: (_r(rng, 2, 3),))
+
+    # -- more math ---------------------------------------------------------
+    unary("neg", pt.neg, np.negative)
+    unary("trunc", pt.trunc, np.trunc, grad_wrt=())
+    unary("digamma", pt.digamma, sps.digamma,
+          sample=lambda rng: (_pos(rng, 3, 4),), rtol=1e-4, atol=1e-5,
+          grad_rtol=2e-2, grad_atol=2e-3)
+    unary("cumprod", lambda x: pt.cumprod(x, 1),
+          lambda x: np.cumprod(x, axis=1),
+          sample=lambda rng: (_pos(rng, 2, 4),))
+    unary("logcumsumexp", lambda x: pt.logcumsumexp(x, axis=1),
+          lambda x: np.log(np.cumsum(np.exp(x), axis=1)),
+          rtol=2e-5, atol=2e-5, grad_rtol=2e-2, grad_atol=2e-3)
+    unary("diff", lambda x: pt.diff(x, axis=1),
+          lambda x: np.diff(x, axis=1))
+    unary("nansum", lambda x: pt.nansum(x, axis=1),
+          lambda x: np.nansum(x, axis=1), sample=_nan_sample, grad_wrt=())
+    unary("nanmedian", lambda x: pt.nanmedian(x, axis=1),
+          lambda x: np.nanmedian(x, axis=1), sample=_nan_sample,
+          grad_wrt=())
+    unary("std", lambda x: pt.std(x, axis=1),
+          lambda x: np.std(x, axis=1, ddof=1))
+    unary("var", lambda x: pt.var(x, axis=1),
+          lambda x: np.var(x, axis=1, ddof=1))
+    unary("norm_fro", pt.norm,
+          lambda x: np.linalg.norm(x.reshape(-1)))
+    unary("scale", lambda x: pt.scale(x, scale=2.0, bias=1.0),
+          lambda x: 2.0 * x + 1.0)
+    unary("renorm", lambda x: pt.renorm(x, p=2.0, axis=0, max_norm=1.0),
+          _np_renorm, sample=lambda rng: (_r(rng, 3, 4) * 2,))
+    binary("mod", pt.mod, np.mod,
+           sample=lambda rng: (_r(rng, 3, 4), _pos(rng, 3, 4)),
+           grad_wrt=(0,))
+    binary("floor_divide", pt.floor_divide, np.floor_divide,
+           sample=lambda rng: (_r(rng, 3, 4), _pos(rng, 3, 4)),
+           grad_wrt=())
+    binary("fmax", pt.fmax, np.fmax)
+    binary("fmin", pt.fmin, np.fmin)
+    binary("ldexp", pt.ldexp, np.ldexp,
+           sample=lambda rng: (_r(rng, 3, 4), _ints(rng, -3, 4, 3, 4)),
+           grad_wrt=(0,))
+    register_op(OpSpec(
+        name="lcm", fn=pt.lcm, ref=np.lcm,
+        sample=lambda rng: (_ints(rng, 1, 20, 6), _ints(rng, 1, 20, 6)),
+        grad_wrt=()))
+    binary("dot", pt.dot, np.dot,
+           sample=lambda rng: (_r(rng, 5), _r(rng, 5)))
+    binary("outer", pt.outer, np.outer,
+           sample=lambda rng: (_r(rng, 3), _r(rng, 4)))
+    binary("cross", pt.cross, np.cross,
+           sample=lambda rng: (_r(rng, 4, 3), _r(rng, 4, 3)))
+    binary("mm", pt.mm, np.matmul,
+           sample=lambda rng: (_r(rng, 3, 4), _r(rng, 4, 5)),
+           rtol=2e-5, atol=2e-5)
+    binary("bmm", pt.bmm, np.matmul,
+           sample=lambda rng: (_r(rng, 2, 3, 4), _r(rng, 2, 4, 5)),
+           rtol=2e-5, atol=2e-5, grad_rtol=2e-2, grad_atol=2e-3)
+    binary("dist", pt.dist,
+           lambda a, b: np.linalg.norm((a - b).reshape(-1)))
+    register_op(OpSpec(
+        name="einsum_ij_jk",
+        fn=lambda a, b: pt.einsum("ij,jk->ik", a, b),
+        ref=lambda a, b: np.einsum("ij,jk->ik", a, b),
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 4, 5)),
+        grad_wrt=(0, 1), rtol=2e-5, atol=2e-5))
+
+    # -- linalg (decompositions compared invariantly) ----------------------
+    def _spd(rng, n=3):
+        a = _r(rng, n, n)
+        return (a @ a.T + n * np.eye(n, dtype=np.float32),)
+
+    register_op(OpSpec(
+        name="linalg.cholesky", fn=pt.linalg.cholesky,
+        ref=np.linalg.cholesky, sample=_spd, grad_wrt=(0,),
+        rtol=1e-4, atol=1e-4, grad_rtol=2e-2, grad_atol=2e-3))
+    register_op(OpSpec(
+        name="linalg.qr_reconstruct",
+        fn=lambda x: (lambda qr: qr[0] @ qr[1])(pt.linalg.qr(x)),
+        ref=lambda x: x, sample=lambda rng: (_r(rng, 4, 3),),
+        grad_wrt=(), rtol=1e-4, atol=1e-4))
+    register_op(OpSpec(
+        name="linalg.svdvals",
+        fn=lambda x: pt.linalg.svd(x)[1],
+        ref=lambda x: np.linalg.svd(x, compute_uv=False),
+        sample=lambda rng: (_r(rng, 4, 3),), grad_wrt=(),
+        rtol=1e-4, atol=1e-4))
+    register_op(OpSpec(
+        name="linalg.eigvalsh",
+        fn=lambda x: pt.linalg.eigvalsh((x + x.T) / 2),
+        ref=lambda x: np.linalg.eigvalsh((x + x.T) / 2),
+        sample=lambda rng: (_r(rng, 4, 4),), grad_wrt=(),
+        rtol=1e-4, atol=1e-4))
+    register_op(OpSpec(
+        name="linalg.matrix_power",
+        fn=lambda x: pt.linalg.matrix_power(x, 3),
+        ref=lambda x: np.linalg.matrix_power(x, 3),
+        sample=_spd, grad_wrt=(0,), rtol=1e-3, atol=1e-3,
+        grad_rtol=5e-2, grad_atol=5e-2))
+    register_op(OpSpec(
+        name="linalg.matrix_rank",
+        fn=lambda x: pt.linalg.matrix_rank(x, tol=1e-4),
+        ref=lambda x: np.linalg.matrix_rank(x, tol=1e-4),
+        sample=lambda rng: (np.outer(_r(rng, 4), _r(rng, 4)),),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="linalg.pinv", fn=pt.linalg.pinv, ref=np.linalg.pinv,
+        sample=_spd, grad_wrt=(), rtol=1e-3, atol=1e-3))
+    register_op(OpSpec(
+        name="linalg.slogdet_logabs",
+        fn=lambda x: pt.linalg.slogdet(x)[1],
+        ref=lambda x: np.linalg.slogdet(x)[1],
+        sample=_spd, grad_wrt=(0,), rtol=1e-4, atol=1e-4,
+        grad_rtol=2e-2, grad_atol=2e-3))
+    register_op(OpSpec(
+        name="linalg.cond", fn=pt.linalg.cond, ref=np.linalg.cond,
+        sample=_spd, grad_wrt=(), rtol=1e-3, atol=1e-3))
+    register_op(OpSpec(
+        name="linalg.lstsq_solution",
+        fn=lambda a, b: pt.linalg.lstsq(a, b)[0],
+        ref=lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+        sample=lambda rng: (_r(rng, 6, 3), _r(rng, 6, 2)),
+        grad_wrt=(), rtol=1e-3, atol=1e-3))
+    register_op(OpSpec(
+        name="linalg.triangular_solve",
+        fn=lambda a, b: pt.linalg.triangular_solve(a, b, upper=False),
+        ref=lambda a, b: np.linalg.solve(np.tril(a), b),
+        sample=lambda rng: (np.tril(_r(rng, 3, 3))
+                            + 3 * np.eye(3, dtype=np.float32),
+                            _r(rng, 3, 2)),
+        grad_wrt=(0, 1), rtol=1e-4, atol=1e-4,
+        grad_rtol=2e-2, grad_atol=2e-3))
+    register_op(OpSpec(
+        name="linalg.norm_axis",
+        fn=lambda x: pt.linalg.norm(x, p=2, axis=1),
+        ref=lambda x: np.linalg.norm(x, ord=2, axis=1),
+        sample=lambda rng: (_r(rng, 3, 5),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="linalg.cov", fn=pt.linalg.cov, ref=np.cov,
+        sample=lambda rng: (_r(rng, 3, 8),), grad_wrt=(0,),
+        rtol=1e-4, atol=1e-5))
+
+    # -- fft (complex outputs compared directly; grads n/a) ----------------
+    for name in ["fft", "ifft", "fft2", "fftshift", "ifftshift"]:
+        register_op(OpSpec(
+            name=f"fft.{name}", fn=getattr(pt.fft, name),
+            ref=getattr(np.fft, name),
+            sample=lambda rng: (_r(rng, 4, 8),),
+            grad_wrt=(), rtol=1e-4, atol=1e-4, bf16=False))
+    register_op(OpSpec(
+        name="fft.rfft", fn=pt.fft.rfft, ref=np.fft.rfft,
+        sample=lambda rng: (_r(rng, 8),), grad_wrt=(),
+        rtol=1e-4, atol=1e-4, bf16=False))
+    register_op(OpSpec(
+        name="fft.irfft", fn=pt.fft.irfft,
+        ref=lambda x: np.fft.irfft(x),
+        sample=lambda rng: (np.fft.rfft(_r(rng, 8)),), grad_wrt=(),
+        rtol=1e-4, atol=1e-4))
+
+    # -- nn.functional: pooling / conv / resampling ------------------------
+    register_op(OpSpec(
+        name="nn.functional.avg_pool2d",
+        fn=lambda x: F.avg_pool2d(x, 2),
+        ref=lambda x: x.reshape(2, 3, 2, 2, 2, 2).mean((3, 5)),
+        sample=lambda rng: (_r(rng, 2, 3, 4, 4),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.max_pool2d",
+        fn=lambda x: F.max_pool2d(x, 2),
+        ref=lambda x: x.reshape(2, 3, 2, 2, 2, 2).max((3, 5)),
+        sample=lambda rng: (_r(rng, 2, 3, 4, 4),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.adaptive_avg_pool2d",
+        fn=lambda x: F.adaptive_avg_pool2d(x, (3, 3)),
+        ref=lambda x: _np_adaptive_pool(x, 3, np.mean),
+        sample=lambda rng: (_r(rng, 2, 2, 5, 5),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.adaptive_max_pool2d",
+        fn=lambda x: F.adaptive_max_pool2d(x, (3, 3)),
+        ref=lambda x: _np_adaptive_pool(x, 3, np.max),
+        sample=lambda rng: (_r(rng, 2, 2, 5, 5),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.conv2d",
+        fn=lambda x, w: F.conv2d(x, w, padding=1),
+        ref=lambda x, w: _np_conv2d(x, w, pad=1),
+        sample=lambda rng: (_r(rng, 1, 2, 4, 4), _r(rng, 3, 2, 3, 3)),
+        grad_wrt=(0, 1), rtol=2e-5, atol=2e-5, grad_rtol=2e-2,
+        grad_atol=2e-3))
+    register_op(OpSpec(
+        name="nn.functional.interpolate_nearest",
+        fn=lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+        ref=lambda x: x.repeat(2, axis=2).repeat(2, axis=3),
+        sample=lambda rng: (_r(rng, 1, 2, 3, 3),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.pad",
+        fn=lambda x: F.pad(x, [1, 2], value=0.5),
+        ref=lambda x: np.pad(x, ((0, 0), (0, 0), (0, 0), (1, 2)),
+                             constant_values=0.5),
+        sample=lambda rng: (_r(rng, 1, 2, 3, 3),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.pixel_shuffle",
+        fn=lambda x: F.pixel_shuffle(x, 2),
+        ref=_np_pixel_shuffle,
+        sample=lambda rng: (_r(rng, 1, 8, 3, 3),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.pixel_unshuffle",
+        fn=lambda x: F.pixel_unshuffle(x, 2),
+        ref=lambda x: _np_pixel_unshuffle(x, 2),
+        sample=lambda rng: (_r(rng, 1, 2, 4, 4),), grad_wrt=(0,)))
+
+    # -- nn.functional: embeddings / norms / misc --------------------------
+    register_op(OpSpec(
+        name="nn.functional.embedding",
+        fn=lambda i, w: F.embedding(i, w),
+        ref=lambda i, w: w[i],
+        sample=lambda rng: (_ints(rng, 0, 6, 3, 4), _r(rng, 6, 5)),
+        grad_wrt=(1,)))
+    register_op(OpSpec(
+        name="nn.functional.one_hot",
+        fn=lambda i: F.one_hot(i, 6),
+        ref=lambda i: np.eye(6, dtype=np.float32)[i],
+        sample=lambda rng: (_ints(rng, 0, 6, 7),), grad_wrt=()))
+    unary("nn.functional.normalize",
+          lambda x: F.normalize(x, axis=1),
+          lambda x: x / np.maximum(
+              np.linalg.norm(x, axis=1, keepdims=True), 1e-12))
+    # cubed so sum-reduction grads are nonzero: both sum(y) and sum(y^2)
+    # of a normalized group are constants, leaving only fd noise
+    register_op(OpSpec(
+        name="nn.functional.group_norm",
+        fn=lambda x: F.group_norm(x, 2) ** 3,
+        ref=lambda x: _np_group_norm(x, 2, 1e-5) ** 3,
+        sample=lambda rng: (_r(rng, 2, 4, 3, 3),), grad_wrt=(0,),
+        rtol=2e-5, atol=2e-5, grad_rtol=2e-2, grad_atol=2e-3))
+    unary("nn.functional.rms_norm", F.rms_norm,
+          lambda x: x / np.sqrt(np.mean(x * x, -1, keepdims=True) + 1e-6),
+          rtol=2e-5, atol=2e-5)
+    register_op(OpSpec(
+        name="nn.functional.batch_norm_eval",
+        fn=lambda x, m, v: F.batch_norm(x, m, v, training=False)[0],
+        ref=lambda x, m, v: (x - m[None, :, None, None])
+        / np.sqrt(v[None, :, None, None] + 1e-5),
+        sample=lambda rng: (_r(rng, 2, 3, 4, 4), _r(rng, 3),
+                            _pos(rng, 3)),
+        grad_wrt=(0,), rtol=2e-5, atol=2e-5, grad_rtol=2e-2,
+        grad_atol=2e-3))
+    register_op(OpSpec(
+        name="nn.functional.dropout_eval",
+        fn=lambda x: F.dropout(x, 0.5, training=False),
+        ref=lambda x: x, sample=lambda rng: (_r(rng, 3, 4),)))
+    unary("nn.functional.swish", F.swish,
+          lambda x: x / (1 + np.exp(-x)))
+    register_op(OpSpec(
+        name="nn.functional.prelu",
+        fn=F.prelu,
+        ref=lambda x, w: np.where(x >= 0, x, x * w[None, :, None, None]),
+        # keep |x| away from the kink so finite differences are valid
+        sample=lambda rng: (np.sign(_r(rng, 2, 3, 4, 4))
+                            * (np.abs(_r(rng, 2, 3, 4, 4)) * 0.5 + 0.3),
+                            _pos(rng, 3) * 0.1),
+        grad_wrt=(0, 1)))
+    register_op(OpSpec(
+        name="nn.functional.softmax_mask_fuse_upper_triangle",
+        fn=F.softmax_mask_fuse_upper_triangle,
+        ref=_np_causal_softmax,
+        sample=lambda rng: (_r(rng, 2, 2, 4, 4),),
+        grad_wrt=(0,), rtol=2e-5, atol=2e-5))
+    register_op(OpSpec(
+        name="nn.functional.label_smooth",
+        fn=lambda x: F.label_smooth(x, epsilon=0.1),
+        ref=lambda x: x * 0.9 + 0.1 / x.shape[-1],
+        sample=lambda rng: (np.eye(4, dtype=np.float32)[
+            np.random.RandomState(0).randint(0, 4, 5)],)))
+
+    # -- nn.functional: losses ---------------------------------------------
+    register_op(OpSpec(
+        name="nn.functional.l1_loss",
+        fn=lambda a, b: F.l1_loss(a, b),
+        ref=lambda a, b: np.mean(np.abs(a - b)),
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.smooth_l1_loss",
+        fn=lambda a, b: F.smooth_l1_loss(a, b),
+        ref=lambda a, b: np.mean(np.where(
+            np.abs(a - b) < 1.0, 0.5 * (a - b) ** 2,
+            np.abs(a - b) - 0.5)),
+        sample=lambda rng: (_r(rng, 3, 4) * 2, _r(rng, 3, 4)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.square_error_cost",
+        fn=F.square_error_cost,
+        ref=lambda a, b: (a - b) ** 2,
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)),
+        grad_wrt=(0, 1)))
+    register_op(OpSpec(
+        name="nn.functional.binary_cross_entropy_with_logits",
+        fn=lambda lg, lb: F.binary_cross_entropy_with_logits(lg, lb),
+        ref=lambda lg, lb: np.mean(
+            np.maximum(lg, 0) - lg * lb + np.log1p(np.exp(-np.abs(lg)))),
+        sample=lambda rng: (_r(rng, 3, 4),
+                            (rng.rand(3, 4) > 0.5).astype(np.float32)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.nll_loss",
+        fn=lambda lp, lb: F.nll_loss(lp, lb),
+        ref=lambda lp, lb: -np.mean(lp[np.arange(lp.shape[0]), lb]),
+        sample=lambda rng: (np.log(_np_softmax(_r(rng, 5, 6))),
+                            _ints(rng, 0, 6, 5)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.pairwise_distance",
+        fn=lambda a, b: F.pairwise_distance(a, b),
+        ref=lambda a, b: np.linalg.norm(a - b + 1e-6, axis=1),
+        sample=lambda rng: (_r(rng, 3, 5), _r(rng, 3, 5)),
+        grad_wrt=(0, 1), rtol=1e-4, atol=1e-4))
+    register_op(OpSpec(
+        name="nn.functional.margin_ranking_loss",
+        fn=lambda a, b, y: F.margin_ranking_loss(a, b, y, margin=0.2),
+        ref=lambda a, b, y: np.mean(np.maximum(0, -y * (a - b) + 0.2)),
+        sample=lambda rng: (_r(rng, 6), _r(rng, 6),
+                            np.sign(_r(rng, 6)).astype(np.float32)),
+        grad_wrt=(0, 1)))
+    register_op(OpSpec(
+        name="nn.functional.hinge_embedding_loss",
+        fn=lambda x, y: F.hinge_embedding_loss(x, y),
+        ref=lambda x, y: np.mean(np.where(
+            y == 1, x, np.maximum(0, 1.0 - x))),
+        sample=lambda rng: (_pos(rng, 6),
+                            np.where(rng.rand(6) > 0.5, 1.0,
+                                     -1.0).astype(np.float32)),
+        grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="nn.functional.triplet_margin_loss",
+        fn=lambda a, p, n: F.triplet_margin_loss(a, p, n),
+        ref=lambda a, p, n: np.mean(np.maximum(
+            np.sqrt(np.sum((a - p) ** 2, 1) + 1e-6)
+            - np.sqrt(np.sum((a - n) ** 2, 1) + 1e-6) + 1.0, 0)),
+        sample=lambda rng: (_r(rng, 4, 5), _r(rng, 4, 5), _r(rng, 4, 5)),
+        grad_wrt=(0,), rtol=1e-4, atol=1e-4))
+    register_op(OpSpec(
+        name="nn.functional.cosine_embedding_loss",
+        fn=lambda a, b, y: F.cosine_embedding_loss(a, b, y),
+        ref=_np_cosine_embedding_loss,
+        sample=lambda rng: (_r(rng, 4, 5), _r(rng, 4, 5),
+                            np.where(np.random.RandomState(3).rand(4) > 0.5,
+                                     1.0, -1.0).astype(np.float32)),
+        grad_wrt=(0, 1), rtol=1e-4, atol=1e-4))
+
+    # -- complex-number surface -------------------------------------------
+    register_op(OpSpec(
+        name="complex", fn=pt.complex,
+        ref=lambda re, im: re + 1j * im,
+        sample=lambda rng: (_r(rng, 3, 4), _r(rng, 3, 4)),
+        grad_wrt=(), bf16=False))
+    register_op(OpSpec(
+        name="real", fn=pt.real, ref=np.real,
+        sample=lambda rng: (_r(rng, 3, 4) + 1j * _r(rng, 3, 4),),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="imag", fn=pt.imag, ref=np.imag,
+        sample=lambda rng: (_r(rng, 3, 4) + 1j * _r(rng, 3, 4),),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="conj", fn=pt.conj, ref=np.conj,
+        sample=lambda rng: (_r(rng, 3, 4) + 1j * _r(rng, 3, 4),),
+        grad_wrt=()))
+    register_op(OpSpec(
+        name="angle", fn=pt.angle, ref=np.angle,
+        sample=lambda rng: (_r(rng, 3, 4) + 1j * _r(rng, 3, 4),),
+        grad_wrt=(), rtol=1e-4, atol=1e-5))
+
+
+def _nan_sample(rng):
+    x = _r(rng, 3, 5)
+    x[0, 1] = np.nan
+    x[2, 3] = np.nan
+    return (x,)
+
+
+def _np_mode_rows(x):
+    """Most frequent value per row; ties resolve to the LARGEST value
+    (mode_op semantics, matching tensor_ops.mode)."""
+    out = []
+    for r in x:
+        vals, counts = np.unique(r, return_counts=True)
+        best = vals[counts == counts.max()]
+        out.append(best.max())
+    return np.asarray(out, x.dtype)
+
+
+def _np_put_along_axis(x, i, v):
+    out = x.copy()
+    np.put_along_axis(out, i, v, axis=1)
+    return out
+
+
+def _np_scatter_overwrite(x, index, updates):
+    out = x.copy()
+    out[index] = updates
+    return out
+
+
+def _np_renorm(x, p=2.0, axis=0, max_norm=1.0):
+    # slice i along `axis` scaled so its p-norm is <= max_norm
+    out = x.copy()
+    norms = np.linalg.norm(x.reshape(x.shape[0], -1) if axis == 0 else x,
+                           axis=1 if axis == 0 else axis)
+    for i in range(x.shape[axis]):
+        n = norms[i]
+        if n > max_norm:
+            out[i] = x[i] * (max_norm / n)
+    return out
+
+
+def _np_adaptive_pool(x, out, reduce):
+    n, c, h, w = x.shape
+    res = np.zeros((n, c, out, out), x.dtype)
+    for i in range(out):
+        for j in range(out):
+            hs, he = (i * h) // out, -(-((i + 1) * h) // out)
+            ws, we = (j * w) // out, -(-((j + 1) * w) // out)
+            res[:, :, i, j] = reduce(x[:, :, hs:he, ws:we], axis=(2, 3))
+    return res
+
+
+def _np_conv2d(x, w, pad=0):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh, ow = h + 2 * pad - kh + 1, wd + 2 * pad - kw + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out
+
+
+def _np_pixel_shuffle(x):
+    n, c, h, w = x.shape
+    r = 2
+    y = x.reshape(n, c // (r * r), r, r, h, w)
+    return y.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r),
+                                                 h * r, w * r)
+
+
+def _np_pixel_unshuffle(x, r):
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // r, r, w // r, r)
+    return y.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r,
+                                                 h // r, w // r)
+
+
+def _np_group_norm(x, groups, eps):
+    n, c, h, w = x.shape
+    y = x.reshape(n, groups, c // groups, h, w)
+    mu = y.mean(axis=(2, 3, 4), keepdims=True)
+    var = y.var(axis=(2, 3, 4), keepdims=True)
+    return ((y - mu) / np.sqrt(var + eps)).reshape(x.shape)
+
+
+def _np_causal_softmax(x):
+    s, t = x.shape[-2], x.shape[-1]
+    mask = np.triu(np.ones((s, t), bool), k=1)
+    xm = np.where(mask, -1e9, x)
+    return _np_softmax(xm)
+
+
+def _np_cosine_embedding_loss(a, b, y, margin=0.0):
+    cos = np.sum(a * b, 1) / np.maximum(
+        np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1), 1e-12)
+    loss = np.where(y == 1, 1 - cos, np.maximum(0, cos - margin))
+    return np.mean(loss)
 
 
 def _erf_scalar(x: float) -> float:

@@ -164,3 +164,157 @@ class TestRope:
         s2 = jnp.einsum("bhqd,bhkd->bhqk", q2, k2)
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestFlashDropout:
+    """In-kernel counter-based attention dropout (reference Philox seeds,
+    fused_attention_op.cc:292-311): fused path, deterministic per seed."""
+
+    def test_deterministic_given_seed(self):
+        q, k, v = _rand_qkv()
+        a = ops.flash_attention(q, k, v, dropout_p=0.3, seed=42)
+        b = ops.flash_attention(q, k, v, dropout_p=0.3, seed=42)
+        c = ops.flash_attention(q, k, v, dropout_p=0.3, seed=43)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_eval_mode_disables(self):
+        q, k, v = _rand_qkv()
+        out = ops.flash_attention(q, k, v, dropout_p=0.3, training=False)
+        ref = ops.flash_attention(q, k, v, dropout_p=0.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+    def test_mean_preserved(self):
+        # E[dropout(P)] = P: averaging over seeds approaches no-dropout
+        q, k, v = _rand_qkv(b=1, h=2, s=64, d=16)
+        ref = np.asarray(ops.flash_attention(q, k, v, dropout_p=0.0))
+        acc = np.zeros_like(ref)
+        n = 24
+        for s in range(n):
+            acc += np.asarray(ops.flash_attention(q, k, v, dropout_p=0.3,
+                                                  seed=s))
+        err = np.abs(acc / n - ref).max() / np.abs(ref).max()
+        assert err < 0.25, err
+
+    def test_grad_matches_numeric_with_fixed_seed(self):
+        # mask is deterministic given seed, so finite differences are valid
+        r = np.random.RandomState(0)
+        q, k, v = _rand_qkv(b=1, h=1, s=16, d=8)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(ops.flash_attention(q_, k_, v_, causal=True,
+                                               dropout_p=0.4, seed=7) ** 2)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        eps = 1e-3
+        for argi, g in enumerate(grads):
+            g = np.asarray(g)
+            for _ in range(4):   # spot-check 4 random coordinates
+                idx = tuple(r.randint(0, s) for s in g.shape)
+                args_hi = [np.array(a) for a in (q, k, v)]
+                args_lo = [np.array(a) for a in (q, k, v)]
+                args_hi[argi][idx] += eps
+                args_lo[argi][idx] -= eps
+                num = (float(loss(*map(jnp.asarray, args_hi)))
+                       - float(loss(*map(jnp.asarray, args_lo)))) / (2 * eps)
+                np.testing.assert_allclose(g[idx], num, rtol=2e-2,
+                                           atol=2e-3)
+
+    def test_dropout_stays_on_fused_path(self, monkeypatch):
+        # dropout>0 must NOT fall back to the XLA path anymore
+        import importlib
+        fa = importlib.import_module("paddle_tpu.ops.flash_attention")
+        calls = []
+        orig = fa._flash_fwd
+
+        def spy(*args, **kw):
+            calls.append(1)
+            return orig(*args, **kw)
+
+        monkeypatch.setattr(fa, "_flash_fwd", spy)
+        q, k, v = _rand_qkv(b=1, h=1, s=128, d=16)
+        out = ops.flash_attention(q, k, v, dropout_p=0.2, seed=3)
+        assert calls, "dropout>0 fell off the fused kernel path"
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_jitted_steps_vary_mask_via_key_scope(self):
+        # under key_scope the auto-drawn seed is traced, not a constant
+        import paddle_tpu as pt
+        q, k, v = _rand_qkv(b=1, h=1, s=64, d=16)
+
+        @jax.jit
+        def step(key, q_, k_, v_):
+            with pt.key_scope(key):
+                return ops.flash_attention(q_, k_, v_, dropout_p=0.3)
+
+        o1 = step(jax.random.key(1), q, k, v)
+        o2 = step(jax.random.key(2), q, k, v)
+        assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+class TestFlashRagged:
+    """Auto-padding for non-block-multiple sequence lengths."""
+
+    @pytest.mark.parametrize("sq,sk", [(100, 100), (37, 37), (60, 200),
+                                       (130, 130)])
+    def test_ragged_matches_xla(self, sq, sk):
+        r = np.random.RandomState(1)
+        q = jnp.asarray(r.randn(1, 2, sq, 16) * 0.5, jnp.float32)
+        k = jnp.asarray(r.randn(1, 2, sk, 16) * 0.5, jnp.float32)
+        v = jnp.asarray(r.randn(1, 2, sk, 16) * 0.5, jnp.float32)
+        for causal in (True, False):
+            out = ops.flash_attention(q, k, v, causal=causal)
+            ref = _sdpa_ref(q, k, v, causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_ragged_grads(self):
+        r = np.random.RandomState(2)
+        q = jnp.asarray(r.randn(1, 1, 50, 8) * 0.5, jnp.float32)
+        k = jnp.asarray(r.randn(1, 1, 70, 8) * 0.5, jnp.float32)
+        v = jnp.asarray(r.randn(1, 1, 70, 8) * 0.5, jnp.float32)
+
+        def f_flash(q_, k_, v_):
+            return jnp.sum(ops.flash_attention(q_, k_, v_, causal=True) ** 2)
+
+        def f_ref(q_, k_, v_):
+            return jnp.sum(_sdpa_ref(q_, k_, v_, True) ** 2)
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+
+class TestFlashKVCache:
+    """Decode kernel vs full attention over the cache prefix (reference
+    CacheKV, fused_attention_op.cc:235)."""
+
+    def test_matches_prefix_attention(self):
+        r = np.random.RandomState(3)
+        smax, used = 128, 77
+        q = jnp.asarray(r.randn(2, 2, 1, 16) * 0.5, jnp.float32)
+        kc = jnp.asarray(r.randn(2, 2, smax, 16) * 0.5, jnp.float32)
+        vc = jnp.asarray(r.randn(2, 2, smax, 16) * 0.5, jnp.float32)
+        out = ops.flash_attention_kvcache(q, kc, vc, used)
+        ref = _sdpa_ref(q, kc[:, :, :used], vc[:, :, :used], causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_traced_seqlen_one_program(self):
+        # one compiled program serves every decode position
+        r = np.random.RandomState(4)
+        q = jnp.asarray(r.randn(1, 2, 1, 16), jnp.float32)
+        kc = jnp.asarray(r.randn(1, 2, 64, 16), jnp.float32)
+        vc = jnp.asarray(r.randn(1, 2, 64, 16), jnp.float32)
+
+        @jax.jit
+        def step(qq, ln):
+            return ops.flash_attention_kvcache(qq, kc, vc, ln)
+
+        for used in (8, 23, 64):
+            out = step(q, jnp.asarray(used, jnp.int32))
+            ref = _sdpa_ref(q, kc[:, :, :used], vc[:, :, :used], False)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
