@@ -17,7 +17,11 @@ from jax.scipy.special import betaln, digamma, gammaln
 from ..framework import random as fw_random
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
-           "Beta", "Dirichlet", "kl_divergence"]
+           "Beta", "Dirichlet", "Multinomial", "Independent",
+           "TransformedDistribution", "kl_divergence", "register_kl",
+           "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "AbsTransform",
+           "ChainTransform"]
 
 
 def _key(key):
@@ -215,9 +219,298 @@ class Dirichlet(Distribution):
                 - jnp.sum((c - 1) * digamma(c), -1))
 
 
+class Multinomial(Distribution):
+    """Reference distribution/multinomial.py: counts over k categories
+    from ``total_count`` draws."""
+
+    def __init__(self, total_count: int, probs):
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self.probs = p / jnp.sum(p, axis=-1, keepdims=True)
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        logits = jnp.log(jnp.clip(self.probs, 1e-30))
+        draws = jax.random.categorical(
+            _key(key), logits,
+            shape=(self.total_count,) + tuple(shape)
+            + self.probs.shape[:-1])
+        onehot = jax.nn.one_hot(draws, self.probs.shape[-1])
+        return jnp.sum(onehot, axis=0)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return (gammaln(self.total_count + 1.0)
+                - jnp.sum(gammaln(v + 1.0), -1)
+                + jnp.sum(v * jnp.log(jnp.clip(self.probs, 1e-30)), -1))
+
+    def entropy(self):
+        # exact series: H = -log n! - n Σ p_i log p_i
+        #                   + Σ_i Σ_{x=0}^{n} Binom(n, x, p_i) log x!
+        n = self.total_count
+        p = self.probs
+        x = jnp.arange(n + 1, dtype=jnp.float32)
+        log_binom = (gammaln(n + 1.0) - gammaln(x + 1.0)
+                     - gammaln(n - x + 1.0))
+        logp = jnp.log(jnp.clip(p, 1e-30))
+        log1mp = jnp.log(jnp.clip(1.0 - p, 1e-30))
+        # (..., k, n+1) pmf of each marginal count
+        pmf = jnp.exp(log_binom + x * logp[..., None]
+                      + (n - x) * log1mp[..., None])
+        e_logfact = jnp.sum(pmf * gammaln(x + 1.0), axis=-1)
+        return (-gammaln(n + 1.0) - n * jnp.sum(p * logp, -1)
+                + jnp.sum(e_logfact, -1))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims as event dims (reference
+    distribution/independent.py): log_prob sums over them."""
+
+    def __init__(self, base: Distribution,
+                 reinterpreted_batch_ndims: int = 1):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key)
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.reinterpreted_batch_ndims, 0))
+        return jnp.sum(lp, axis=axes)
+
+    def entropy(self):
+        e = self.base.entropy()
+        axes = tuple(range(-self.reinterpreted_batch_ndims, 0))
+        return jnp.sum(e, axis=axes)
+
+
+# ---------------------------------------------------------------------------
+# Transforms (reference distribution/transform.py) — bijectors with
+# forward/inverse/log-det used by TransformedDistribution
+# ---------------------------------------------------------------------------
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py AffineTransform)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * _arr(x)
+
+    def inverse(self, y):
+        return (_arr(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(_arr(x))
+
+    def inverse(self, y):
+        return jnp.log(_arr(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _arr(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def forward(self, x):
+        return jnp.power(_arr(x), self.power)
+
+    def inverse(self, y):
+        return jnp.power(_arr(y), 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        x = _arr(x)
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(_arr(x))
+
+    def inverse(self, y):
+        y = _arr(y)
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        x = _arr(x)
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(_arr(x))
+
+    def inverse(self, y):
+        return jnp.arctanh(_arr(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _arr(x)
+        # log(1 - tanh^2 x) in a numerically stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return jnp.abs(_arr(x))
+
+    def inverse(self, y):   # principal branch
+        return _arr(y)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(_arr(x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through transforms (reference
+    distribution/transformed_distribution.py): sample = T(base.sample());
+    log_prob(y) = base.log_prob(T^-1(y)) - log|det J_T(T^-1(y))|."""
+
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms))
+
+    def sample(self, shape=(), key=None):
+        return self.transform.forward(self.base.sample(shape, key))
+
+    def rsample(self, shape=(), key=None):
+        return self.transform.forward(self.base.rsample(shape, key))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return (self.base.log_prob(x)
+                - self.transform.forward_log_det_jacobian(x))
+
+
+# ---------------------------------------------------------------------------
+# kl registry (reference distribution/kl.py: register_kl decorator +
+# most-specific dispatch)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a pairwise kl rule (reference kl.py:40)."""
+    def wrap(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return wrap
+
+
 def kl_divergence(p: Distribution, q: Distribution):
-    """Reference distribution/kl.py dispatch."""
+    """Registry dispatch with most-specific match (reference kl.py:26)."""
+    matches = [(pc, qc) for (pc, qc) in _KL_REGISTRY
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if matches:
+        # most-specific: minimal (by subclass ordering) pair
+        def depth(pair):
+            return (len(type(p).__mro__) - type(p).__mro__.index(pair[0]),
+                    len(type(q).__mro__) - type(q).__mro__.index(pair[1]))
+        best = max(matches, key=depth)
+        return _KL_REGISTRY[best](p, q)
     if hasattr(p, "kl_divergence") and type(p) is type(q):
         return p.kl_divergence(q)
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs_, q.probs_
+    return (a * (jnp.log(a) - jnp.log(b))
+            + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return (betaln(a2, b2) - betaln(a1, b1)
+            + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+            + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    c1, c2 = p.concentration, q.concentration
+    s1 = jnp.sum(c1, -1)
+    return (gammaln(s1) - jnp.sum(gammaln(c1), -1)
+            - gammaln(jnp.sum(c2, -1)) + jnp.sum(gammaln(c2), -1)
+            + jnp.sum((c1 - c2) * (digamma(c1) - digamma(s1)[..., None]),
+                      -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    kl = jnp.log(q.high - q.low) - jnp.log(p.high - p.low)
+    contained = (q.low <= p.low) & (p.high <= q.high)
+    return jnp.where(contained, kl, jnp.inf)

@@ -1,14 +1,19 @@
-"""paddle.sparse parity (reference paddle/phi sparse kernels + python
-paddle.sparse API: SparseCooTensor/SparseCsrTensor, SURVEY C6).
+"""paddle.sparse parity (reference python/paddle/sparse — creation +
+layer/activation — and the paddle/phi/kernels/sparse corpus:
+sparse_utils_kernel.h dense↔coo↔csr conversions, activation_kernel.h
+value-wise unaries, matmul/masked-matmul, softmax; SURVEY C6).
 
 TPU-native substrate: jax.experimental.sparse.BCOO — XLA's batched-COO
-format with native lowering of sparse-dense matmul (the phi
-sparse_coo kernels' role).  CSR is represented by converting to BCOO at
+format with native lowering of sparse-dense matmul (the phi sparse_coo
+kernels' role).  CSR is represented by converting to BCOO at
 construction (TPU has no CSR-specific units; the format distinction is an
-API-compat concern, kept via ``.layout``)."""
+API-compat concern, kept via ``.layout``).  Everything stays jittable:
+nse is static, value-wise ops map over ``.data``, and row-wise softmax
+uses segment reductions over the static index set.
+"""
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -16,13 +21,22 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseTensor",
-           "is_sparse", "add", "matmul", "masked_matmul", "relu", "to_dense"]
+from ..framework.errors import enforce
+
+__all__ = [
+    "SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor", "is_sparse",
+    "to_dense", "to_sparse_coo", "to_sparse_csr", "coalesce",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "addmm", "transpose", "softmax",
+    "relu", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "expm1", "neg", "pow", "cast",
+    "nn",
+]
 
 
 class SparseTensor:
     """Thin wrapper over BCOO carrying the paddle surface
-    (indices/values/to_dense/nnz; layout 'coo' or 'csr')."""
+    (indices/values/crows/cols/to_dense/nnz; layout 'coo' or 'csr')."""
 
     def __init__(self, bcoo: jsparse.BCOO, layout: str = "coo"):
         self._bcoo = bcoo
@@ -34,6 +48,10 @@ class SparseTensor:
         return tuple(self._bcoo.shape)
 
     @property
+    def ndim(self):
+        return len(self._bcoo.shape)
+
+    @property
     def dtype(self):
         return self._bcoo.dtype
 
@@ -43,23 +61,61 @@ class SparseTensor:
     def values(self):
         return self._bcoo.data
 
+    def crows(self):
+        """CSR row-pointer view (row-major sorted internally, so it is
+        consistent with cols()/values() regardless of insertion order)."""
+        enforce(self.ndim == 2, "crows() needs a 2-d sparse tensor")
+        rows = _sorted(self._bcoo).indices[:, 0]
+        n = self.shape[0]
+        counts = jnp.zeros((n,), jnp.int32).at[rows].add(1)
+        return jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(counts)])
+
+    def cols(self):
+        enforce(self.ndim == 2, "cols() needs a 2-d sparse tensor")
+        return _sorted(self._bcoo).indices[:, 1]
+
+    def csr_values(self):
+        """Values in the same row-major order as crows()/cols()."""
+        enforce(self.ndim == 2, "csr_values() needs a 2-d sparse tensor")
+        return _sorted(self._bcoo).data
+
     def nnz(self) -> int:
         return int(self._bcoo.nse)
 
     def to_dense(self):
         return self._bcoo.todense()
 
+    def to_sparse_csr(self) -> "SparseTensor":
+        return SparseTensor(_sorted(self._bcoo), layout="csr")
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None
+                      ) -> "SparseTensor":
+        return SparseTensor(self._bcoo, layout="coo")
+
     def bcoo(self) -> jsparse.BCOO:
         return self._bcoo
+
+    def astype(self, dtype):
+        return cast(self, dtype)
 
     def __repr__(self):
         return (f"SparseTensor(layout={self.layout}, shape={self.shape}, "
                 f"nnz={self.nnz()})")
 
 
+def _sorted(b: jsparse.BCOO) -> jsparse.BCOO:
+    """Row-major sorted indices (CSR invariant)."""
+    key = b.indices[:, 0] * b.shape[1] + b.indices[:, 1] \
+        if len(b.shape) == 2 else b.indices[:, 0]
+    order = jnp.argsort(key)
+    return jsparse.BCOO((b.data[order], b.indices[order]), shape=b.shape)
+
+
 def sparse_coo_tensor(indices, values, shape: Sequence[int],
                       dtype=None) -> SparseTensor:
-    """paddle.sparse.sparse_coo_tensor(indices (ndim, nnz), values)."""
+    """paddle.sparse.sparse_coo_tensor(indices (ndim, nnz), values)
+    (reference creation.py:30)."""
     idx = jnp.asarray(indices).T.astype(jnp.int32)   # BCOO: (nnz, ndim)
     vals = jnp.asarray(values, dtype)
     return SparseTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)),
@@ -68,7 +124,8 @@ def sparse_coo_tensor(indices, values, shape: Sequence[int],
 
 def sparse_csr_tensor(crows, cols, values, shape: Sequence[int],
                       dtype=None) -> SparseTensor:
-    """paddle.sparse.sparse_csr_tensor — stored as BCOO internally."""
+    """paddle.sparse.sparse_csr_tensor (reference creation.py:103) —
+    stored as BCOO internally."""
     crows = np.asarray(crows)
     cols = np.asarray(cols)
     rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
@@ -87,11 +144,68 @@ def to_dense(x):
     return x.to_dense() if is_sparse(x) else jnp.asarray(x)
 
 
+def to_sparse_coo(x, sparse_dim: Optional[int] = None) -> SparseTensor:
+    """Dense → COO (phi sparse_utils DenseToSparseCoo); nse is the exact
+    nonzero count, so use outside jit (static shapes)."""
+    x = jnp.asarray(x)
+    nse = int(jnp.sum(x != 0))
+    return SparseTensor(jsparse.BCOO.fromdense(x, nse=nse), layout="coo")
+
+
+def to_sparse_csr(x) -> SparseTensor:
+    """Dense → CSR (phi sparse_utils DenseToSparseCsr)."""
+    t = to_sparse_coo(x)
+    return SparseTensor(_sorted(t.bcoo()), layout="csr")
+
+
+def coalesce(x: SparseTensor) -> SparseTensor:
+    """Merge duplicate indices (phi CoalesceKernel).  nse stays the input's
+    static nse (duplicates merge into padded out-of-range entries), so the
+    op is jit-safe."""
+    b = x.bcoo()
+    return SparseTensor(_sorted(b.sum_duplicates(nse=b.nse)),
+                        layout=x.layout)
+
+
+# ---------------------------------------------------------------------------
+# elementwise sparse∘sparse (phi sparse elementwise kernels): operate on the
+# union pattern via BCOO addition identities
+# ---------------------------------------------------------------------------
 def add(a: SparseTensor, b: SparseTensor) -> SparseTensor:
-    summed = (a.bcoo() + b.bcoo()).sum_duplicates()
-    return SparseTensor(summed, layout=a.layout)
+    merged = a.bcoo() + b.bcoo()
+    return SparseTensor(merged.sum_duplicates(nse=merged.nse),
+                        layout=a.layout)
 
 
+def subtract(a: SparseTensor, b: SparseTensor) -> SparseTensor:
+    bb = b.bcoo()
+    negb = jsparse.BCOO((-bb.data, bb.indices), shape=bb.shape)
+    merged = a.bcoo() + negb
+    return SparseTensor(merged.sum_duplicates(nse=merged.nse),
+                        layout=a.layout)
+
+
+def multiply(a: SparseTensor, b: SparseTensor) -> SparseTensor:
+    """Elementwise product — zero wherever either is zero, so evaluate b
+    densely at a's pattern (keeps a's static nse)."""
+    ab = coalesce(a).bcoo()
+    bd = to_dense(b)
+    vals = ab.data * bd[tuple(ab.indices.T)]
+    return SparseTensor(jsparse.BCOO((vals, ab.indices), shape=ab.shape),
+                        layout=a.layout)
+
+
+def divide(a: SparseTensor, b: SparseTensor) -> SparseTensor:
+    ab = coalesce(a).bcoo()
+    bd = to_dense(b)
+    vals = ab.data / bd[tuple(ab.indices.T)]
+    return SparseTensor(jsparse.BCOO((vals, ab.indices), shape=ab.shape),
+                        layout=a.layout)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
 def matmul(a, b):
     """sparse @ dense (or dense @ sparse) → dense; the phi
     sparse_coo matmul kernel's role, lowered by XLA from BCOO dot."""
@@ -100,6 +214,17 @@ def matmul(a, b):
     if is_sparse(b):
         return jnp.asarray(a) @ b.bcoo()
     return jnp.asarray(a) @ jnp.asarray(b)
+
+
+def mv(a: SparseTensor, x) -> jax.Array:
+    """sparse matrix × dense vector (phi sparse mv kernel)."""
+    return a.bcoo() @ jnp.asarray(x)
+
+
+def addmm(input, x: SparseTensor, y, beta: float = 1.0,
+          alpha: float = 1.0) -> jax.Array:
+    """beta*input + alpha*(x @ y) — reference sparse addmm."""
+    return beta * jnp.asarray(input) + alpha * matmul(x, y)
 
 
 def masked_matmul(a, b, mask: SparseTensor) -> SparseTensor:
@@ -115,8 +240,113 @@ def masked_matmul(a, b, mask: SparseTensor) -> SparseTensor:
                         layout=mask.layout)
 
 
-def relu(x: SparseTensor) -> SparseTensor:
-    """Elementwise on the stored values (reference sparse relu kernel)."""
+def transpose(x: SparseTensor, perm: Optional[Sequence[int]] = None
+              ) -> SparseTensor:
+    enforce(x.ndim == 2, "sparse transpose supports 2-d tensors")
+    if perm is not None:
+        perm = list(perm)
+        enforce(sorted(perm) == [0, 1], f"invalid perm {perm} for 2-d")
+        if perm == [0, 1]:   # identity permutation
+            return x
     b = x.bcoo()
-    return SparseTensor(jsparse.BCOO((jnp.maximum(b.data, 0), b.indices),
-                                     shape=b.shape), layout=x.layout)
+    idx = b.indices[:, ::-1]
+    return SparseTensor(
+        _sorted(jsparse.BCOO((b.data, idx),
+                             shape=(b.shape[1], b.shape[0]))),
+        layout=x.layout)
+
+
+def softmax(x: SparseTensor, axis: int = -1) -> SparseTensor:
+    """Row-wise softmax over the stored values only (phi sparse softmax:
+    implicit zeros are NOT part of the distribution)."""
+    enforce(x.ndim == 2 and axis in (-1, 1),
+            "sparse softmax: 2-d, last axis")
+    b = coalesce(x).bcoo()
+    rows = b.indices[:, 0]
+    n = x.shape[0]
+    m = jax.ops.segment_max(b.data, rows, num_segments=n)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(b.data - m[rows])
+    z = jax.ops.segment_sum(e, rows, num_segments=n)
+    vals = e / jnp.maximum(z[rows], 1e-30)
+    return SparseTensor(jsparse.BCOO((vals, b.indices), shape=b.shape),
+                        layout=x.layout)
+
+
+# ---------------------------------------------------------------------------
+# value-wise unaries (phi sparse activation_kernel.h family): act on stored
+# values, pattern unchanged — valid exactly for f(0)=0 functions, the same
+# set the reference registers
+# ---------------------------------------------------------------------------
+def _valuewise(name: str, fn: Callable) -> Callable:
+    def op(x: SparseTensor, *args) -> SparseTensor:
+        b = x.bcoo()
+        return SparseTensor(
+            jsparse.BCOO((fn(b.data, *args), b.indices), shape=b.shape),
+            layout=x.layout)
+    op.__name__ = name
+    op.__doc__ = f"sparse.{name}: value-wise (pattern preserved)."
+    return op
+
+
+relu = _valuewise("relu", lambda v: jnp.maximum(v, 0))
+sin = _valuewise("sin", jnp.sin)
+tan = _valuewise("tan", jnp.tan)
+asin = _valuewise("asin", jnp.arcsin)
+atan = _valuewise("atan", jnp.arctan)
+sinh = _valuewise("sinh", jnp.sinh)
+tanh = _valuewise("tanh", jnp.tanh)
+asinh = _valuewise("asinh", jnp.arcsinh)
+atanh = _valuewise("atanh", jnp.arctanh)
+sqrt = _valuewise("sqrt", jnp.sqrt)
+square = _valuewise("square", jnp.square)
+log1p = _valuewise("log1p", jnp.log1p)
+abs = _valuewise("abs", jnp.abs)
+expm1 = _valuewise("expm1", jnp.expm1)
+neg = _valuewise("neg", jnp.negative)
+pow = _valuewise("pow", lambda v, p: jnp.power(v, p))
+cast = _valuewise("cast", lambda v, dt: v.astype(dt))
+
+
+# ---------------------------------------------------------------------------
+# sparse.nn (reference layer/activation.py ReLU + the attention built from
+# subsystem ops: SDDMM → sparse softmax → SpMM)
+# ---------------------------------------------------------------------------
+class _SparseNNFunctional:
+    @staticmethod
+    def relu(x: SparseTensor) -> SparseTensor:
+        return relu(x)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask: SparseTensor,
+                  scale: Optional[float] = None) -> jax.Array:
+        """Single-head sparse attention from subsystem primitives:
+        scores = masked_matmul(q, k^T) at the mask pattern, row softmax
+        over stored entries, then sparse @ v.  The batched CSR entry
+        point is nn.functional.sparse_attention."""
+        q = jnp.asarray(query)
+        k = jnp.asarray(key)
+        if scale is None:
+            scale = q.shape[-1] ** -0.5
+        s = masked_matmul(q * scale, k.T, sparse_mask)
+        p = softmax(s)
+        return matmul(p, jnp.asarray(value))
+
+
+class _ReLULayer:
+    """paddle.sparse.ReLU (reference layer/activation.py:22)."""
+
+    def __call__(self, x: SparseTensor) -> SparseTensor:
+        return relu(x)
+
+    def forward(self, x: SparseTensor) -> SparseTensor:
+        return relu(x)
+
+
+class _SparseNN:
+    ReLU = _ReLULayer
+    functional = _SparseNNFunctional
+
+
+nn = _SparseNN
+ReLU = _ReLULayer

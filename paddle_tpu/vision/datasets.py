@@ -13,7 +13,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "ImageFolder", "DatasetFolder"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "ImageFolder", "DatasetFolder"]
 
 
 def _load_idx_images(path: str) -> np.ndarray:
@@ -102,6 +103,101 @@ class Cifar10(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = False,
+                 synthetic_size: Optional[int] = None):
+        self.transform = transform
+        n = synthetic_size or (2048 if mode == "train" else 256)
+        self.images, self.labels = _synthetic_classes(
+            n, seed=19 if mode == "train" else 23, shape=(32, 32, 3),
+            proto_seed=8765, noise=0.25, num_classes=100)
+
+
+class Flowers(Dataset):
+    """paddle.vision.datasets.Flowers analog (reference
+    python/paddle/vision/datasets/flowers.py:43): 102-category flower
+    classification with train/valid/test splits.  Zero-egress default:
+    deterministic learnable synthetic classes (shared prototypes across
+    splits, split-specific noise)."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file: Optional[str] = None,
+                 label_file: Optional[str] = None,
+                 setid_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "cv2",
+                 synthetic_size: Optional[int] = None):
+        assert mode in ("train", "valid", "test"), mode
+        self.transform = transform
+        self.mode = mode
+        n = synthetic_size or {"train": 1024, "valid": 128,
+                               "test": 256}[mode]
+        seed = {"train": 29, "valid": 31, "test": 37}[mode]
+        self.images, self.labels = _synthetic_classes(
+            n, seed=seed, shape=(64, 64, 3), proto_seed=10246,
+            noise=0.25, num_classes=self.NUM_CLASSES)
+        self.labels = self.labels + 1   # reference labels are 1-based
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """paddle.vision.datasets.VOC2012 analog (reference
+    python/paddle/vision/datasets/voc2012.py:40): segmentation pairs
+    (image, per-pixel label mask over 21 classes).  Zero-egress default:
+    each sample places a class-colored rectangle on a noise background
+    with the exactly-matching mask — learnable by a small conv net."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "cv2",
+                 synthetic_size: Optional[int] = None, image_hw=(64, 64)):
+        assert mode in ("train", "valid", "test"), mode
+        self.transform = transform
+        self.mode = mode
+        n = synthetic_size or {"train": 512, "valid": 64, "test": 128}[mode]
+        rng = np.random.RandomState({"train": 41, "valid": 43,
+                                     "test": 47}[mode])
+        colors = np.random.RandomState(20127).rand(
+            self.NUM_CLASSES, 3).astype(np.float32)
+        H, W = image_hw
+        imgs = rng.rand(n, H, W, 3).astype(np.float32) * 0.3
+        masks = np.zeros((n, H, W), np.int64)
+        for i in range(n):
+            cls = rng.randint(1, self.NUM_CLASSES)
+            h0, w0 = rng.randint(0, H // 2), rng.randint(0, W // 2)
+            h1 = h0 + rng.randint(H // 4, H // 2)
+            w1 = w0 + rng.randint(W // 4, W // 2)
+            imgs[i, h0:h1, w0:w1] = (
+                colors[cls] + 0.1 * rng.randn(h1 - h0, w1 - w0, 3)
+            ).clip(0, 1)
+            masks[i, h0:h1, w0:w1] = cls
+        self.images = (imgs * 255).astype(np.uint8)
+        self.masks = masks
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
 
     def __len__(self):
         return len(self.images)

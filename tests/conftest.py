@@ -21,3 +21,27 @@ def _seed_everything():
     pt.seed(1234)
     np.random.seed(1234)
     yield
+
+
+# -- quick tier: `pytest -m quick` runs a <90s cross-section of the suite
+# (one file per doctrine row; see tests/README.md for recorded timings)
+_QUICK_MODULES = {
+    "test_auto_parallel",          # sharding annotations
+    "test_fleet_strategy",         # strategy-driven composition
+    "test_distribution_extended",  # distributions + datasets
+    "test_checkpoint",             # save/load/reshard
+    "test_optimizer",              # optimizer family
+    "test_launch_multihost",       # 2-process cluster proof
+    "test_api_spec",               # API drift guard
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: fast cross-section tier (<90s; see README.md)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _QUICK_MODULES:
+            item.add_marker(pytest.mark.quick)

@@ -1,0 +1,238 @@
+"""paddle.sparse subsystem: OpTest-style parity vs scipy.sparse
+(reference phi/kernels/sparse corpus + python/paddle/sparse API)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as psp
+
+R = np.random.RandomState(0)
+
+
+def _rand_csr(m=6, n=5, density=0.4, seed=1):
+    rs = np.random.RandomState(seed)
+    return sp.random(m, n, density=density, format="csr",
+                     random_state=rs, dtype=np.float32)
+
+
+def _to_pt_coo(s):
+    coo = s.tocoo()
+    return psp.sparse_coo_tensor(
+        np.stack([coo.row, coo.col]), coo.data, coo.shape)
+
+
+class TestCreationAndConversion:
+    def test_coo_roundtrip(self):
+        s = _rand_csr()
+        t = _to_pt_coo(s)
+        np.testing.assert_allclose(np.asarray(t.to_dense()), s.toarray())
+        assert t.nnz() == s.nnz
+
+    def test_csr_roundtrip(self):
+        s = _rand_csr()
+        t = psp.sparse_csr_tensor(s.indptr, s.indices, s.data, s.shape)
+        assert t.layout == "csr"
+        np.testing.assert_allclose(np.asarray(t.to_dense()), s.toarray())
+        np.testing.assert_array_equal(np.asarray(t.crows()), s.indptr)
+        np.testing.assert_array_equal(np.asarray(t.cols()), s.indices)
+
+    def test_dense_to_sparse_and_back(self):
+        d = s = _rand_csr().toarray()
+        t = psp.to_sparse_coo(d)
+        np.testing.assert_allclose(np.asarray(t.to_dense()), d)
+        tc = psp.to_sparse_csr(d)
+        assert tc.layout == "csr"
+        np.testing.assert_allclose(np.asarray(tc.to_dense()), s)
+
+    def test_csr_view_consistent_for_unsorted_coo(self):
+        # insertion order (1,0) then (0,1): crows/cols/csr_values must
+        # decode to the SAME matrix, not a silently-permuted one
+        t = psp.sparse_coo_tensor([[1, 0], [0, 1]], [5.0, 7.0], (2, 2))
+        import scipy.sparse as sp2
+        rebuilt = sp2.csr_matrix(
+            (np.asarray(t.csr_values()), np.asarray(t.cols()),
+             np.asarray(t.crows())), shape=(2, 2)).toarray()
+        np.testing.assert_allclose(rebuilt, np.asarray(t.to_dense()))
+
+    def test_empty_dense_has_zero_nnz(self):
+        t = psp.to_sparse_coo(np.zeros((4, 4), np.float32))
+        assert t.nnz() == 0
+        np.testing.assert_allclose(np.asarray(t.to_dense()), 0.0)
+
+    def test_softmax_jittable(self):
+        import jax as _jax
+        s = _rand_csr(5, 6, density=0.5, seed=12)
+        t = _to_pt_coo(s)
+
+        @_jax.jit
+        def f(vals):
+            tt = psp.SparseTensor(
+                psp.jsparse.BCOO((vals, t.bcoo().indices),
+                                 shape=t.shape))
+            return psp.softmax(tt).to_dense()
+
+        out = f(t.values())
+        ref = psp.softmax(t).to_dense()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+
+    def test_coalesce_merges_duplicates(self):
+        t = psp.sparse_coo_tensor([[0, 0, 1], [1, 1, 2]],
+                                  [1.0, 2.0, 3.0], (2, 3))
+        c = psp.coalesce(t)
+        dense = np.zeros((2, 3), np.float32)
+        dense[0, 1] = 3.0
+        dense[1, 2] = 3.0
+        np.testing.assert_allclose(np.asarray(c.to_dense()), dense)
+
+
+class TestElementwise:
+    def test_add_subtract(self):
+        a, b = _rand_csr(seed=1), _rand_csr(seed=2)
+        ta, tb = _to_pt_coo(a), _to_pt_coo(b)
+        np.testing.assert_allclose(
+            np.asarray(psp.add(ta, tb).to_dense()), (a + b).toarray(),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(psp.subtract(ta, tb).to_dense()),
+            (a - b).toarray(), rtol=1e-6)
+
+    def test_multiply_divide(self):
+        a, b = _rand_csr(seed=3), _rand_csr(seed=4)
+        b.data += 2.0   # keep divisors away from zero on a's pattern
+        ta, tb = _to_pt_coo(a), _to_pt_coo(b)
+        np.testing.assert_allclose(
+            np.asarray(psp.multiply(ta, tb).to_dense()),
+            a.multiply(b).toarray(), rtol=1e-6)
+        got = np.asarray(psp.divide(ta, tb).to_dense())
+        bd = b.toarray()
+        want = np.where(a.toarray() != 0,
+                        np.divide(a.toarray(), np.where(bd == 0, 1.0, bd)),
+                        0.0)
+        # only positions where b is nonzero are comparable (else inf/nan)
+        m = (a.toarray() != 0) & (bd != 0)
+        np.testing.assert_allclose(got[m], want[m], rtol=1e-5)
+
+    @pytest.mark.parametrize("name", ["relu", "sin", "tanh", "sqrt",
+                                      "square", "log1p", "abs", "expm1",
+                                      "neg"])
+    def test_valuewise_unaries(self, name):
+        s = _rand_csr(seed=5)
+        t = _to_pt_coo(s)
+        np_ref = {"relu": lambda v: np.maximum(v, 0), "sin": np.sin,
+                  "tanh": np.tanh, "sqrt": np.sqrt, "square": np.square,
+                  "log1p": np.log1p, "abs": np.abs, "expm1": np.expm1,
+                  "neg": np.negative}[name]
+        out = getattr(psp, name)(t)
+        want = s.toarray().copy()
+        want[want != 0] = np_ref(want[want != 0])
+        np.testing.assert_allclose(np.asarray(out.to_dense()), want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestLinalg:
+    def test_matmul_vs_scipy(self):
+        s = _rand_csr(6, 5, seed=6)
+        d = R.randn(5, 4).astype(np.float32)
+        t = _to_pt_coo(s)
+        np.testing.assert_allclose(np.asarray(psp.matmul(t, d)), s @ d,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mv_addmm(self):
+        s = _rand_csr(6, 5, seed=7)
+        t = _to_pt_coo(s)
+        x = R.randn(5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(psp.mv(t, x)), s @ x,
+                                   rtol=1e-5, atol=1e-5)
+        inp = R.randn(6, 4).astype(np.float32)
+        y = R.randn(5, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(psp.addmm(inp, t, y, beta=0.5, alpha=2.0)),
+            0.5 * inp + 2.0 * (s @ y), rtol=1e-5, atol=1e-5)
+
+    def test_transpose(self):
+        s = _rand_csr(6, 5, seed=8)
+        t = psp.transpose(_to_pt_coo(s))
+        np.testing.assert_allclose(np.asarray(t.to_dense()),
+                                   s.T.toarray())
+        # explicit perms are honored, including identity
+        same = psp.transpose(_to_pt_coo(s), perm=[0, 1])
+        np.testing.assert_allclose(np.asarray(same.to_dense()),
+                                   s.toarray())
+        tt = psp.transpose(_to_pt_coo(s), perm=[1, 0])
+        np.testing.assert_allclose(np.asarray(tt.to_dense()),
+                                   s.T.toarray())
+
+    def test_masked_matmul_sddmm(self):
+        a = R.randn(6, 8).astype(np.float32)
+        b = R.randn(8, 5).astype(np.float32)
+        mask = _to_pt_coo(_rand_csr(6, 5, seed=9))
+        out = psp.masked_matmul(a, b, mask)
+        full = a @ b
+        want = np.where(np.asarray(mask.to_dense()) != 0, full, 0.0)
+        np.testing.assert_allclose(np.asarray(out.to_dense()), want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_softmax_rowwise_over_stored(self):
+        s = _rand_csr(5, 6, density=0.5, seed=10)
+        t = _to_pt_coo(s)
+        out = np.asarray(psp.softmax(t).to_dense())
+        d = s.toarray()
+        for i in range(d.shape[0]):
+            nz = d[i] != 0
+            if nz.sum() == 0:
+                continue
+            e = np.exp(d[i][nz] - d[i][nz].max())
+            np.testing.assert_allclose(out[i][nz], e / e.sum(), rtol=1e-5)
+            assert np.all(out[i][~nz] == 0)
+
+
+class TestSparseNN:
+    def test_relu_layer(self):
+        s = _rand_csr(seed=11)
+        layer = psp.nn.ReLU()
+        out = layer(_to_pt_coo(s))
+        np.testing.assert_allclose(np.asarray(out.to_dense()),
+                                   np.maximum(s.toarray(), 0))
+
+    def test_attention_matches_csr_entry_point(self):
+        """sparse.nn.functional.attention (subsystem primitives) must agree
+        with nn.functional.sparse_attention (batched CSR entry point)."""
+        import paddle_tpu.nn.functional as F
+        S, D = 8, 4
+        q = R.randn(S, D).astype(np.float32)
+        k = R.randn(S, D).astype(np.float32)
+        v = R.randn(S, D).astype(np.float32)
+        # lower-triangular pattern
+        rows, cols = np.tril_indices(S)
+        mask = psp.sparse_coo_tensor(np.stack([rows, cols]),
+                                     np.ones(len(rows), np.float32),
+                                     (S, S))
+        out = psp.nn.functional.attention(q, k, v, mask)
+        # CSR form of the same pattern for the batched entry point
+        crows = np.concatenate([[0], np.cumsum(np.arange(1, S + 1))])
+        ccols = np.concatenate([np.arange(i + 1) for i in range(S)])
+        ref = F.sparse_attention(
+            jnp.asarray(q)[None, None], jnp.asarray(k)[None, None],
+            jnp.asarray(v)[None, None],
+            jnp.asarray(crows, jnp.int32)[None, None],
+            jnp.asarray(ccols, jnp.int32)[None, None])
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref)[0, 0], rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_attention_grads_flow(self):
+        S, D = 8, 4
+        q = jnp.asarray(R.randn(S, D), jnp.float32)
+        k = jnp.asarray(R.randn(S, D), jnp.float32)
+        v = jnp.asarray(R.randn(S, D), jnp.float32)
+        rows, cols = np.tril_indices(S)
+        mask = psp.sparse_coo_tensor(np.stack([rows, cols]),
+                                     np.ones(len(rows), np.float32),
+                                     (S, S))
+        g = jax.grad(lambda q_: jnp.sum(
+            psp.nn.functional.attention(q_, k, v, mask) ** 2))(q)
+        assert g.shape == q.shape and bool(jnp.isfinite(g).all())
