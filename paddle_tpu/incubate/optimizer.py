@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from ..framework.errors import enforce
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "DistributedFusedLamb"]
 
 
 class LookAhead:
@@ -110,3 +110,190 @@ class ModelAverage:
         return jax.tree_util.tree_map(
             lambda s, p: (s / eff).astype(jnp.asarray(p).dtype),
             state["sum"], params)
+
+
+class DistributedFusedLamb:
+    """Sharded fused LAMB (reference incubate/optimizer/
+    distributed_fused_lamb.py:27 + the fused CUDA op
+    operators/optimizers/distributed_fused_lamb_op.cu).
+
+    TPU-native design: every parameter is flattened into ONE fp32 master
+    buffer (the multi-tensor-apply analog — a single vectorized update
+    chain instead of a per-tensor op zoo), with static segment ids giving
+    each parameter its own LAMB trust ratio via segment reductions.  The
+    flat master/moment buffers are sharded over the dp/sharding mesh axis
+    (the reference's nproc-way state partition, here a NamedSharding that
+    GSPMD turns into a reduce-scattered update + all-gather), padded to
+    the axis size.  Supports ClipGradByGlobalNorm semantics
+    (max_global_grad_norm), exclude_from_weight_decay_fn, and a
+    found_inf-style skip via ``set_scale`` + nonfinite detection.
+    """
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce: bool = True,
+                 is_grad_scaled_by_nranks: bool = True,
+                 alignment: int = 128,
+                 use_master_param_norm: bool = True):
+        self._lr = learning_rate
+        self._wd = float(lamb_weight_decay or 0.0)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._exclude = exclude_from_weight_decay_fn
+        if grad_clip is not None:
+            from ..optimizer import ClipGradByGlobalNorm
+            enforce(isinstance(grad_clip, ClipGradByGlobalNorm),
+                    "Only ClipGradByGlobalNorm is supported in "
+                    "DistributedFusedLamb")
+            self._max_gnorm = float(grad_clip.clip_norm)
+        else:
+            self._max_gnorm = -1.0
+        self._alignment = int(alignment)
+        self._scale = None
+
+    def set_scale(self, scale):
+        """AMP hook (reference _set_scale): grads are divided by ``scale``
+        and the step is skipped when any grad is nonfinite."""
+        self._scale = scale
+
+    # -- flat layout --------------------------------------------------------
+    def _shard_axis(self):
+        from ..distributed.topology import get_mesh
+        mesh = get_mesh()
+        if mesh is None:
+            return None, 1
+        axis = "sharding" if "sharding" in mesh.axis_names else (
+            "dp" if "dp" in mesh.axis_names else None)
+        return (axis, mesh.shape[axis]) if axis else (None, 1)
+
+    def _layout(self, params):
+        """Static flat layout, cached per (treedef, shapes) — rebuilding
+        the O(N) segment-id array every step would dominate for the
+        1.3B-scale models this optimizer targets."""
+        import math
+        import numpy as np
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        shapes = tuple(tuple(jnp.shape(p)) for p in flat)
+        key = (treedef, shapes)
+        cached = getattr(self, "_layout_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        offsets = [0]
+        for s in sizes:
+            offsets.append(offsets[-1] + s)
+        total = offsets[-1]
+        # pad so the flat buffers divide BOTH the alignment and the mesh
+        # sharding axis (else _shard would silently replicate)
+        _, axis_n = self._shard_axis()
+        mult = math.lcm(max(self._alignment, 1), axis_n)
+        pad = (-total) % mult
+        seg = np.empty(total + pad, np.int32)
+        for i, (o, s) in enumerate(zip(offsets[:-1], sizes)):
+            seg[o:o + s] = i
+        seg[total:] = len(sizes)              # padding segment
+        out = (treedef, flat, sizes, offsets, total, pad, jnp.asarray(seg))
+        self._layout_cache = (key, out)
+        return out
+
+    def _flatten(self, tree, total, pad):
+        flat = jax.tree_util.tree_leaves(tree)
+        vec = jnp.concatenate(
+            [jnp.ravel(jnp.asarray(x)).astype(jnp.float32) for x in flat])
+        return jnp.pad(vec, (0, pad))
+
+    def _shard(self, vec):
+        from ..distributed.topology import get_mesh
+        axis, axis_n = self._shard_axis()
+        if axis is None:
+            return vec
+        enforce(vec.shape[0] % axis_n == 0,
+                f"flat buffer {vec.shape[0]} not divisible by mesh axis "
+                f"{axis}={axis_n} (layout padding bug)")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(vec, NamedSharding(get_mesh(), P(axis)))
+
+    def init(self, params):
+        treedef, flat, sizes, offsets, total, pad, seg = self._layout(params)
+        master = self._shard(self._flatten(params, total, pad))
+        zeros = self._shard(jnp.zeros_like(master))
+        return {"master": master, "moment1": zeros, "moment2": zeros,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, grads, params, state, lr=None):
+        treedef, flat_p, sizes, offsets, total, pad, seg = \
+            self._layout(params)
+        nseg = len(sizes)
+        g = self._flatten(grads, total, pad)
+        found_inf = ~jnp.all(jnp.isfinite(g))
+        if self._scale is not None:
+            g = g / jnp.asarray(self._scale, jnp.float32)
+        if self._max_gnorm > 0:
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            g = g * jnp.minimum(1.0, self._max_gnorm
+                                / jnp.maximum(gnorm, 1e-12))
+
+        from ..optimizer import LRScheduler
+        step = state["step"] + 1
+        if lr is not None:
+            lr_t = jnp.asarray(lr, jnp.float32)
+        elif isinstance(self._lr, LRScheduler):
+            lr_t = self._lr(step - 1)
+        else:
+            lr_t = jnp.asarray(self._lr, jnp.float32)
+        m = self._b1 * state["moment1"] + (1 - self._b1) * g
+        v = self._b2 * state["moment2"] + (1 - self._b2) * jnp.square(g)
+        mhat = m / (1 - self._b1 ** step.astype(jnp.float32))
+        vhat = v / (1 - self._b2 ** step.astype(jnp.float32))
+        master = state["master"]
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        # per-parameter weight decay mask (exclude_from_weight_decay_fn
+        # gets the parameter's tree path string, reference semantics)
+        import numpy as np
+        wd_mask = np.ones(nseg + 1, np.float32)
+        wd_mask[nseg] = 0.0
+        if self._exclude is not None:
+            # dotted names ("layers.0.bias"), matching the base
+            # Optimizer's apply_decay_param_fun path convention
+            def _dotted(kp):
+                return ".".join(str(getattr(k, "key",
+                                            getattr(k, "idx", k)))
+                                for k in kp)
+            paths = [_dotted(kp) for kp, _ in
+                     jax.tree_util.tree_flatten_with_path(params)[0]]
+            for i, name in enumerate(paths):
+                if self._exclude(name):
+                    wd_mask[i] = 0.0
+        upd = upd + self._wd * jnp.asarray(wd_mask)[seg] * master
+
+        # LAMB trust ratio per parameter segment (segment reductions are
+        # the fused analog of the reference's per-param norm kernels)
+        pnorm2 = jax.ops.segment_sum(jnp.square(master), seg,
+                                     num_segments=nseg + 1)
+        unorm2 = jax.ops.segment_sum(jnp.square(upd), seg,
+                                     num_segments=nseg + 1)
+        pnorm = jnp.sqrt(pnorm2)
+        unorm = jnp.sqrt(unorm2)
+        ratio = jnp.where((pnorm > 0) & (unorm > 0),
+                          pnorm / jnp.maximum(unorm, 1e-12), 1.0)
+        new_master = master - lr_t * ratio[seg] * upd
+
+        skip = found_inf
+        out = {
+            "master": jnp.where(skip, master, new_master),
+            "moment1": jnp.where(skip, state["moment1"], m),
+            "moment2": jnp.where(skip, state["moment2"], v),
+            "step": jnp.where(skip, state["step"], step),
+        }
+        # unflatten back to the original pytree/dtypes
+        new_flat = []
+        vec = out["master"]
+        for p, o, s in zip(flat_p, offsets[:-1], sizes):
+            seg_vals = jax.lax.dynamic_slice(vec, (o,), (s,))
+            new_flat.append(seg_vals.reshape(jnp.shape(p)).astype(
+                jnp.asarray(p).dtype))
+        return jax.tree_util.tree_unflatten(treedef, new_flat), out
+
+    def update(self, grads, params, state):
+        return self.apply_gradients(grads, params, state)
