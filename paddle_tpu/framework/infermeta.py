@@ -1,0 +1,121 @@
+"""Declarative shape/dtype inference + validation — component C8.
+
+Reference: paddle/phi/infermeta/ (unary.cc/binary.cc/multiary.cc): every op
+declares an InferMeta that validates input metas and derives output metas
+BEFORE the kernel runs, so users get a typed, shaped error instead of a
+kernel fault.
+
+TPU-native role: jax already derives output shapes at trace time, so the
+surviving job is the *validation* half — catch bad call shapes at the
+python boundary and raise paddle-style ``InvalidArgumentError`` with the
+offending shapes in the message (instead of a deep XLA trace).  The
+``@infer_meta`` decorator attaches a rule to an op; rules are composed
+from the small combinator set below, mirroring how the reference composes
+per-op InferMeta functions from shared helpers.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .errors import InvalidArgumentError, enforce
+
+__all__ = ["infer_meta", "Meta", "meta_of", "require_rank",
+           "require_rank_in", "require_dim_match", "require_same_rank",
+           "require_broadcastable", "require_floating", "require_integer"]
+
+
+class Meta:
+    """Shape/dtype view of one argument (the DenseTensorMeta analog)."""
+
+    __slots__ = ("shape", "dtype", "name")
+
+    def __init__(self, shape, dtype, name: str):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"{self.name}: {self.dtype}{list(self.shape)}"
+
+
+def meta_of(x, name: str = "x") -> Optional[Meta]:
+    """Meta for any array-like (Parameter, jax array, numpy, list)."""
+    if x is None:
+        return None
+    if hasattr(x, "__jax_array__"):
+        x = x.__jax_array__()
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return Meta(x.shape, x.dtype, name)
+    arr = np.asarray(x)
+    return Meta(arr.shape, arr.dtype, name)
+
+
+# -- composable checks (≙ phi/infermeta shared helpers) ---------------------
+def require_rank(m: Meta, rank: int, op: str) -> None:
+    enforce(m.ndim == rank,
+            f"{op}: {m.name} must be {rank}-D, got {m}",
+            exc=InvalidArgumentError)
+
+
+def require_rank_in(m: Meta, ranks: Sequence[int], op: str) -> None:
+    enforce(m.ndim in tuple(ranks),
+            f"{op}: {m.name} must have rank in {list(ranks)}, got {m}",
+            exc=InvalidArgumentError)
+
+
+def require_dim_match(a: Meta, da: int, b: Meta, db: int, op: str) -> None:
+    enforce(a.shape[da] == b.shape[db],
+            f"{op}: dim {da} of {a} must match dim {db} of {b}",
+            exc=InvalidArgumentError)
+
+
+def require_same_rank(a: Meta, b: Meta, op: str) -> None:
+    enforce(a.ndim == b.ndim,
+            f"{op}: rank mismatch between {a} and {b}",
+            exc=InvalidArgumentError)
+
+
+def require_broadcastable(a: Meta, b: Meta, op: str) -> None:
+    try:
+        np.broadcast_shapes(a.shape, b.shape)
+    except ValueError:
+        raise InvalidArgumentError(
+            f"{op}: shapes not broadcastable: {a} vs {b}")
+
+
+def require_floating(m: Meta, op: str) -> None:
+    kind = np.dtype(str(m.dtype)).kind if not str(m.dtype).startswith(
+        "bfloat16") else "f"
+    enforce(kind == "f" or "float" in str(m.dtype) or "bf16" in str(m.dtype),
+            f"{op}: {m.name} must be floating, got {m}",
+            exc=InvalidArgumentError)
+
+
+def require_integer(m: Meta, op: str) -> None:
+    enforce(np.dtype(str(m.dtype)).kind in ("i", "u"),
+            f"{op}: {m.name} must be integer, got {m}",
+            exc=InvalidArgumentError)
+
+
+def infer_meta(rule: Callable) -> Callable:
+    """Attach a validation rule to an op: ``rule`` receives the op's
+    positional/keyword arguments (arrays and attrs alike) and raises
+    ``InvalidArgumentError`` on bad metas; the op body runs unchanged
+    afterwards.  ``fn.__infermeta__`` exposes the rule (the analog of the
+    registry linkage api.yaml ``infer_meta:`` entries give the reference).
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            rule(*args, **kwargs)
+            return fn(*args, **kwargs)
+        wrapped.__infermeta__ = rule
+        return wrapped
+    return deco

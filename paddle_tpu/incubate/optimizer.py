@@ -141,6 +141,20 @@ class DistributedFusedLamb:
         self._wd = float(lamb_weight_decay or 0.0)
         self._b1, self._b2, self._eps = beta1, beta2, epsilon
         self._exclude = exclude_from_weight_decay_fn
+        # clip on the globally-reduced gradient and fp32-master param
+        # norms are what the GSPMD formulation computes BY CONSTRUCTION;
+        # the opposite settings cannot be honored, so reject them loudly
+        enforce(clip_after_allreduce,
+                "clip_after_allreduce=False is not supported: under GSPMD "
+                "the gradient is globally reduced before any optimizer "
+                "math runs")
+        enforce(use_master_param_norm,
+                "use_master_param_norm=False is not supported: trust "
+                "ratios are computed on the fp32 master buffer")
+        self._grad_scaled_by_nranks = bool(is_grad_scaled_by_nranks)
+        self._parameters = list(parameters) if parameters is not None \
+            else None
+        self._state = None
         if grad_clip is not None:
             from ..optimizer import ClipGradByGlobalNorm
             enforce(isinstance(grad_clip, ClipGradByGlobalNorm),
@@ -168,14 +182,18 @@ class DistributedFusedLamb:
         return (axis, mesh.shape[axis]) if axis else (None, 1)
 
     def _layout(self, params):
-        """Static flat layout, cached per (treedef, shapes) — rebuilding
-        the O(N) segment-id array every step would dominate for the
-        1.3B-scale models this optimizer targets."""
+        """Static flat layout, cached per (treedef, shapes, dtypes) —
+        rebuilding the O(N) segment-id array every step would dominate for
+        the 1.3B-scale models this optimizer targets.  The cache holds
+        only metadata (shapes/dtypes/offsets/seg), never array leaves, so
+        no parameter memory is pinned."""
         import math
         import numpy as np
-        flat, treedef = jax.tree_util.tree_flatten(params)
-        shapes = tuple(tuple(jnp.shape(p)) for p in flat)
-        key = (treedef, shapes)
+        _, treedef = jax.tree_util.tree_flatten(params)
+        leaves = jax.tree_util.tree_leaves(params)
+        shapes = tuple(tuple(jnp.shape(p)) for p in leaves)
+        dtypes = tuple(str(jnp.asarray(p).dtype) for p in leaves)
+        key = (treedef, shapes, dtypes)
         cached = getattr(self, "_layout_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -193,7 +211,8 @@ class DistributedFusedLamb:
         for i, (o, s) in enumerate(zip(offsets[:-1], sizes)):
             seg[o:o + s] = i
         seg[total:] = len(sizes)              # padding segment
-        out = (treedef, flat, sizes, offsets, total, pad, jnp.asarray(seg))
+        out = (treedef, shapes, dtypes, sizes, offsets, total, pad,
+               jnp.asarray(seg))
         self._layout_cache = (key, out)
         return out
 
@@ -215,20 +234,27 @@ class DistributedFusedLamb:
         return jax.device_put(vec, NamedSharding(get_mesh(), P(axis)))
 
     def init(self, params):
-        treedef, flat, sizes, offsets, total, pad, seg = self._layout(params)
+        (treedef, shapes, dtypes, sizes, offsets, total, pad,
+         seg) = self._layout(params)
         master = self._shard(self._flatten(params, total, pad))
         zeros = self._shard(jnp.zeros_like(master))
         return {"master": master, "moment1": zeros, "moment2": zeros,
                 "step": jnp.zeros((), jnp.int32)}
 
     def apply_gradients(self, grads, params, state, lr=None):
-        treedef, flat_p, sizes, offsets, total, pad, seg = \
-            self._layout(params)
+        (treedef, shapes, dtypes, sizes, offsets, total, pad,
+         seg) = self._layout(params)
         nseg = len(sizes)
         g = self._flatten(grads, total, pad)
         found_inf = ~jnp.all(jnp.isfinite(g))
         if self._scale is not None:
             g = g / jnp.asarray(self._scale, jnp.float32)
+        if not self._grad_scaled_by_nranks:
+            # reference semantics: grads arrive SUMMED over ranks and the
+            # optimizer applies the 1/nranks itself
+            _, axis_n = self._shard_axis()
+            if axis_n > 1:
+                g = g / float(axis_n)
         if self._max_gnorm > 0:
             gnorm = jnp.sqrt(jnp.sum(jnp.square(g)))
             g = g * jnp.minimum(1.0, self._max_gnorm
@@ -289,11 +315,34 @@ class DistributedFusedLamb:
         # unflatten back to the original pytree/dtypes
         new_flat = []
         vec = out["master"]
-        for p, o, s in zip(flat_p, offsets[:-1], sizes):
+        for shp, dt, o, s in zip(shapes, dtypes, offsets[:-1], sizes):
             seg_vals = jax.lax.dynamic_slice(vec, (o,), (s,))
-            new_flat.append(seg_vals.reshape(jnp.shape(p)).astype(
-                jnp.asarray(p).dtype))
+            new_flat.append(seg_vals.reshape(shp).astype(dt))
         return jax.tree_util.tree_unflatten(treedef, new_flat), out
 
     def update(self, grads, params, state):
         return self.apply_gradients(grads, params, state)
+
+    # -- stateful (dygraph-parity) path -------------------------------------
+    def step(self, grads=None):
+        """Eager convenience over bound parameters (reference scripts pass
+        ``parameters=`` and drive ``step()``)."""
+        enforce(self._parameters is not None,
+                "stateful step() needs parameters= at construction")
+        keys = [p.name or f"p{i}" for i, p in enumerate(self._parameters)]
+        values = dict(zip(keys, (p.value for p in self._parameters)))
+        if grads is None:
+            grads = [p._grad for p in self._parameters]
+        gdict = dict(zip(keys, grads))
+        if self._state is None:
+            self._state = self.init(values)
+        new_values, self._state = self.apply_gradients(gdict, values,
+                                                       self._state)
+        for p, k in zip(self._parameters, keys):
+            p.value = new_values[k]
+            p._grad = None
+
+    def clear_grad(self):
+        if self._parameters:
+            for p in self._parameters:
+                p._grad = None

@@ -19,6 +19,9 @@ from jax import lax
 from ..amp import state as amp_state
 from ..framework import random as fw_random
 from ..framework.errors import InvalidArgumentError, enforce
+from ..framework.infermeta import (infer_meta, meta_of, require_dim_match,
+                                   require_integer, require_rank,
+                                   require_rank_in)
 
 
 def _arr(x):
@@ -97,8 +100,20 @@ def log_softmax(x, axis: int = -1):
 # ---------------------------------------------------------------------------
 # Linear / matmul (MXU path; reference phi/kernels/matmul_kernel.h + F.linear)
 # ---------------------------------------------------------------------------
+def _linear_meta(x, weight, bias=None):
+    xm, wm = meta_of(x, "x"), meta_of(weight, "weight")
+    require_rank(wm, 2, "linear")
+    require_dim_match(xm, xm.ndim - 1, wm, 0, "linear")
+    if bias is not None:
+        bm = meta_of(bias, "bias")
+        if bm.ndim >= 1:   # 0-d scalars broadcast freely
+            require_dim_match(bm, -1, wm, 1, "linear")
+
+
+@infer_meta(_linear_meta)
 def linear(x, weight, bias=None):
-    """y = x @ W + b with W shaped (in, out) — paddle convention."""
+    """y = x @ W + b with W shaped (in, out) — paddle convention.
+    InferMeta: x[..., K] @ W[K, N] (+ b[N]) — phi MatmulInferMeta."""
     x, weight = amp_state.cast_for_op("linear", _arr(x), _arr(weight))
     y = jnp.matmul(x, weight)
     if bias is not None:
@@ -115,8 +130,16 @@ def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
     return jnp.matmul(x, y)
 
 
+def _embedding_meta(ids, weight, padding_idx=None):
+    im, wm = meta_of(ids, "ids"), meta_of(weight, "weight")
+    require_integer(im, "embedding")
+    require_rank(wm, 2, "embedding")
+
+
+@infer_meta(_embedding_meta)
 def embedding(ids, weight, padding_idx: Optional[int] = None):
-    """Reference: phi embedding kernel + nn/functional/input.py."""
+    """Reference: phi embedding kernel + nn/functional/input.py.
+    InferMeta: integer ids, 2-D weight — phi EmbeddingInferMeta."""
     ids = _arr(ids)
     weight = _arr(weight)
     out = jnp.take(weight, ids, axis=0)
@@ -134,9 +157,29 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _conv2d_meta(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                 groups=1, data_format="NCHW"):
+    xm, wm = meta_of(x, "x"), meta_of(weight, "weight")
+    require_rank(xm, 4, "conv2d")
+    require_rank(wm, 4, "conv2d")
+    cin = xm.shape[1] if data_format == "NCHW" else xm.shape[3]
+    enforce(cin == wm.shape[1] * groups,
+            f"conv2d: input channels {cin} != weight in_channels "
+            f"{wm.shape[1]} * groups {groups} ({xm} vs {wm})")
+    enforce(wm.shape[0] % groups == 0,
+            f"conv2d: out_channels {wm.shape[0]} not divisible by "
+            f"groups {groups}")
+    if bias is not None:
+        bm = meta_of(bias, "bias")
+        if bm.ndim >= 1:   # 0-d scalars broadcast freely
+            require_dim_match(bm, 0, wm, 0, "conv2d")
+
+
+@infer_meta(_conv2d_meta)
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
            groups: int = 1, data_format: str = "NCHW"):
-    """weight layout (out_ch, in_ch/groups, kh, kw) — paddle/OIHW."""
+    """weight layout (out_ch, in_ch/groups, kh, kw) — paddle/OIHW.
+    InferMeta: channel/groups consistency — phi ConvInferMeta."""
     x, weight = amp_state.cast_for_op("conv2d", _arr(x), _arr(weight))
     stride, dilation = _pair(stride), _pair(dilation)
     if isinstance(padding, str):
@@ -248,6 +291,19 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
 # ---------------------------------------------------------------------------
 # Normalization (reference phi layer_norm/batch_norm kernels)
 # ---------------------------------------------------------------------------
+def _layer_norm_meta(x, normalized_shape=None, weight=None, bias=None,
+                     epsilon=1e-5):
+    if normalized_shape is None:
+        return
+    xm = meta_of(x, "x")
+    ns = ((normalized_shape,) if isinstance(normalized_shape, int)
+          else tuple(normalized_shape))
+    enforce(xm.shape[xm.ndim - len(ns):] == ns,
+            f"layer_norm: trailing dims of {xm} != normalized_shape "
+            f"{list(ns)}")
+
+
+@infer_meta(_layer_norm_meta)
 def layer_norm(x, normalized_shape=None, weight=None, bias=None,
                epsilon: float = 1e-5):
     x = _arr(x)
@@ -278,6 +334,23 @@ def rms_norm(x, weight=None, epsilon: float = 1e-6):
     return y.astype(orig_dtype)
 
 
+def _batch_norm_meta(x, running_mean, running_var, weight=None, bias=None,
+                     training=False, momentum=0.9, epsilon=1e-5,
+                     data_format="NCHW"):
+    xm = meta_of(x, "x")
+    require_rank_in(xm, (2, 3, 4, 5), "batch_norm")
+    c = xm.shape[1] if data_format.startswith("NC") or xm.ndim == 2 \
+        else xm.shape[-1]
+    for nm, t in (("running_mean", running_mean),
+                  ("running_var", running_var), ("weight", weight),
+                  ("bias", bias)):
+        if t is not None:
+            m = meta_of(t, nm)
+            enforce(m.shape == (c,),
+                    f"batch_norm: {m} must be ({c},) for {xm}")
+
+
+@infer_meta(_batch_norm_meta)
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training: bool = False, momentum: float = 0.9,
                epsilon: float = 1e-5, data_format: str = "NCHW"):
@@ -351,11 +424,27 @@ def one_hot(x, num_classes: int, dtype=jnp.float32):
     return jax.nn.one_hot(_arr(x), num_classes, dtype=dtype)
 
 
+def _cross_entropy_meta(logits, label, soft_label=False, reduction="mean",
+                        ignore_index=-100, axis=-1, label_smoothing=0.0):
+    lm, tm = meta_of(logits, "logits"), meta_of(label, "label")
+    if soft_label:
+        require_rank(tm, lm.ndim, "cross_entropy")
+        require_dim_match(tm, axis if axis >= 0 else tm.ndim + axis,
+                          lm, axis if axis >= 0 else lm.ndim + axis,
+                          "cross_entropy")
+    else:
+        require_rank_in(tm, (lm.ndim - 1, lm.ndim), "cross_entropy")
+        require_integer(tm, "cross_entropy")
+
+
+@infer_meta(_cross_entropy_meta)
 def cross_entropy(logits, label, soft_label: bool = False,
                   reduction: str = "mean", ignore_index: int = -100,
                   axis: int = -1, label_smoothing: float = 0.0):
     """softmax_with_cross_entropy semantics (reference
-    phi/kernels/cross_entropy_kernel.h)."""
+    phi/kernels/cross_entropy_kernel.h).  InferMeta: hard labels are
+    integer with one fewer (or a squeezable) rank — phi
+    CrossEntropyWithSoftmaxInferMeta."""
     logits = amp_state.cast_for_op("cross_entropy", _arr(logits))
     label = _arr(label)
     logp = jax.nn.log_softmax(logits, axis=axis)

@@ -302,3 +302,25 @@ class TestDistributedFusedLamb:
         assert int(st["step"]) == 1
         assert all(bool(jnp.isfinite(v).all())
                    for v in jax.tree_util.tree_leaves(p1))
+
+    def test_stateful_step_and_unsupported_flags(self):
+        import pytest as _pytest
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+        pt.seed(4)
+        lin = nn.Linear(8, 8)
+        fused = DistributedFusedLamb(learning_rate=1e-2, alignment=1,
+                                     parameters=lin.parameters())
+        before = np.asarray(lin.weight.value).copy()
+        fused.step([jnp.ones_like(p.value) * 0.1
+                    for p in lin.parameters()])
+        assert not np.allclose(np.asarray(lin.weight.value), before)
+        with _pytest.raises(Exception, match="clip_after_allreduce"):
+            DistributedFusedLamb(clip_after_allreduce=False)
+        with _pytest.raises(Exception, match="use_master_param_norm"):
+            DistributedFusedLamb(use_master_param_norm=False)
+
+    def test_scalar_bias_linear_still_works(self):
+        import paddle_tpu.nn.functional as F
+        out = F.linear(jnp.ones((4, 8)), jnp.ones((8, 16)),
+                       jnp.asarray(0.5))
+        np.testing.assert_allclose(np.asarray(out), 8.5)

@@ -1,0 +1,85 @@
+"""InferMeta validation layer (component C8; reference paddle/phi/
+infermeta/): bad call shapes raise typed InvalidArgumentError with the
+offending shapes in the message, BEFORE any kernel runs."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.framework.infermeta import infer_meta, meta_of
+
+R = np.random.RandomState(0)
+
+
+def _f(*shape):
+    return jnp.asarray(R.rand(*shape), jnp.float32)
+
+
+class TestRules:
+    def test_linear_dim_mismatch(self):
+        with pytest.raises(InvalidArgumentError, match="linear"):
+            F.linear(_f(4, 8), _f(9, 16))
+        with pytest.raises(InvalidArgumentError, match="bias"):
+            F.linear(_f(4, 8), _f(8, 16), _f(17))
+        assert F.linear(_f(4, 8), _f(8, 16), _f(16)).shape == (4, 16)
+
+    def test_conv2d_channel_groups(self):
+        with pytest.raises(InvalidArgumentError, match="channels"):
+            F.conv2d(_f(1, 3, 8, 8), _f(8, 4, 3, 3))
+        with pytest.raises(InvalidArgumentError, match="groups"):
+            F.conv2d(_f(1, 4, 8, 8), _f(7, 2, 3, 3), groups=2)
+        out = F.conv2d(_f(1, 4, 8, 8), _f(8, 2, 3, 3), groups=2,
+                       padding=1)
+        assert out.shape == (1, 8, 8, 8)
+
+    def test_embedding_requires_int_ids(self):
+        with pytest.raises(InvalidArgumentError, match="integer"):
+            F.embedding(_f(4), _f(10, 8))
+        ids = jnp.asarray([1, 2], jnp.int32)
+        assert F.embedding(ids, _f(10, 8)).shape == (2, 8)
+
+    def test_cross_entropy_label_meta(self):
+        logits = _f(4, 10)
+        with pytest.raises(InvalidArgumentError, match="integer"):
+            F.cross_entropy(logits, _f(4))
+        with pytest.raises(InvalidArgumentError, match="rank"):
+            F.cross_entropy(logits,
+                            jnp.zeros((4, 2, 2), jnp.int32))
+        ok = F.cross_entropy(logits, jnp.zeros((4,), jnp.int32))
+        assert np.isfinite(float(ok))
+
+    def test_layer_norm_trailing_dims(self):
+        with pytest.raises(InvalidArgumentError, match="normalized_shape"):
+            F.layer_norm(_f(2, 8), normalized_shape=(9,))
+        assert F.layer_norm(_f(2, 8), normalized_shape=(8,)).shape == (2, 8)
+
+    def test_batch_norm_stat_shapes(self):
+        with pytest.raises(InvalidArgumentError, match="running_mean"):
+            F.batch_norm(_f(2, 3, 4, 4), jnp.zeros(4), jnp.ones(3))
+
+    def test_error_message_carries_shapes(self):
+        try:
+            F.linear(_f(4, 8), _f(9, 16))
+        except InvalidArgumentError as e:
+            assert "[4, 8]" in str(e) and "[9, 16]" in str(e)
+        else:
+            raise AssertionError("expected InvalidArgumentError")
+
+
+class TestDecorator:
+    def test_rule_exposed_and_composable(self):
+        def rule(x):
+            m = meta_of(x, "x")
+            if m.ndim != 1:
+                raise InvalidArgumentError(f"need 1-D, got {m}")
+
+        @infer_meta(rule)
+        def op(x):
+            return jnp.asarray(x) * 2
+
+        assert op.__infermeta__ is rule
+        np.testing.assert_allclose(np.asarray(op(jnp.ones(3))), 2.0)
+        with pytest.raises(InvalidArgumentError):
+            op(jnp.ones((2, 2)))
