@@ -304,8 +304,12 @@ def main():
                            max_position_embeddings=2048)
             tok_s, mfu = _bench_config(cfg, B=8, S=2048, steps=10,
                                        warmup=3, tag="gpt-125m-xla")
-        # diagnostics must not kill the headline number
-        if os.environ.get("BENCH_SKIP_DIAGNOSTICS", "0") != "1":
+        # diagnostics must not kill the headline number.
+        # BENCH_SKIP_SLICE keeps its historical meaning (skip ALL stderr
+        # diagnostics); BENCH_SKIP_DIAGNOSTICS is an explicit alias.
+        skip_diag = (os.environ.get("BENCH_SKIP_DIAGNOSTICS", "0") == "1"
+                     or os.environ.get("BENCH_SKIP_SLICE", "0") == "1")
+        if not skip_diag:
             try:
                 _bench_flash_ab()
             except Exception as e:
@@ -318,8 +322,7 @@ def main():
                 _bench_1p3b_fullstep()
             except Exception as e:
                 print(f"[1.3b-fullstep] failed: {e!r}", file=sys.stderr)
-        if os.environ.get("BENCH_SKIP_SLICE", "0") != "1" and \
-                os.environ.get("BENCH_SKIP_DIAGNOSTICS", "0") != "1":
+        if not skip_diag:
             try:
                 _bench_1p3b_slice()
             except Exception as e:

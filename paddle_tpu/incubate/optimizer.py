@@ -329,7 +329,19 @@ class DistributedFusedLamb:
         ``parameters=`` and drive ``step()``)."""
         enforce(self._parameters is not None,
                 "stateful step() needs parameters= at construction")
-        keys = [p.name or f"p{i}" for i, p in enumerate(self._parameters)]
+        # same key scheme as Optimizer._param_keys: real names (so
+        # exclude_from_weight_decay_fn matches what the model calls the
+        # parameter), deduped, synthetic only as a last resort
+        if getattr(self, "_param_key_list", None) is None:
+            keys, seen = [], set()
+            for i, p in enumerate(self._parameters):
+                k = p.name if p.name else f"param_{i}"
+                if k in seen:
+                    k = f"{k}#{i}"
+                seen.add(k)
+                keys.append(k)
+            self._param_key_list = keys
+        keys = self._param_key_list
         values = dict(zip(keys, (p.value for p in self._parameters)))
         if grads is None:
             grads = [p._grad for p in self._parameters]

@@ -339,8 +339,8 @@ def _batch_norm_meta(x, running_mean, running_var, weight=None, bias=None,
                      data_format="NCHW"):
     xm = meta_of(x, "x")
     require_rank_in(xm, (2, 3, 4, 5), "batch_norm")
-    c = xm.shape[1] if data_format.startswith("NC") or xm.ndim == 2 \
-        else xm.shape[-1]
+    # must mirror the body's layout rule exactly: "NC*" = channel-first
+    c = xm.shape[1] if data_format.startswith("NC") else xm.shape[-1]
     for nm, t in (("running_mean", running_mean),
                   ("running_var", running_var), ("weight", weight),
                   ("bias", bias)):
@@ -358,7 +358,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     x = _arr(x)
     orig_dtype = x.dtype
     xf = amp_state.cast_for_op("batch_norm", x)
-    if data_format == "NCHW":
+    # "NC*" formats (NCL/NCHW/NCDHW) are channel-first; "N*C" channel-last
+    if data_format.startswith("NC"):
         axes = tuple(i for i in range(x.ndim) if i != 1)
         shape = (1, -1) + (1,) * (x.ndim - 2)
     else:

@@ -327,7 +327,6 @@ class TestFusionEvidence:
     fused kernels, not one HBM round-trip per elementwise op."""
 
     def test_epilogue_fuses_to_few_kernels(self):
-        import re
         from paddle_tpu.ops.fused import (
             fused_bias_dropout_residual_layer_norm as fe)
         x = jnp.ones((4, 256, 512), jnp.float32)
