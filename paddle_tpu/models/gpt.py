@@ -160,12 +160,25 @@ class GPTAttention(Layer):
             v_buf = lax.dynamic_update_slice(
                 v_buf, v.astype(v_buf.dtype), (0, 0, used, 0))
             L = k_buf.shape[2]
-            rows = used + jnp.arange(s)                 # query positions
-            cols = jnp.arange(L)
-            bias = jnp.where(cols[None, :] <= rows[:, None], 0.0, -1e9)
-            out = F.scaled_dot_product_attention(
-                q, k_buf, v_buf, attn_mask=bias[None, None].astype(q.dtype),
-                is_causal=False, dropout_p=0.0, training=False)
+            from ..distributed.topology import get_mesh
+            if c.use_pallas_attention and s == 1 and L % 8 == 0 \
+                    and get_mesh() is None:
+                # single-token decode rides the streaming cache kernel:
+                # only blocks holding real entries are read (dynamic trip
+                # count on the traced length — reference CacheKV path).
+                # Mesh-gated like functional.py's routing: pallas_call is
+                # opaque to GSPMD, so sharded decode stays on the
+                # partitionable SDPA branch
+                from ..ops import flash_attention_kvcache
+                out = flash_attention_kvcache(q, k_buf, v_buf, used + 1)
+            else:
+                rows = used + jnp.arange(s)             # query positions
+                cols = jnp.arange(L)
+                bias = jnp.where(cols[None, :] <= rows[:, None], 0.0, -1e9)
+                out = F.scaled_dot_product_attention(
+                    q, k_buf, v_buf,
+                    attn_mask=bias[None, None].astype(q.dtype),
+                    is_causal=False, dropout_p=0.0, training=False)
             out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
             out = self.resid_dropout(self.out_proj(out))
             return out, (k_buf, v_buf, used + s)

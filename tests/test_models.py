@@ -192,6 +192,25 @@ class TestBert:
 
 
 class TestGPTGenerate:
+    def test_pallas_decode_kernel_matches_xla_cache_path(self):
+        """Single-token decode through flash_attention_kvcache must produce
+        the same greedy continuation as the masked XLA cache path."""
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        outs = {}
+        for pallas in (False, True):
+            pt.seed(11)   # identical weights across the two paths
+            cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=4,
+                            max_position_embeddings=64, vocab_size=256,
+                            hidden_dropout=0.0, attention_dropout=0.0,
+                            use_pallas_attention=pallas)
+            model = GPTForCausalLM(cfg)
+            model.eval()
+            prompt = jnp.asarray(
+                np.random.RandomState(0).randint(0, 256, (2, 8)), jnp.int32)
+            outs[pallas] = np.asarray(
+                model.generate(prompt, max_new_tokens=8, temperature=0.0))
+        np.testing.assert_array_equal(outs[False], outs[True])
+
     def test_greedy_matches_full_recompute(self):
         """Incremental static-cache decode == rerunning the full forward at
         every step (the CacheKV correctness invariant)."""
