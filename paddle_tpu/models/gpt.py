@@ -136,6 +136,7 @@ class GPTAttention(Layer):
         self.resid_dropout = Dropout(c.hidden_dropout)
 
     def forward(self, x, cache=None):
+        from ..distributed.topology import get_mesh
         c = self.config
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)                      # (b, s, 3h) mp-sharded
@@ -160,9 +161,8 @@ class GPTAttention(Layer):
             v_buf = lax.dynamic_update_slice(
                 v_buf, v.astype(v_buf.dtype), (0, 0, used, 0))
             L = k_buf.shape[2]
-            from ..distributed.topology import get_mesh
             if c.use_pallas_attention and s == 1 and L % 8 == 0 \
-                    and get_mesh() is None:
+                    and c.head_dim % 8 == 0 and get_mesh() is None:
                 # single-token decode rides the streaming cache kernel:
                 # only blocks holding real entries are read (dynamic trip
                 # count on the traced length — reference CacheKV path).
@@ -186,7 +186,6 @@ class GPTAttention(Layer):
             # ring attention: seq stays sharded, KV chunks rotate the ring
             from ..distributed.sequence_parallel import (
                 ring_attention_sharded)
-            from ..distributed.topology import get_mesh
             mesh = get_mesh()
             if mesh is not None and "sp" in mesh.axis_names:
                 out = ring_attention_sharded(q, k, v, causal=True)
