@@ -21,6 +21,7 @@ from . import optimizer  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401

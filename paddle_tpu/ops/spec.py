@@ -894,6 +894,26 @@ def _populate_round5(unary, binary) -> None:
                                      1.0, -1.0).astype(np.float32)),
         grad_wrt=(0, 1), rtol=1e-4, atol=1e-4))
 
+    # -- signal (reference python/paddle/signal.py) ------------------------
+    register_op(OpSpec(
+        name="signal.frame",
+        fn=lambda x: pt.signal.frame(x, 4, 2),
+        ref=lambda x: np.stack([x[..., i * 2:i * 2 + 4]
+                                for i in range((x.shape[-1] - 4) // 2 + 1)],
+                               axis=-1),
+        sample=lambda rng: (_r(rng, 2, 12),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="signal.overlap_add",
+        fn=lambda x: pt.signal.overlap_add(x, 2),
+        ref=_np_overlap_add_hop2,
+        sample=lambda rng: (_r(rng, 4, 3),), grad_wrt=(0,)))
+    register_op(OpSpec(
+        name="signal.stft",
+        fn=lambda x: pt.signal.stft(x, n_fft=16, hop_length=8),
+        ref=lambda x: _np_stft(x, 16, 8),
+        sample=lambda rng: (_r(rng, 64),), grad_wrt=(),
+        rtol=1e-4, atol=1e-4, bf16=False))
+
     # -- complex-number surface -------------------------------------------
     register_op(OpSpec(
         name="complex", fn=pt.complex,
@@ -923,6 +943,22 @@ def _nan_sample(rng):
     x[0, 1] = np.nan
     x[2, 3] = np.nan
     return (x,)
+
+
+def _np_overlap_add_hop2(x):
+    fl, nf = x.shape
+    out = np.zeros((nf - 1) * 2 + fl, x.dtype)
+    for j in range(nf):
+        out[j * 2:j * 2 + fl] += x[:, j]
+    return out
+
+
+def _np_stft(x, n_fft, hop):
+    pad = n_fft // 2
+    xp = np.pad(x, (pad, pad), mode="reflect")
+    nf = 1 + (len(xp) - n_fft) // hop
+    frames = np.stack([xp[i * hop:i * hop + n_fft] for i in range(nf)], -1)
+    return np.fft.rfft(frames, axis=0)
 
 
 def _np_mode_rows(x):

@@ -1,0 +1,153 @@
+"""paddle.signal parity (reference python/paddle/signal.py: frame:32,
+overlap_add:154, stft:237, istft:391).
+
+TPU-native: framing is a static gather (indices built at trace time, one
+vectorized take), overlap-add is a segment-sum scatter, and the DFTs ride
+``jnp.fft`` — everything jittable with static shapes, batched over
+leading dims.  Output layout matches the reference: stft returns
+``[..., n_fft(/2+1), num_frames]`` (frequency-major)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .framework.errors import enforce
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _arr(x):
+    return x.__jax_array__() if hasattr(x, "__jax_array__") else jnp.asarray(x)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1):
+    """Slice overlapping frames: ``[..., seq]`` → ``[..., frame_length,
+    num_frames]`` for axis=-1 (reference signal.py:32; axis=0 puts frames
+    first)."""
+    x = _arr(x)
+    enforce(axis in (0, -1), "frame: axis must be 0 or -1")
+    enforce(hop_length > 0, f"frame: hop_length={hop_length} must be > 0")
+    seq = x.shape[axis]
+    enforce(frame_length <= seq,
+            f"frame: frame_length={frame_length} > seq_length={seq}")
+    n_frames = 1 + (seq - frame_length) // hop_length
+    idx = (np.arange(frame_length)[:, None]
+           + hop_length * np.arange(n_frames)[None, :])   # (fl, nf)
+    if axis == -1:
+        return jnp.take(x, jnp.asarray(idx), axis=-1)
+    return jnp.take(x, jnp.asarray(idx.T), axis=0)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1):
+    """Inverse of :func:`frame`: ``[..., frame_length, num_frames]`` →
+    ``[..., seq]`` summing overlaps (reference signal.py:154)."""
+    x = _arr(x)
+    enforce(axis in (0, -1), "overlap_add: axis must be 0 or -1")
+    if axis == 0:
+        # (num_frames, frame_length, ...) → move to (..., fl, nf)
+        x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2)
+    fl, nf = x.shape[-2], x.shape[-1]
+    out_len = (nf - 1) * hop_length + fl
+    pos = (np.arange(fl)[:, None]
+           + hop_length * np.arange(nf)[None, :]).reshape(-1)
+    flat = x.reshape(x.shape[:-2] + (fl * nf,))
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    out = out.at[..., jnp.asarray(pos)].add(flat)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def _resolve_window(window, win_length: int, n_fft: int, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = _arr(window).astype(dtype)
+        enforce(w.shape == (win_length,),
+                f"window must have shape ({win_length},), got {w.shape}")
+    if win_length < n_fft:     # center-pad to n_fft (reference behavior)
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return w
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True):
+    """Short-time Fourier transform (reference signal.py:237): returns
+    ``[..., n_fft//2 + 1 (or n_fft), num_frames]`` complex frames."""
+    x = _arr(x)
+    hop_length = n_fft // 4 if hop_length is None else hop_length
+    enforce(hop_length > 0, f"stft: hop_length={hop_length} must be > 0")
+    win_length = win_length or n_fft
+    enforce(not (onesided and jnp.iscomplexobj(x)),
+            "stft: onesided is not supported for complex inputs")
+    w = _resolve_window(window, win_length, n_fft,
+                        jnp.float32 if not jnp.iscomplexobj(x)
+                        else jnp.complex64)
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(x, n_fft, hop_length, axis=-1)      # (..., n_fft, nf)
+    frames = frames * w[:, None]
+    spec = (jnp.fft.rfft(frames, axis=-2) if onesided
+            else jnp.fft.fft(frames, axis=-2))
+    if normalized:
+        spec = spec * (n_fft ** -0.5)
+    return spec
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None,
+          return_complex: bool = False):
+    """Inverse STFT, least-squares/NOLA form (reference signal.py:391)."""
+    x = _arr(x)
+    hop_length = n_fft // 4 if hop_length is None else hop_length
+    enforce(hop_length > 0, f"istft: hop_length={hop_length} must be > 0")
+    win_length = win_length or n_fft
+    enforce(x.ndim >= 2, "istft: input must be [..., n_fft(/2+1), frames]")
+    enforce(not (return_complex and onesided),
+            "istft: return_complex=True requires onesided=False")
+    w = _resolve_window(window, win_length, n_fft, jnp.float32)
+    if normalized:
+        x = x * (n_fft ** 0.5)
+    frames = (jnp.fft.irfft(x, n=n_fft, axis=-2) if onesided
+              else jnp.fft.ifft(x, axis=-2))
+    if not return_complex:
+        frames = jnp.real(frames)
+    frames = frames * w[:, None]
+    y = overlap_add(frames, hop_length, axis=-1)
+    # NOLA check + normalization by the summed squared window envelope.
+    # The window is concrete at trace time, so the envelope minimum over
+    # the center region is checkable with numpy (reference/torch raise
+    # likewise on zero overlap-add coverage)
+    wsq_np = np.asarray(w, np.float64) ** 2
+    nf = int(x.shape[-1])
+    env_np = np.zeros((nf - 1) * hop_length + n_fft)
+    for j in range(nf):
+        env_np[j * hop_length:j * hop_length + n_fft] += wsq_np
+    chk = env_np[n_fft // 2:len(env_np) - n_fft // 2] if center else env_np
+    enforce(chk.size == 0 or chk.min() > 1e-11,
+            "istft: window fails the NOLA condition (zero overlap-add "
+            f"coverage with hop_length={hop_length})")
+    y = y / jnp.maximum(jnp.asarray(env_np, y.dtype), 1e-11)
+    if center:
+        pad = n_fft // 2
+        # drop the left padding; the right crop depends on `length`: an
+        # explicit length keeps real samples from the last frames' tails
+        # (torch semantics) instead of cropping pad then zero-padding
+        y = y[..., pad:] if length is not None \
+            else y[..., pad:y.shape[-1] - pad]
+    if length is not None:
+        if y.shape[-1] < length:   # zero-pad past frame coverage
+            y = jnp.pad(y, [(0, 0)] * (y.ndim - 1)
+                        + [(0, length - y.shape[-1])])
+        y = y[..., :length]
+    return y

@@ -1,0 +1,112 @@
+"""paddle.signal parity (reference python/paddle/signal.py): torch
+goldens for stft/istft, analytic checks for frame/overlap_add."""
+import numpy as np
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+
+R = np.random.RandomState(0)
+
+
+class TestFrame:
+    def test_frame_last_axis(self):
+        x = jnp.asarray(np.arange(10, dtype=np.float32))
+        f = np.asarray(pt.signal.frame(x, frame_length=4, hop_length=2))
+        assert f.shape == (4, 4)
+        np.testing.assert_array_equal(f[:, 0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(f[:, 1], [2, 3, 4, 5])
+        np.testing.assert_array_equal(f[:, 3], [6, 7, 8, 9])
+
+    def test_frame_batched(self):
+        x = jnp.asarray(R.randn(3, 16), jnp.float32)
+        f = pt.signal.frame(x, 8, 4)
+        assert f.shape == (3, 8, 3)
+
+    def test_overlap_add_inverts_hop_eq_len(self):
+        x = jnp.asarray(R.randn(2, 12), jnp.float32)
+        f = pt.signal.frame(x, 4, 4)
+        back = pt.signal.overlap_add(f, 4)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=1e-6)
+
+    def test_overlap_add_sums_overlaps(self):
+        ones = jnp.ones((4, 3))          # 3 frames of length 4, hop 2
+        y = np.asarray(pt.signal.overlap_add(ones, 2))
+        np.testing.assert_array_equal(y, [1, 1, 2, 2, 2, 2, 1, 1])
+
+
+class TestStft:
+    def test_matches_torch(self):
+        x = R.randn(2, 256).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        got = np.asarray(pt.signal.stft(
+            jnp.asarray(x), n_fft=128, hop_length=32,
+            window=jnp.asarray(win)))
+        want = torch.stft(torch.from_numpy(x), n_fft=128, hop_length=32,
+                          window=torch.from_numpy(win), center=True,
+                          pad_mode="reflect", onesided=True,
+                          return_complex=True).numpy()
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_normalized_and_twosided(self):
+        x = R.randn(128).astype(np.float32)
+        got = np.asarray(pt.signal.stft(jnp.asarray(x), n_fft=64,
+                                        onesided=False, normalized=True))
+        want = torch.stft(torch.from_numpy(x), n_fft=64, center=True,
+                          onesided=False, normalized=True,
+                          return_complex=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestIstft:
+    def test_round_trip(self):
+        x = R.randn(1, 400).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        spec = pt.signal.stft(jnp.asarray(x), n_fft=128, hop_length=32,
+                              window=jnp.asarray(win))
+        back = np.asarray(pt.signal.istft(
+            spec, n_fft=128, hop_length=32, window=jnp.asarray(win)))
+        # exact within the frame-covered prefix (the tail past the last
+        # full frame is unrecoverable, same as torch)
+        n = back.shape[-1]
+        np.testing.assert_allclose(back, x[:, :n], rtol=1e-3, atol=1e-4)
+
+    def test_matches_torch(self):
+        x = R.randn(300).astype(np.float32)
+        win = np.hanning(64).astype(np.float32)
+        spec_t = torch.stft(torch.from_numpy(x), n_fft=64, hop_length=16,
+                            window=torch.from_numpy(win),
+                            return_complex=True)
+        got = np.asarray(pt.signal.istft(
+            jnp.asarray(spec_t.numpy()), n_fft=64, hop_length=16,
+            window=jnp.asarray(win), length=300))
+        want = torch.istft(spec_t, n_fft=64, hop_length=16,
+                           window=torch.from_numpy(win), length=300).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestValidation:
+    def test_hop_zero_rejected(self):
+        import pytest
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="hop_length"):
+            pt.signal.stft(jnp.zeros(64), n_fft=16, hop_length=0)
+
+    def test_nola_violation_raises(self):
+        import pytest
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        spec = jnp.zeros((17, 4), jnp.complex64)
+        with pytest.raises(InvalidArgumentError, match="NOLA"):
+            pt.signal.istft(spec, n_fft=32, hop_length=33,
+                            window=jnp.asarray(
+                                np.hanning(32).astype(np.float32)))
+
+    def test_return_complex_needs_twosided(self):
+        import pytest
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="onesided"):
+            pt.signal.istft(jnp.zeros((17, 4), jnp.complex64), n_fft=32,
+                            return_complex=True)

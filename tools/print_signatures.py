@@ -48,6 +48,7 @@ _MODULES = [
     "paddle_tpu.static",
     "paddle_tpu.sparse",
     "paddle_tpu.fft",
+    "paddle_tpu.signal",
     "paddle_tpu.distribution",
     "paddle_tpu.device",
     "paddle_tpu.text",
@@ -79,8 +80,20 @@ def collect() -> list[str]:
             mod = importlib.import_module(modname)
         except ImportError:
             continue
+        def _local(n):
+            # no __all__: cross-package re-exports (nn.ClipGrad*, the
+            # top-level tensor surface) ARE the public API; only
+            # framework-internal helpers (infermeta combinators, enforce,
+            # error classes) leaking via imports are excluded
+            src = getattr(vars(mod)[n], "__module__", None) or ""
+            return not (src.startswith("paddle_tpu.framework")
+                        and not modname.startswith("paddle_tpu.framework")
+                        # the top level re-exports framework symbols on
+                        # purpose (paddle.save/load/seed/...)
+                        and modname != "paddle_tpu")
+
         names = getattr(mod, "__all__", None) or [
-            n for n in vars(mod) if not n.startswith("_")]
+            n for n in vars(mod) if not n.startswith("_") and _local(n)]
         for name in sorted(set(names)):
             obj = getattr(mod, name, None)
             if obj is None or inspect.ismodule(obj):
