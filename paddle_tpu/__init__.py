@@ -22,6 +22,12 @@ from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from .reader import batch  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401

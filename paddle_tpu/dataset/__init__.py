@@ -1,0 +1,11 @@
+"""Legacy paddle.dataset facade (reference python/paddle/dataset/*):
+real submodules exposing the reference's ``train()``/``test()`` reader
+factories (so ``import paddle_tpu.dataset.mnist`` works, the dominant
+idiom in ported tutorial code) over the same corpora the modern
+``vision.datasets`` / ``text.datasets`` classes serve (zero-egress
+synthetic-learnable defaults)."""
+from . import (cifar, flowers, imdb, imikolov,  # noqa: F401
+               mnist, uci_housing)
+
+__all__ = ["mnist", "cifar", "flowers", "uci_housing", "imdb",
+           "imikolov"]

@@ -1,0 +1,154 @@
+"""Legacy reader decorators + paddle.batch (reference python/paddle/
+reader/decorator.py and batch.py).
+
+These are pure-python generator combinators; they survive unchanged on
+TPU because they run entirely on the host feeding the DataLoader.  The
+multiprocess variants map onto the DataLoader's worker pool rather than
+re-implementing a pipe zoo (xmap_readers/multiprocess_reader keep their
+signatures and run the mapper in-process — on TPU hosts the win of those
+decorators was CPU-side decode overlap, which io.DataLoader's
+num_workers already provides).
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from typing import Callable
+
+__all__ = ["batch", "cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False):
+    """paddle.batch (reference batch.py:18): group samples into lists."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def cache(reader: Callable):
+    """Cache all samples in memory on first pass (decorator.py:52).
+    The cache commits atomically: a reader that raises mid-pass leaves
+    nothing cached, so a retry re-reads from scratch (no duplicates)."""
+    data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            fresh = list(reader())      # all-or-nothing
+            data.extend(fresh)
+            filled.append(True)
+        return iter(data)
+    return cached
+
+
+def map_readers(func: Callable, *readers):
+    """Zip readers, map func over the tuples (decorator.py:92)."""
+    def mapped():
+        its = [r() for r in readers]
+        for args in zip(*its):
+            yield func(*args)
+    return mapped
+
+
+def shuffle(reader: Callable, buf_size: int):
+    """Buffered shuffle (decorator.py:134)."""
+    def shuffled():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers end to end (decorator.py:183)."""
+    def chained():
+        return itertools.chain(*(r() for r in readers))
+    return chained
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples (decorator.py:248).
+    check_alignment=True raises when readers run out unevenly."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _flatten(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    _END = object()
+
+    def composed():
+        its = [r() for r in readers]
+        if check_alignment:
+            # zip() would silently eat one extra element from earlier
+            # readers; a sentinel-padded zip sees EVERY ragged tail
+            for items in itertools.zip_longest(*its, fillvalue=_END):
+                if any(i is _END for i in items):
+                    raise ValueError("readers have different lengths "
+                                     "(check_alignment=True)")
+                yield sum((_flatten(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*its, fillvalue=_END):
+                yield sum((_flatten(i) for i in items if i is not _END),
+                          ())
+    return composed
+
+
+def buffered(reader: Callable, size: int):
+    """Read-ahead buffer (decorator.py:308) — the DataLoader prefetch
+    thread is the TPU-native version; kept for API parity as a pass-through
+    buffer."""
+    def buffered_reader():
+        buf = []
+        it = reader()
+        for sample in it:
+            buf.append(sample)
+            if len(buf) >= size:
+                yield from buf
+                buf = []
+        yield from buf
+    return buffered_reader
+
+
+def firstn(reader: Callable, n: int):
+    """First n samples (decorator.py:367)."""
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Signature-compatible mapper (decorator.py:412); the mapper runs
+    in-process — use io.DataLoader(num_workers=...) for real host
+    parallelism on TPU machines."""
+    def xmapped():
+        for sample in reader():
+            yield mapper(sample)
+    return xmapped
+
+
+def multiprocess_reader(readers, use_pipe: bool = True,
+                        queue_size: int = 1000):
+    """Signature-compatible merge of readers (decorator.py:505),
+    sequential in-process; see xmap_readers note."""
+    def merged():
+        for r in readers:
+            yield from r()
+    return merged

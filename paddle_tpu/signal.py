@@ -83,7 +83,9 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
     x = _arr(x)
     hop_length = n_fft // 4 if hop_length is None else hop_length
     enforce(hop_length > 0, f"stft: hop_length={hop_length} must be > 0")
-    win_length = win_length or n_fft
+    win_length = n_fft if win_length is None else win_length
+    enforce(0 < win_length <= n_fft,
+            f"stft: need 0 < win_length={win_length} <= n_fft={n_fft}")
     enforce(not (onesided and jnp.iscomplexobj(x)),
             "stft: onesided is not supported for complex inputs")
     w = _resolve_window(window, win_length, n_fft,
@@ -111,7 +113,9 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     x = _arr(x)
     hop_length = n_fft // 4 if hop_length is None else hop_length
     enforce(hop_length > 0, f"istft: hop_length={hop_length} must be > 0")
-    win_length = win_length or n_fft
+    win_length = n_fft if win_length is None else win_length
+    enforce(0 < win_length <= n_fft,
+            f"istft: need 0 < win_length={win_length} <= n_fft={n_fft}")
     enforce(x.ndim >= 2, "istft: input must be [..., n_fft(/2+1), frames]")
     enforce(not (return_complex and onesided),
             "istft: return_complex=True requires onesided=False")

@@ -1,0 +1,20 @@
+"""paddle.sysconfig parity (reference python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory of framework headers (reference :20).  The TPU build has
+    no C++ op headers to compile against; custom host ops use the C ABI
+    (utils/cpp_extension), so this returns the native-helpers dir."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "io", "_native")
+
+
+def get_lib() -> str:
+    """Directory of shared libraries (reference :37): built native
+    helpers (e.g. the dataloader shm ring) live beside their sources."""
+    return get_include()

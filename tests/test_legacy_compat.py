@@ -1,0 +1,115 @@
+"""Legacy compat namespaces: paddle.batch + reader decorators
+(reference batch.py, reader/decorator.py), paddle.dataset facade,
+paddle.callbacks, paddle.sysconfig, paddle.hub (local)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+class TestBatchAndReader:
+    def test_batch(self):
+        r = pt.batch(lambda: iter(range(10)), batch_size=3)
+        out = [len(b) for b in r()]
+        assert out == [3, 3, 3, 1]
+        r2 = pt.batch(lambda: iter(range(10)), batch_size=3,
+                      drop_last=True)
+        assert [len(b) for b in r2()] == [3, 3, 3]
+
+    def test_shuffle_chain_firstn_cache(self):
+        base = lambda: iter(range(20))  # noqa: E731
+        s = sorted(pt.reader.shuffle(base, buf_size=8)())
+        assert s == list(range(20))
+        c = list(pt.reader.chain(lambda: iter([1, 2]),
+                                 lambda: iter([3]))())
+        assert c == [1, 2, 3]
+        assert list(pt.reader.firstn(base, 5)()) == [0, 1, 2, 3, 4]
+        calls = []
+
+        def counting():
+            calls.append(1)
+            return iter([7, 8])
+
+        cached = pt.reader.cache(counting)
+        assert list(cached()) == [7, 8] and list(cached()) == [7, 8]
+        assert len(calls) == 1
+
+    def test_map_and_compose(self):
+        a = lambda: iter([1, 2, 3])     # noqa: E731
+        b = lambda: iter([10, 20, 30])  # noqa: E731
+        m = list(pt.reader.map_readers(lambda x, y: x + y, a, b)())
+        assert m == [11, 22, 33]
+        z = list(pt.reader.compose(a, b)())
+        assert z == [(1, 10), (2, 20), (3, 30)]
+
+    def test_xmap_and_buffered(self):
+        base = lambda: iter(range(5))   # noqa: E731
+        assert list(pt.reader.xmap_readers(lambda x: x * 2, base, 2, 4)()) \
+            == [0, 2, 4, 6, 8]
+        assert list(pt.reader.buffered(base, 2)()) == [0, 1, 2, 3, 4]
+
+
+class TestDatasetFacade:
+    def test_mnist_reader_schema(self):
+        r = pt.dataset.mnist.test()
+        img, label = next(r())
+        assert img.shape == (28, 28) and img.dtype == np.float32
+        assert 0 <= label < 10
+        batched = pt.batch(r, 16)
+        first = next(batched())
+        assert len(first) == 16
+
+    def test_uci_housing(self):
+        x, y = next(pt.dataset.uci_housing.train()())
+        assert x.ndim == 1 and np.issubdtype(x.dtype, np.floating)
+
+
+class TestMiscNamespaces:
+    def test_callbacks_alias(self):
+        assert pt.callbacks.EarlyStopping is not None
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        assert pt.callbacks.EarlyStopping is EarlyStopping
+
+    def test_sysconfig(self):
+        assert os.path.isdir(pt.sysconfig.get_include())
+        assert isinstance(pt.sysconfig.get_lib(), str)
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=2):\n    '''doc'''\n    return n * 2\n")
+        assert "tiny" in pt.hub.list(str(tmp_path))
+        assert pt.hub.help(str(tmp_path), "tiny") == "doc"
+        assert pt.hub.load(str(tmp_path), "tiny", n=3) == 6
+
+    def test_hub_remote_gated(self):
+        import pytest
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="egress"):
+            pt.hub.list("github.com/some/repo")
+
+    def test_dataset_submodule_import_idiom(self):
+        # the dominant tutorial idiom must work
+        import paddle_tpu.dataset.mnist as mnist_mod
+        img, label = next(mnist_mod.test()())
+        assert img.shape == (28, 28)
+        import paddle_tpu.dataset.cifar as cifar_mod
+        assert next(cifar_mod.train10()())[0].shape == (32, 32, 3)
+
+    def test_compose_misalignment_raises_both_ways(self):
+        import pytest
+        a4 = lambda: iter([1, 2, 3, 4])   # noqa: E731
+        b3 = lambda: iter([10, 20, 30])   # noqa: E731
+        with pytest.raises(ValueError):
+            list(pt.reader.compose(a4, b3)())
+        with pytest.raises(ValueError):
+            list(pt.reader.compose(b3, a4)())
+
+    def test_stft_win_length_validation(self):
+        import pytest
+        import jax.numpy as jnp
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="win_length"):
+            pt.signal.stft(jnp.zeros(64), n_fft=16, win_length=32)
+        with pytest.raises(InvalidArgumentError, match="win_length"):
+            pt.signal.stft(jnp.zeros(64), n_fft=16, win_length=0)

@@ -49,6 +49,10 @@ _MODULES = [
     "paddle_tpu.sparse",
     "paddle_tpu.fft",
     "paddle_tpu.signal",
+    "paddle_tpu.reader",
+    "paddle_tpu.callbacks",
+    "paddle_tpu.sysconfig",
+    "paddle_tpu.hub",
     "paddle_tpu.distribution",
     "paddle_tpu.device",
     "paddle_tpu.text",
