@@ -24,6 +24,8 @@ from ..topology import (CommunicateTopology, HybridCommunicateGroup,
                         set_hybrid_communicate_group)
 from ..parallel import device_put_sharded_variables, get_rank, get_world_size
 from .recompute import recompute
+from . import utils  # noqa: F401  (fleet.utils.recompute import path)
+from . import meta_parallel  # noqa: F401  (ported-script import path)
 
 __all__ = ["DistributedStrategy", "init", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",

@@ -1,0 +1,15 @@
+"""fleet.meta_parallel import-path compatibility (reference
+python/paddle/distributed/fleet/meta_parallel/__init__.py): the
+Megatron-style TP layers, the TP-correct RNG tracker, and the pipeline
+machinery under the names ported hybrid-parallel scripts import."""
+from ..mp_layers import (ColumnParallelLinear,  # noqa: F401
+                         RowParallelLinear, VocabParallelEmbedding)
+from ..pipeline import (gpipe_spmd, one_f_one_b_spmd,  # noqa: F401
+                        split_microbatches, stack_stage_params)
+from ..random import (RNGStatesTracker,  # noqa: F401
+                      get_rng_state_tracker)
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "RNGStatesTracker",
+           "get_rng_state_tracker", "gpipe_spmd", "one_f_one_b_spmd",
+           "split_microbatches", "stack_stage_params"]

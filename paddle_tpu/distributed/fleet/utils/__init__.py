@@ -1,0 +1,27 @@
+"""fleet.utils (reference python/paddle/distributed/fleet/utils/):
+``recompute`` (the reference's canonical import path,
+fleet/utils/recompute.py:331) plus the hybrid_parallel_util helpers that
+remain meaningful on TPU — the grad-sync fns are GSPMD-derived no-ops
+kept for ported-script compatibility."""
+from __future__ import annotations
+
+from ..recompute import recompute, recompute_wrapper  # noqa: F401
+
+__all__ = ["recompute", "recompute_wrapper", "fused_allreduce_gradients",
+           "broadcast_dp_parameters", "broadcast_mp_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """≙ hybrid_parallel_util.py:156 — under GSPMD the data-parallel grad
+    all-reduce is emitted by the partitioner; nothing to do eagerly."""
+    return None
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """≙ hybrid_parallel_util.py:128 — parameters created under a shared
+    seed are already consistent; replicated placement is the broadcast."""
+    return None
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    return None

@@ -19,18 +19,22 @@ fi
 
 echo "== hardware kernel tests =="
 python -m pytest tests/test_tpu_hw.py -q 2>&1 | tail -5
+test_status=${PIPESTATUS[0]}
 
 echo "== bench (headline + A/B + sweep + 1.3B measured) =="
 python bench.py >BENCH_hw_r05.stdout.json 2>BENCH_hw_r05.stderr.log
-status=$?
+bench_status=$?
 python - <<'EOF'
 import json
 out = open("BENCH_hw_r05.stdout.json").read().strip()
 err = open("BENCH_hw_r05.stderr.log").read()
-json.dump({"stdout": json.loads(out.splitlines()[-1]) if out else None,
-           "stderr_diagnostics": err.splitlines()},
+try:
+    headline = json.loads(out.splitlines()[-1]) if out else None
+except Exception as e:   # truncated stdout must still leave an artifact
+    headline = {"parse_error": repr(e), "raw": out.splitlines()[-3:]}
+json.dump({"stdout": headline, "stderr_diagnostics": err.splitlines()},
           open("BENCH_hw_r05.json", "w"), indent=2)
 print("wrote BENCH_hw_r05.json")
 print(out)
 EOF
-exit $status
+exit $(( test_status || bench_status ))
