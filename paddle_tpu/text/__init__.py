@@ -14,10 +14,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import datasets  # noqa: F401
+from .tokenizer import WordPieceTokenizer  # noqa: F401
 from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
                        UCIHousing)
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+__all__ = ["WordPieceTokenizer",
+           "viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
            "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
 
 

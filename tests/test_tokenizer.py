@@ -1,0 +1,85 @@
+"""WordPiece tokenizer: canonical BERT segmentation, native C core ==
+python oracle on every input (property parity), round-trip decode."""
+import numpy as np
+import pytest
+
+from paddle_tpu.text import WordPieceTokenizer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##ed", "##s", "##ing", "over", "lazy", "dog",
+         "un", "##aff", "##able", "runn", "hello", "world", ",", ".",
+         "!", "?", "'", "a", "##b", "##c", "ab"]
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordPieceTokenizer(VOCAB, unk_token="[UNK]")
+
+
+class TestSemantics:
+    def test_canonical_bert_example(self, tok):
+        # the classic wordpiece example
+        assert [tok.inv_vocab[i] for i in tok.encode("unaffable")] == \
+            ["un", "##aff", "##able"]
+
+    def test_greedy_longest_match(self, tok):
+        # "ab" in vocab beats "a"+"##b"
+        assert [tok.inv_vocab[i] for i in tok.encode("ab")] == ["ab"]
+        assert [tok.inv_vocab[i] for i in tok.encode("abc")] == \
+            ["ab", "##c"]
+
+    def test_punct_isolated_and_lowercase(self, tok):
+        ids = tok.encode("The quick, brown fox!")
+        toks = [tok.inv_vocab[i] for i in ids]
+        assert toks == ["the", "quick", ",", "brown", "fox", "!"]
+
+    def test_unsegmentable_word_is_single_unk(self, tok):
+        assert [tok.inv_vocab[i] for i in tok.encode("zzz quick")] == \
+            ["[UNK]", "quick"]
+
+    def test_decode_round_trip(self, tok):
+        ids = tok.encode("the quick brown fox jumped over the lazy dog")
+        assert tok.decode(ids) == \
+            "the quick brown fox jumped over the lazy dog"
+
+
+class TestNativeParity:
+    def test_native_active(self, tok):
+        assert tok.uses_native, "C core failed to build"
+
+    def test_matches_python_oracle(self, tok):
+        rng = np.random.RandomState(0)
+        pieces = ["the", "quick", "unaffable", "zzz", "ab", "abc",
+                  "jumping", "runns", ",", "!", "hello", "world'",
+                  "dog.", "a", "+++", "日本語"]
+        for _ in range(200):
+            text = " ".join(rng.choice(pieces,
+                                       size=rng.randint(1, 12)))
+            got = tok.encode(text)
+            want = tok._encode_py(text.lower())
+            assert got == want, (text, got, want)
+
+    def test_python_fallback_equivalent(self):
+        t2 = WordPieceTokenizer(VOCAB, use_native=False)
+        t1 = WordPieceTokenizer(VOCAB)
+        s = "the unaffable fox jumped, quick! zzz"
+        assert t1.encode(s) == t2.encode(s)
+
+
+class TestMultibyteAndLimits:
+    def test_multibyte_segmentation_parity(self):
+        # byte-greedy matching must not split multibyte chars wrongly
+        t = WordPieceTokenizer(["[UNK]", "a", "##é"])
+        for tok in (t, WordPieceTokenizer(["[UNK]", "a", "##é"],
+                                          use_native=False)):
+            ids = tok.encode("aé")
+            assert [tok.inv_vocab[i] for i in ids] == ["a", "##é"]
+
+    def test_long_word_cap_identical_both_paths(self):
+        t_native = WordPieceTokenizer(["[UNK]", "a", "##a"],
+                                      max_word_len=2000)
+        t_py = WordPieceTokenizer(["[UNK]", "a", "##a"],
+                                  max_word_len=2000, use_native=False)
+        long_word = "a" * 600
+        assert t_native.encode(long_word) == t_py.encode(long_word) == \
+            [0]   # both clamp to the same byte cap -> single [UNK]
