@@ -59,6 +59,23 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
+
+def _dot(a, b, dimension_numbers):
+    """``lax.dot_general`` with f32 accumulation and a Mosaic-legal precision.
+
+    The global ``jax_default_matmul_precision`` (e.g. "highest") leaks into
+    Pallas kernel traces, and Mosaic rejects fp32 contract precision on bf16
+    operands ("Bad lhs type").  Pin the precision from the operand dtypes
+    instead: the native MXU bf16 pass for bf16 inputs, exact fp32
+    contraction for f32 inputs (the hw parity test holds fp32 to 2e-5).
+    """
+    prec = (lax.Precision.HIGHEST
+            if (a.dtype == jnp.float32 and b.dtype == jnp.float32)
+            else lax.Precision.DEFAULT)
+    return lax.dot_general(a, b, dimension_numbers,
+                           preferred_element_type=jnp.float32,
+                           precision=prec)
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -72,8 +89,9 @@ def _stat_tile(x, width):
 
 
 def _block_sizes(seq_q: int, seq_k: int):
-    # swept on v5e at (8, 12, 2048, 64): 512/512 gives 2.5x over 128/128
-    # (small blocks starve the MXU when the contraction dim is only 64).
+    # swept on v5e at (8, 12, 2048, 64): 512/512 gives 1.6x over 128/128
+    # (19.3ms vs 30.4ms fwd+bwd; benchmarks/flash_block_sweep.json — small
+    # blocks starve the MXU when the contraction dim is only 64).
     # Fall back to the largest power-of-two block that divides the sequence
     # so every multiple of 128 stays supported; the resulting widths are
     # always either <=128 or a multiple of _LANES, which _stat_tile needs.
@@ -110,7 +128,10 @@ def _keep_mask(seed_u32, bh, rows, cols, dropout_p):
     x = x ^ (x >> 15)
     x = x * np.uint32(0x846CA68B)
     x = x ^ (x >> 16)
-    u = (x >> 8).astype(jnp.float32) * np.float32(1.0 / (1 << 24))
+    # top 24 bits as a uniform in [0, 1); route the cast through int32 —
+    # Mosaic has no uint32->float32 lowering, and the value fits 24 bits
+    u = ((x >> 8).astype(jnp.int32).astype(jnp.float32)
+         * np.float32(1.0 / (1 << 24)))
     return u >= dropout_p
 
 
@@ -140,8 +161,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
         rows = qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = j * block_k + lax.broadcasted_iota(
@@ -159,9 +179,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
             # normalizer l does not (dropout applies after normalization)
             p = jnp.where(_keep_mask(seed, bh, rows, cols, dropout_p),
                           p, 0.0)
-        acc_new = acc * alpha[:, None] + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha[:, None] + _dot(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())))
         return m_new, l_new, acc_new
 
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
@@ -233,8 +252,7 @@ def _dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = _stat_tile(lse_ref[0, pl.ds(i * block_q, block_q), :], block_k)
         delta = _stat_tile(
             delta_ref[0, pl.ds(i * block_q, block_q), :], block_k)
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
         rows = i * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = kj * block_k + lax.broadcasted_iota(
@@ -249,15 +267,12 @@ def _dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            p * keep_scale, 0.0)
         else:
             pd = p
-        dv_new = dv + lax.dot_general(
-            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+        dv_new = dv + _dot(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())))
+        dp = _dot(do, v, (((1,), (1,)), ((), ())))
         ds = (pd * dp - p * delta) * scale
-        dk_new = dk + lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dk_new = dk + _dot(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())))
         return dk_new, dv_new
 
     z = jnp.zeros((block_k, k.shape[1]), jnp.float32)
@@ -287,8 +302,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
         rows = qi * q.shape[0] + lax.broadcasted_iota(
             jnp.int32, (q.shape[0], block_k), 0)
         cols = j * block_k + lax.broadcasted_iota(
@@ -303,12 +317,10 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            p * keep_scale, 0.0)
         else:
             pd = p
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+        dp = _dot(do, v, (((1,), (1,)), ((), ())))
         ds = (pd * dp - p * delta) * scale
-        return dq + lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        return dq + _dot(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())))
 
     dq = lax.fori_loop(0, num_iter, body,
                        jnp.zeros((q.shape[0], q.shape[1]), jnp.float32))
@@ -458,8 +470,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k):
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
         cols = j * block_k + lax.broadcasted_iota(
             jnp.int32, (q.shape[0], block_k), 1)
         s = jnp.where(cols < kv_len, s, _NEG_INF)
@@ -467,9 +478,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k):
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha[:, None] + _dot(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())))
         return m_new, l_new, acc_new
 
     m0 = jnp.full((q.shape[0],), _NEG_INF, jnp.float32)

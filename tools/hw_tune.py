@@ -1,0 +1,44 @@
+"""One-off hardware tuning scan (round 5): block-size sweep for the flash
+kernel plus a batch-size scan of the headline 125M config.  Serializes with
+other chip users — run alone.  Results go to benchmarks/ via bench helpers.
+
+Usage: python tools/hw_tune.py [sweep|batch|all]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+
+def batch_scan():
+    import bench
+    from paddle_tpu.models import gpt_125m
+    rows = {}
+    for B in (8, 16, 32):
+        cfg = gpt_125m(dtype="bfloat16", hidden_dropout=0.0,
+                       attention_dropout=0.0, use_pallas_attention=True,
+                       max_position_embeddings=2048)
+        try:
+            tok_s, mfu = bench._bench_config(cfg, B=B, S=2048, steps=8,
+                                             warmup=3, tag=f"125m-B{B}")
+            rows[f"B{B}"] = {"tok_s": tok_s, "mfu": mfu}
+        except Exception as e:  # OOM at large B must not kill the scan
+            rows[f"B{B}"] = {"error": repr(e)}
+            print(f"[batch-scan B={B}] failed: {e!r}", file=sys.stderr)
+    bench._write_artifact("batch_scan_125m.json", rows)
+
+
+def main():
+    what = sys.argv[1] if len(sys.argv) > 1 else "all"
+    assert jax.devices()[0].platform == "tpu", jax.devices()
+    import bench
+    if what in ("sweep", "all"):
+        bench._sweep_block_sizes()
+    if what in ("batch", "all"):
+        batch_scan()
+
+
+if __name__ == "__main__":
+    main()
