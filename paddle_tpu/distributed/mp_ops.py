@@ -73,7 +73,14 @@ def parallel_cross_entropy(logits, label, mp_axis: str = "mp",
             .astype(jnp.int32), axis=-1)[..., 0]
         loss = jnp.log(sum_exp) - picked
 
-    valid = label != ignore_index
+    return masked_token_reduce(loss, label != ignore_index, reduction)
+
+
+def masked_token_reduce(loss, valid, reduction: str):
+    """Shared ignore-mask + reduction semantics for every CE flavor (this
+    module's vocab-parallel path and ops/fused.py's fused linear CE must
+    never diverge): invalid tokens contribute 0; "mean" divides by the
+    valid count (floor 1 for an all-ignored batch)."""
     loss = jnp.where(valid, loss, 0.0)
     if reduction == "mean":
         return jnp.sum(loss) / jnp.maximum(

@@ -88,6 +88,10 @@ class GPTConfig:
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01
     moe_every: int = 2
+    # memory-efficient LM loss (ops/fused.py linear_softmax_cross_entropy):
+    # never materializes the [B, S, V] logits/softmax — measured on v5e this
+    # is the top HLO temp of the naive path (benchmarks/batch_scan_125m.json)
+    fused_lm_loss: bool = True
 
     def is_moe_layer(self, index: int) -> bool:
         return (self.moe_num_experts > 0
@@ -353,15 +357,35 @@ class GPTForCausalLM(Layer):
         # tied head: logits = h @ wte.T → vocab-sharded over mp
         c = self.config
         table = self.gpt.wte.weight.value.astype(hidden.dtype)
-        logits = jnp.einsum("bsh,vh->bsv", hidden, table)
         seq_ax = ("sp" if c.sequence_parallel or c.context_parallel
                   else None)
-        logits = shard_constraint(logits, "dp", seq_ax, "mp")
+
+        def full_logits():
+            lg = jnp.einsum("bsh,vh->bsv", hidden, table)
+            return shard_constraint(lg, "dp", seq_ax, "mp")
+
         if labels is None:
-            return logits
-        loss = parallel_cross_entropy(
-            logits.astype(jnp.float32), shift_labels(labels),
-            reduction="mean")
+            return full_logits()
+        shifted = shift_labels(labels)
+        from ..distributed.mp_ops import _in_axis
+        from ..ops.fused import _lce_chunk, linear_softmax_cross_entropy
+        if (c.fused_lm_loss and not _in_axis("mp")
+                and _lce_chunk(hidden.shape[1]) is not None):
+            # memory-efficient path: loss from (hidden, table) directly —
+            # the full [B, S, V] logits are never built (the 16GB-chip
+            # budget that makes the full-vocab 1.3B trainable at all, see
+            # BASELINE.md), so the logits slot of the return is None; set
+            # fused_lm_loss=False to get (loss, logits)
+            loss = linear_softmax_cross_entropy(
+                hidden, table, shifted,
+                logits_spec=("dp", seq_ax, "mp"), reduction="mean")
+            logits = None
+        else:
+            # shard_map vocab-parallel contexts and irregular sequence
+            # lengths keep the c_softmax_with_cross_entropy path
+            logits = full_logits()
+            loss = parallel_cross_entropy(
+                logits.astype(jnp.float32), shifted, reduction="mean")
         if aux_losses:
             loss = loss + self.config.moe_aux_weight * sum(aux_losses)
         return loss, logits

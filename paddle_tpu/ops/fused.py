@@ -20,6 +20,7 @@ tests/test_ops.py).
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -104,3 +105,150 @@ def rotary_position_embedding(q, k, position_ids=None, base: float = 10000.0):
         return jnp.concatenate([r1, r2], axis=-1).astype(x.dtype)
 
     return rot(q), rot(k)
+
+
+# ---------------------------------------------------------------------------
+# Fused linear + softmax cross-entropy (memory-efficient LM loss).
+#
+# Reference semantics: the c_softmax_with_cross_entropy objective
+# (operators/collective/c_softmax_with_cross_entropy_op.cu) applied to a
+# tied-embedding LM head.  The naive composition materializes the full
+# [B, S, V] logits **twice** (bf16 matmul output + the f32 softmax
+# probabilities XLA saves for backward) — measured on v5e at GPT-125M
+# B=8/S=2048 that is ~4.5GB of HLO temps, and B=32 OOMs outright
+# (benchmarks/batch_scan_125m.json).  This op never materializes more than
+# one [B, chunk, V] block: forward scans over sequence chunks saving only
+# the per-token logsumexp; backward recomputes each chunk's logits and
+# fuses softmax-grad into the dW / dh matmuls.
+# ---------------------------------------------------------------------------
+def _lce_chunk(s: int, batch: int = 1, vocab: int = 0):
+    """Largest sequence chunk (a multiple of the 128-lane tile) dividing s
+    whose per-chunk f32 logits block [batch, chunk, vocab] stays under
+    ~1.6GB of HBM (the measured B=32 OOM headroom — batch_scan_125m.json);
+    None = sequence too irregular, caller should fall back to the unfused
+    path."""
+    budget = 1.6e9
+    best = None
+    for c in (512, 256, 128):
+        if s % c == 0:
+            best = best or c                   # largest divisor as fallback
+            if batch * c * vocab * 4 <= budget:
+                return c
+    return 128 if best else None               # smallest tile when over budget
+
+
+def _lce_constraint(logits, spec):
+    if spec is None:
+        return logits
+    from ..distributed.mp_layers import shard_constraint
+    return shard_constraint(logits, *spec)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _linear_ce(hidden, table, labels, chunk, spec):
+    loss, _ = _linear_ce_fwd(hidden, table, labels, chunk, spec)
+    return loss
+
+
+def _lce_split(x, chunk):
+    """[b, s, ...] -> [s/chunk, b, chunk, ...] (scan-major)."""
+    b, s = x.shape[0], x.shape[1]
+    x = x.reshape((b, s // chunk, chunk) + x.shape[2:])
+    return jnp.moveaxis(x, 1, 0)
+
+
+def _lce_merge(x):
+    """[n, b, chunk, ...] -> [b, n*chunk, ...]."""
+    x = jnp.moveaxis(x, 0, 1)
+    return x.reshape((x.shape[0], x.shape[1] * x.shape[2]) + x.shape[3:])
+
+
+def _linear_ce_fwd(hidden, table, labels, chunk, spec):
+    vocab = table.shape[0]
+    hs = _lce_split(hidden, chunk)
+    ls = _lce_split(labels, chunk)
+
+    def body(_, inp):
+        hc, lc = inp
+        logits = jnp.einsum("bch,vh->bcv", hc, table,
+                            preferred_element_type=jnp.float32)
+        logits = _lce_constraint(logits, spec)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(lc, 0, vocab - 1)[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        return 0, (lse, picked)
+
+    _, (lse, picked) = jax.lax.scan(body, 0, (hs, ls))
+    loss = _lce_merge(lse - picked)
+    return loss, _lce_merge(lse)
+
+
+def _linear_ce_fwd_rule(hidden, table, labels, chunk, spec):
+    loss, lse = _linear_ce_fwd(hidden, table, labels, chunk, spec)
+    return loss, (hidden, table, labels, lse)
+
+
+def _linear_ce_bwd_rule(chunk, spec, res, g):
+    import numpy as _np
+    hidden, table, labels, lse = res
+    vocab = table.shape[0]
+    hs = _lce_split(hidden, chunk)
+    ls = _lce_split(labels, chunk)
+    lses = _lce_split(lse, chunk)
+    gs = _lce_split(g, chunk)
+
+    def body(dw, inp):
+        hc, lc, lsec, gc = inp
+        logits = jnp.einsum("bch,vh->bcv", hc, table,
+                            preferred_element_type=jnp.float32)
+        logits = _lce_constraint(logits, spec)
+        p = jnp.exp(logits - lsec[..., None])
+        onehot = (lc[..., None] ==
+                  jax.lax.broadcasted_iota(lc.dtype, (1, 1, vocab), 2))
+        grad = ((p - onehot.astype(p.dtype))
+                * gc[..., None].astype(p.dtype)).astype(table.dtype)
+        dh = jnp.einsum("bcv,vh->bch", grad, table,
+                        preferred_element_type=jnp.float32)
+        dw = dw + jnp.einsum("bcv,bch->vh", grad, hc,
+                             preferred_element_type=jnp.float32)
+        return dw, dh.astype(hidden.dtype)
+
+    dw0 = jnp.zeros(table.shape, jnp.float32)
+    dw, dhs = jax.lax.scan(body, dw0, (hs, ls, lses, gs))
+    dh = _lce_merge(dhs)
+    return (dh, dw.astype(table.dtype),
+            _np.zeros(labels.shape, jax.dtypes.float0))
+
+
+_linear_ce.defvjp(_linear_ce_fwd_rule, _linear_ce_bwd_rule)
+
+
+def linear_softmax_cross_entropy(hidden, table, labels, *,
+                                 ignore_index: int = -100,
+                                 reduction: str = "mean",
+                                 seq_chunk: Optional[int] = None,
+                                 logits_spec=None):
+    """Cross-entropy of ``softmax(hidden @ table.T)`` against ``labels``
+    without materializing full logits (see module note above).
+
+    hidden: (b, s, h); table: (v, h) — e.g. a tied embedding; labels:
+    (b, s) int ids, ``ignore_index`` masked out.  ``logits_spec`` optionally
+    names mesh axes for the per-chunk logits (e.g. ("dp", None, "mp")) so
+    GSPMD keeps the vocab dimension sharded through the scan.  Falls back
+    to the unfused path when the sequence has no 128-multiple chunking.
+    """
+    hidden, table, labels = _arr(hidden), _arr(table), _arr(labels)
+    b, s, _ = hidden.shape
+    chunk = (seq_chunk if seq_chunk is not None
+             else _lce_chunk(s, b, table.shape[0]))
+    if chunk is None or s % chunk != 0:
+        from ..distributed.mp_ops import parallel_cross_entropy
+        logits = jnp.einsum("bsh,vh->bsv", hidden, table)
+        return parallel_cross_entropy(
+            logits.astype(jnp.float32), labels,
+            ignore_index=ignore_index, reduction=reduction)
+    spec = tuple(logits_spec) if logits_spec is not None else None
+    loss = _linear_ce(hidden, table, labels.astype(jnp.int32), chunk, spec)
+    from ..distributed.mp_ops import masked_token_reduce
+    return masked_token_reduce(loss, labels != ignore_index, reduction)

@@ -346,3 +346,97 @@ class TestFusionEvidence:
         # mean-subtract, var-normalize, scale, shift) would write the
         # full tensor 7+ times; fused it is <= 4 kernel outputs
         assert len(producing) <= 4, (len(producing), producing)
+
+
+class TestLinearCrossEntropy:
+    """ops/fused.py linear_softmax_cross_entropy — the memory-efficient LM
+    loss (c_softmax_with_cross_entropy objective without materialized
+    logits; see benchmarks/batch_scan_125m.json for the motivating OOM)."""
+
+    def _ref(self, hid, W, lab, ignore=-100):
+        logits = jnp.einsum("bsh,vh->bsv", hid, W).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, -1)
+        v = W.shape[0]
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(lab, 0, v - 1)[..., None], -1)[..., 0]
+        tok = jnp.where(lab != ignore, lse - picked, 0.0)
+        return jnp.sum(tok) / jnp.sum((lab != ignore).astype(jnp.float32))
+
+    @pytest.mark.quick
+    def test_loss_and_grad_parity(self):
+        from paddle_tpu.ops.fused import linear_softmax_cross_entropy
+        rng = np.random.RandomState(0)
+        hid = jnp.asarray(rng.randn(2, 256, 32) * 0.4, jnp.float32)
+        W = jnp.asarray(rng.randn(97, 32) * 0.4, jnp.float32)
+        lab = rng.randint(0, 97, (2, 256))
+        lab[0, :9] = -100                      # ignore_index tokens
+        lab = jnp.asarray(lab, jnp.int32)
+        with jax.default_matmul_precision("highest"):
+            got = linear_softmax_cross_entropy(hid, W, lab)
+            want = self._ref(hid, W, lab)
+            assert abs(float(got - want)) < 1e-6
+            g = jax.grad(lambda h, w: linear_softmax_cross_entropy(
+                h, w, lab), argnums=(0, 1))(hid, W)
+            gr = jax.grad(lambda h, w: self._ref(h, w, lab),
+                          argnums=(0, 1))(hid, W)
+            for a, b in zip(g, gr):
+                assert float(jnp.max(jnp.abs(a - b))) < 1e-6
+
+    def test_reductions_and_fallback(self):
+        from paddle_tpu.ops.fused import linear_softmax_cross_entropy
+        rng = np.random.RandomState(1)
+        hid = jnp.asarray(rng.randn(1, 128, 16) * 0.4, jnp.float32)
+        W = jnp.asarray(rng.randn(33, 16) * 0.4, jnp.float32)
+        lab = jnp.asarray(rng.randint(0, 33, (1, 128)), jnp.int32)
+        with jax.default_matmul_precision("highest"):
+            tok = linear_softmax_cross_entropy(hid, W, lab, reduction="none")
+            assert tok.shape == (1, 128)
+            s = linear_softmax_cross_entropy(hid, W, lab, reduction="sum")
+            assert abs(float(jnp.sum(tok) - s)) < 1e-5
+            # s=100 has no 128-chunking -> unfused fallback, same numbers
+            f = linear_softmax_cross_entropy(hid[:, :100], W, lab[:, :100])
+            r = self._ref(hid[:, :100], W, lab[:, :100])
+            assert abs(float(f - r)) < 1e-6
+
+    def test_gpt_fused_flag_parity(self):
+        """Model-level: fused_lm_loss=True must match the unfused path
+        (loss AND a parameter gradient) on a tiny config."""
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        rng = np.random.RandomState(2)
+        ids = jnp.asarray(rng.randint(0, 1024, (2, 128)), jnp.int32)
+        losses, grads = {}, {}
+        for fused in (True, False):
+            pt.seed(0)
+            m = GPTForCausalLM(gpt_tiny(max_position_embeddings=128,
+                                        hidden_dropout=0.0,
+                                        attention_dropout=0.0,
+                                        fused_lm_loss=fused))
+            m.train()
+            params = m.state_dict()
+
+            def lf(p):
+                loss, _ = m.apply(p, ids, labels=ids)
+                return loss
+
+            with jax.default_matmul_precision("highest"):
+                losses[fused] = float(lf(params))
+                g = jax.grad(lf)(params)
+            grads[fused] = g["gpt.wte.weight"]
+        assert abs(losses[True] - losses[False]) < 1e-5, losses
+        err = float(jnp.max(jnp.abs(grads[True] - grads[False])))
+        assert err < 1e-5, err
+
+    def test_bf16_path_finite_and_close(self):
+        from paddle_tpu.ops.fused import linear_softmax_cross_entropy
+        rng = np.random.RandomState(3)
+        hid = jnp.asarray(rng.randn(2, 256, 32) * 0.4, jnp.bfloat16)
+        W = jnp.asarray(rng.randn(97, 32) * 0.4, jnp.bfloat16)
+        lab = jnp.asarray(rng.randint(0, 97, (2, 256)), jnp.int32)
+        got = linear_softmax_cross_entropy(hid, W, lab)
+        want = self._ref(hid.astype(jnp.float32),
+                         W.astype(jnp.float32), lab)
+        assert bool(jnp.isfinite(got))
+        assert abs(float(got - want)) < 5e-2
+        g = jax.grad(lambda h: linear_softmax_cross_entropy(h, W, lab))(hid)
+        assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
