@@ -39,6 +39,8 @@ _QUICK_MODULES = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "quick: fast cross-section tier (<90s; see README.md)")
+    config.addinivalue_line(
+        "markers", "slow: heavyweight tests, deselect with -m 'not slow'")
 
 
 def pytest_collection_modifyitems(config, items):

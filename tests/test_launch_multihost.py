@@ -40,6 +40,14 @@ def test_launch_nnodes2_global_psum(tmp_path):
     # children must not inherit a single-process cluster config
     for k in ["PADDLE_MASTER", "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID"]:
         env.pop(k, None)
+    # nor the CI harness's forced 8-device CPU mesh — the proof needs
+    # exactly one local device per "host" so the psum must cross processes
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nnodes", "2", "--master", f"127.0.0.1:{_free_port()}",
