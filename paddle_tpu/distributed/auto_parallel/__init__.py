@@ -209,3 +209,8 @@ def shard_op(op_fn: Callable, dist_attr: Optional[Dict[Any, Any]] = None):
         return out
 
     return wrapper
+
+
+from .engine import Engine  # noqa: E402,F401
+
+__all__.append("Engine")
