@@ -3,7 +3,10 @@ ModelAverage optimizers, incubate.nn fused transformer layers,
 softmax_mask_fuse ops)."""
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import sparsity  # noqa: F401 (ASP n:m structured pruning)
+from .graph_ops import graph_send_recv  # noqa: F401
 from ..nn.functional import (  # noqa: F401
     softmax_mask_fuse_upper_triangle)
 
-__all__ = ["nn", "optimizer", "softmax_mask_fuse_upper_triangle"]
+__all__ = ["nn", "optimizer", "sparsity", "graph_send_recv",
+           "softmax_mask_fuse_upper_triangle"]

@@ -1,0 +1,57 @@
+"""Graph-learning message passing (reference:
+python/paddle/incubate/operators/graph_send_recv.py:22 graph_send_recv).
+
+The reference lowers to a dedicated CUDA scatter-reduce kernel
+(operators/graph_send_recv_op.cu); on TPU the same semantics are XLA
+segment reductions — gather rows by ``src_index``, segment-reduce into
+``dst_index`` — which fuse into the surrounding program instead of a
+standalone kernel.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+
+__all__ = ["graph_send_recv"]
+
+_POOLS = ("sum", "mean", "max", "min")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type: str = "sum",
+                    out_size: Optional[int] = None, name=None):
+    """Gather ``x[src_index]`` and scatter-reduce into row ``dst_index``.
+
+    Rows of the output that receive no message are 0 (reference kernel
+    initializes the output buffer to zeros for every pool type).
+    ``out_size`` fixes the number of output rows (defaults to
+    ``x.shape[0]``, the reference default).
+    """
+    enforce(pool_type in _POOLS,
+            f"pool_type must be one of {_POOLS}, got {pool_type!r}")
+    x = jnp.asarray(x)
+    src = jnp.asarray(src_index, jnp.int32)
+    dst = jnp.asarray(dst_index, jnp.int32)
+    enforce(src.ndim == 1 and dst.ndim == 1 and src.shape == dst.shape,
+            f"src/dst_index must be equal-length 1-D, got {src.shape} "
+            f"vs {dst.shape}")
+    n = int(out_size) if out_size is not None else x.shape[0]
+    gathered = x[src]
+    if pool_type == "sum":
+        return jax.ops.segment_sum(gathered, dst, num_segments=n)
+    counts = jax.ops.segment_sum(jnp.ones_like(dst, x.dtype), dst,
+                                 num_segments=n)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(gathered, dst, num_segments=n)
+        denom = jnp.maximum(counts, 1).reshape((-1,) + (1,) * (x.ndim - 1))
+        return s / denom
+    if pool_type == "max":
+        r = jax.ops.segment_max(gathered, dst, num_segments=n)
+    else:
+        r = jax.ops.segment_min(gathered, dst, num_segments=n)
+    # empty segments come back +/-inf from XLA; the reference zero-fills
+    empty = (counts == 0).reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(empty, jnp.zeros_like(r), r)

@@ -324,3 +324,142 @@ class TestDistributedFusedLamb:
         out = F.linear(jnp.ones((4, 8)), jnp.ones((8, 16)),
                        jnp.asarray(0.5))
         np.testing.assert_allclose(np.asarray(out), 8.5)
+
+
+class TestGraphSendRecv:
+    """graph_send_recv (reference incubate/operators/graph_send_recv.py:22)
+    — the docstring example plus all pool types vs a numpy oracle."""
+
+    def test_reference_docstring_example(self):
+        from paddle_tpu.incubate import graph_send_recv
+        x = jnp.asarray([[0, 2, 3], [1, 4, 5], [2, 6, 7]], jnp.float32)
+        src = jnp.asarray([0, 1, 2, 0], jnp.int32)
+        dst = jnp.asarray([1, 2, 1, 0], jnp.int32)
+        out = graph_send_recv(x, src, dst, pool_type="sum")
+        np.testing.assert_array_equal(
+            np.asarray(out), [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+    @pytest.mark.parametrize("pool", ["sum", "mean", "max", "min"])
+    def test_pools_vs_numpy(self, pool):
+        from paddle_tpu.incubate import graph_send_recv
+        R = np.random.RandomState(0)
+        x = R.randn(6, 4).astype(np.float32)
+        src = R.randint(0, 6, (12,)).astype(np.int32)
+        dst = R.randint(0, 5, (12,)).astype(np.int32)   # row 5 stays empty
+        out = np.asarray(graph_send_recv(x, src, dst, pool_type=pool))
+        ref = np.zeros((6, 4), np.float32)
+        for row in range(6):
+            msgs = x[src[dst == row]]
+            if len(msgs) == 0:
+                continue
+            ref[row] = {"sum": msgs.sum(0), "mean": msgs.mean(0),
+                        "max": msgs.max(0), "min": msgs.min(0)}[pool]
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(out[5], 0.0)      # empty row zeroed
+
+    def test_out_size_and_jit(self):
+        from paddle_tpu.incubate import graph_send_recv
+        x = jnp.ones((4, 2))
+        src = jnp.asarray([0, 1], jnp.int32)
+        dst = jnp.asarray([0, 0], jnp.int32)
+        out = jax.jit(lambda *a: graph_send_recv(*a, pool_type="sum",
+                                                 out_size=2))(x, src, dst)
+        assert out.shape == (2, 2)
+        np.testing.assert_array_equal(np.asarray(out[0]), 2.0)
+
+
+class TestASP:
+    """ASP n:m pruning (reference fluid/contrib/sparsity; asp.py:289
+    ASPHelper).  The speedup half is N/A on TPU (no sparse MXU mode); the
+    capability half — masks, pruning, sparsity-preserving optimizer — is
+    what these assert."""
+
+    def test_mask_1d_reference_convention_n_is_zeros(self):
+        # n:m = at least n ZEROS per 1 x m block (reference utils.py:181):
+        # 1:4 zeroes one of every four -> density 0.75
+        from paddle_tpu.incubate import sparsity
+        mat = np.random.RandomState(5).randn(4, 8).astype(np.float32)
+        mask = sparsity.get_mask_1d(mat, 1, 4)
+        assert abs(sparsity.calculate_density(mask) - 0.75) < 1e-6
+        assert sparsity.check_mask_1d(mat * mask, 1, 4)
+        assert not sparsity.check_mask_1d(mat, 1, 4)  # dense fails
+
+    def test_mask_1d_pattern_and_checkers(self):
+        from paddle_tpu.incubate import sparsity
+        R = np.random.RandomState(0)
+        mat = R.randn(8, 16).astype(np.float32)
+        mask = sparsity.get_mask_1d(mat, 2, 4)
+        assert sparsity.check_mask_1d(mat * mask, 2, 4)
+        assert abs(sparsity.calculate_density(mat * mask) - 0.5) < 1e-6
+        # keeps the largest-magnitude pair of every group of 4
+        groups = np.abs(mat).reshape(-1, 4)
+        kept = (mask.reshape(-1, 4) > 0)
+        for g, k in zip(groups, kept):
+            assert set(np.argsort(-g)[:2]) == set(np.nonzero(k)[0])
+
+    @pytest.mark.parametrize("algo", ["mask_2d_greedy", "mask_2d_best"])
+    def test_mask_2d_valid(self, algo):
+        from paddle_tpu.incubate import sparsity
+        R = np.random.RandomState(1)
+        mat = R.randn(8, 8).astype(np.float32)
+        fn = getattr(sparsity, "get_" + algo)
+        mask = fn(mat, 2, 4)
+        assert sparsity.check_mask_2d(mat * mask, 2, 4)
+        assert abs(sparsity.calculate_density(mask) - 0.5) < 1e-6
+
+    def test_mask_2d_best_beats_or_ties_greedy(self):
+        from paddle_tpu.incubate import sparsity
+        R = np.random.RandomState(2)
+        mat = R.randn(16, 16).astype(np.float32)
+        g = np.abs(mat * sparsity.get_mask_2d_greedy(mat, 2, 4)).sum()
+        b = np.abs(mat * sparsity.get_mask_2d_best(mat, 2, 4)).sum()
+        assert b >= g - 1e-5
+
+    def test_conv_weight_mask_shape(self):
+        from paddle_tpu.incubate import sparsity
+        w = np.random.RandomState(3).randn(8, 4, 3, 3).astype(np.float32)
+        mask = sparsity.create_mask(w, sparsity.MaskAlgo.MASK_1D, 2, 4)
+        assert mask.shape == w.shape
+        assert sparsity.check_sparsity(w * mask,
+                                       sparsity.CheckMethod.CHECK_1D, 2, 4)
+
+    def test_prune_model_and_decorated_optimizer_preserve_sparsity(self):
+        from paddle_tpu.incubate import sparsity
+        sparsity.reset_excluded_layers()
+        pt.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
+                              nn.Linear(32, 4))
+        masks = sparsity.prune_model(model, mask_algo="mask_1d")
+        assert len(masks) == 2              # the two Linear weights
+        for name, p in model.named_parameters():
+            if name in masks:
+                assert sparsity.check_sparsity(np.asarray(p.value))
+
+        opt = sparsity.decorate(
+            pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                  weight_decay=0.01))
+        params = model.trainable_variables()
+        state = opt.init(params)
+        R = np.random.RandomState(0)
+        x = jnp.asarray(R.randn(8, 16), jnp.float32)
+        y = jnp.asarray(R.randint(0, 4, (8,)), jnp.int32)
+        for _ in range(3):
+            def loss_fn(p):
+                return nn.functional.cross_entropy(model.apply(p, x), y)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.apply_gradients(grads, params, state)
+        # momentum + weight decay would densify without the guard
+        for name in masks:
+            assert sparsity.check_sparsity(np.asarray(params[name])), name
+        sparsity.reset_masks()
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import sparsity
+        sparsity.reset_excluded_layers()
+        pt.seed(0)
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        sparsity.set_excluded_layers(["0.weight"])
+        masks = sparsity.prune_model(model)
+        assert "0.weight" not in masks and "1.weight" in masks
+        sparsity.reset_excluded_layers()
+        sparsity.reset_masks()
