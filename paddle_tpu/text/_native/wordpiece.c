@@ -29,6 +29,7 @@ typedef struct {
     char *packed;          /* owned copy of the NUL-joined vocab */
     const char **words;    /* sorted pointers into packed */
     int32_t n;
+    int32_t maxlen;        /* longest vocab token in bytes (incl. "##") */
 } wp_t;
 
 void *wp_new(const char *packed, const int64_t *offsets, int32_t n,
@@ -40,7 +41,12 @@ void *wp_new(const char *packed, const int64_t *offsets, int32_t n,
     if (!h->packed || !h->words) { free(h->packed); free(h->words);
                                    free(h); return 0; }
     memcpy(h->packed, packed, (size_t)packed_len);
-    for (int32_t i = 0; i < n; i++) h->words[i] = h->packed + offsets[i];
+    h->maxlen = 1;
+    for (int32_t i = 0; i < n; i++) {
+        h->words[i] = h->packed + offsets[i];
+        int32_t l = (int32_t)strlen(h->words[i]);
+        if (l > h->maxlen) h->maxlen = l;
+    }
     h->n = n;
     return h;
 }
@@ -88,17 +94,22 @@ static int64_t wp_word(const wp_t *h, const char *w, int wlen,
     int start = 0;
     int64_t first = pos;
     while (start < wlen) {
+        /* trials longer than the longest vocab token can never match;
+         * with a "##" prefix the budget shrinks by 2 */
+        int maxsub = (start > 0) ? h->maxlen - 2 : h->maxlen;
+        if (maxsub < 1) maxsub = 1;
         int end = wlen, found = -1;
+        if (end > start + maxsub) end = start + maxsub;
+        const char *sub = w + start;
+        if (start > 0) {
+            /* copy the remaining word ONCE per start; trials only vary
+             * the length */
+            buf[0] = '#'; buf[1] = '#';
+            memcpy(buf + 2, w + start, (size_t)(wlen - start));
+            sub = buf;
+        }
         while (end > start) {
-            int sublen = end - start;
-            const char *sub;
-            if (start > 0) {
-                buf[0] = '#'; buf[1] = '#';
-                memcpy(buf + 2, w + start, (size_t)sublen);
-                sub = buf; sublen += 2;
-            } else {
-                sub = w + start;
-            }
+            int sublen = end - start + (start > 0 ? 2 : 0);
             found = wp_lookup(h, sub, sublen);
             if (found >= 0) break;
             end--;

@@ -144,8 +144,12 @@ class WordPieceTokenizer:
                 self.max_word_len,
                 out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
             ids = out[:min(n, cap)]
-            return [self.unk_id if i < 0 else int(self._id_remap[i])
-                    for i in ids]
+            # vectorized remap: a python per-token loop here dominates the
+            # whole encode for MB-scale inputs (C core output is sorted-
+            # table indices; <0 marks UNK)
+            remapped = np.where(ids < 0, np.int32(self.unk_id),
+                                self._id_remap[np.clip(ids, 0, None)])
+            return remapped.tolist()
         return self._encode_py(text)
 
     def encode_batch(self, texts: Sequence[str]) -> List[List[int]]:
