@@ -525,6 +525,46 @@ def equal_all(x, y):
 no_grad = autograd.no_grad
 grad = autograd.grad
 
+# execution-mode toggles (recorded state; one codepath — framework/mode.py)
+from .framework.mode import (  # noqa: E402
+    enable_static, disable_static, in_dynamic_mode, set_grad_enabled,
+    is_grad_enabled)
+
+# Keras-style Model at the top level (reference paddle.Model = hapi.Model)
+Model = hapi.Model
+
+
+def is_compiled_with_cuda() -> bool:
+    """False by construction — this build targets TPU via XLA (reference
+    paddle.is_compiled_with_cuda; the whole WITH_GPU family answers No)."""
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def get_cudnn_version():
+    """None — no cuDNN in a TPU build (reference device.py
+    get_cudnn_version returns None when not compiled with CUDA)."""
+    return None
+
 
 def stop_gradient(x):
     return jax.lax.stop_gradient(_arr(x))

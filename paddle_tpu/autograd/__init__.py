@@ -36,17 +36,23 @@ def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
 class no_grad:
     """Context/decorator: stop gradients through the wrapped computation.
     In functional JAX there is no global tape; this is provided for API parity
-    and wraps outputs in stop_gradient when used as a decorator."""
+    and wraps outputs in stop_gradient when used as a decorator.  Inside the
+    context ``paddle_tpu.is_grad_enabled()`` reports False (reference
+    dygraph/base.py interplay)."""
 
     def __enter__(self):
+        from ..framework.mode import set_grad_enabled
+        self._cm = set_grad_enabled(False)
+        self._cm.__enter__()
         return self
 
     def __exit__(self, *exc):
-        return False
+        return self._cm.__exit__(*exc)
 
     def __call__(self, fn):
         def wrapper(*args, **kwargs):
-            out = fn(*args, **kwargs)
+            with self:
+                out = fn(*args, **kwargs)
             return jax.tree_util.tree_map(jax.lax.stop_gradient, out)
         return wrapper
 

@@ -1,0 +1,60 @@
+"""Global execution-mode state (reference: paddle.enable_static /
+disable_static / in_dynamic_mode, fluid/framework.py; set_grad_enabled /
+is_grad_enabled, fluid/dygraph/base.py).
+
+There is one codepath here (un-jitted JAX = dygraph, jitted = static), so
+these toggles are recorded STATE, not a switch between two runtimes: the
+static facade (`paddle_tpu.static`) works identically in either mode, and
+ported scripts that open with ``paddle.enable_static()`` run unchanged.
+Grad mode interoperates with ``paddle_tpu.no_grad``: inside a
+``no_grad``/``set_grad_enabled(False)`` region ``is_grad_enabled()`` is
+False and decorated functions stop gradients.
+"""
+from __future__ import annotations
+
+__all__ = ["enable_static", "disable_static", "in_dynamic_mode",
+           "set_grad_enabled", "is_grad_enabled"]
+
+_static_mode = False
+_grad_enabled = True
+
+
+def enable_static() -> None:
+    """Record static mode (reference paddle.enable_static).  The one-jit
+    design needs no runtime switch; this keeps ported scripts working and
+    makes ``in_dynamic_mode()`` answer like the reference."""
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static() -> None:
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+class set_grad_enabled:
+    """Context manager mirroring reference fluid/dygraph/base.py — usable
+    as ``with set_grad_enabled(False): ...``; the mode applies at with-entry
+    (like the reference contextmanager), nests, and is re-enterable."""
+
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False

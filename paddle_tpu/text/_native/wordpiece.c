@@ -102,10 +102,10 @@ static int64_t wp_word(const wp_t *h, const char *w, int wlen,
         if (end > start + maxsub) end = start + maxsub;
         const char *sub = w + start;
         if (start > 0) {
-            /* copy the remaining word ONCE per start; trials only vary
-             * the length */
+            /* copy once per start (trials only vary the length) — and only
+             * the bytes the clamped longest trial can use */
             buf[0] = '#'; buf[1] = '#';
-            memcpy(buf + 2, w + start, (size_t)(wlen - start));
+            memcpy(buf + 2, w + start, (size_t)(end - start));
             sub = buf;
         }
         while (end > start) {

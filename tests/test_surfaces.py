@@ -209,3 +209,57 @@ class TestViterbi:
             np.testing.assert_allclose(float(scores[b]), want_s, rtol=1e-5)
             got = list(np.asarray(paths[b][: lengths[b]]))
             assert got == want_p, (b, got, want_p)
+
+
+class TestExecutionMode:
+    """enable_static/disable_static/in_dynamic_mode + grad-mode toggles
+    (reference fluid/framework.py + dygraph/base.py): recorded state over
+    the one-codepath design — ported scripts' mode calls run unchanged."""
+
+    def test_static_toggle(self):
+        import paddle_tpu as pt
+        assert pt.in_dynamic_mode()
+        pt.enable_static()
+        try:
+            assert not pt.in_dynamic_mode()
+        finally:
+            pt.disable_static()
+        assert pt.in_dynamic_mode()
+
+    def test_grad_mode_interop_with_no_grad(self):
+        import paddle_tpu as pt
+        assert pt.is_grad_enabled()
+        with pt.no_grad():
+            assert not pt.is_grad_enabled()
+            with pt.set_grad_enabled(True):
+                assert pt.is_grad_enabled()
+            assert not pt.is_grad_enabled()
+        assert pt.is_grad_enabled()
+
+    def test_set_grad_enabled_reenterable(self):
+        import paddle_tpu as pt
+        cm = pt.set_grad_enabled(False)
+        with cm:
+            assert not pt.is_grad_enabled()
+        assert pt.is_grad_enabled()       # construction alone must not flip
+        with cm:
+            assert not pt.is_grad_enabled()
+        assert pt.is_grad_enabled()
+
+    def test_no_grad_decorator_stops_gradients(self):
+        import jax, jax.numpy as jnp
+        import paddle_tpu as pt
+
+        @pt.no_grad()
+        def f(x):
+            return x * 3.0
+
+        g = jax.grad(lambda x: f(x).sum())(jnp.ones((2,)))
+        assert float(jnp.abs(g).sum()) == 0.0
+
+    def test_compiled_with_family_and_model(self):
+        import paddle_tpu as pt
+        assert not pt.is_compiled_with_cuda()
+        assert not pt.is_compiled_with_rocm()
+        assert not pt.is_compiled_with_xpu()
+        assert pt.Model is pt.hapi.Model
