@@ -40,9 +40,13 @@ class no_grad:
     context ``paddle_tpu.is_grad_enabled()`` reports False (reference
     dygraph/base.py interplay)."""
 
-    def __enter__(self):
+    def __init__(self):
         from ..framework.mode import set_grad_enabled
+        # one stateful cm whose internal stack makes this instance safely
+        # re-enterable (nested `with ng`, recursive decorated functions)
         self._cm = set_grad_enabled(False)
+
+    def __enter__(self):
         self._cm.__enter__()
         return self
 
@@ -50,6 +54,9 @@ class no_grad:
         return self._cm.__exit__(*exc)
 
     def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             with self:
                 out = fn(*args, **kwargs)

@@ -47,14 +47,17 @@ class set_grad_enabled:
 
     def __init__(self, mode: bool):
         self._mode = bool(mode)
+        # a stack, not a slot: the same instance may be entered while
+        # already active (nested `with cm`, recursive decorated fns)
+        self._prev = []
 
     def __enter__(self):
         global _grad_enabled
-        self._prev = _grad_enabled
+        self._prev.append(_grad_enabled)
         _grad_enabled = self._mode
         return self
 
     def __exit__(self, *exc):
         global _grad_enabled
-        _grad_enabled = self._prev
+        _grad_enabled = self._prev.pop()
         return False

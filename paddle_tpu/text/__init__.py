@@ -16,11 +16,12 @@ from jax import lax
 from . import datasets  # noqa: F401
 from .tokenizer import WordPieceTokenizer  # noqa: F401
 from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
-                       UCIHousing)
+                       MovieInfo, UCIHousing, UserInfo, WMT14, WMT16)
 
 __all__ = ["WordPieceTokenizer",
            "viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
-           "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+           "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "MovieInfo", "UserInfo", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition, lengths=None,

@@ -20,7 +20,8 @@ import numpy as np
 from ..framework.errors import enforce
 from ..io import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "MovieInfo", "UserInfo", "WMT14", "WMT16"]
 
 
 class Imdb(Dataset):
@@ -198,3 +199,158 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.users)
+
+
+# Movielens record types (reference text/datasets/movielens.py:37,62):
+# feature-extraction helpers kept for API parity with scripts that
+# introspect the raw corpus records.
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Movie id, title and categories (reference movielens.py:37)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+    def __str__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+    __repr__ = __str__
+
+
+class UserInfo:
+    """User id, gender, age bucket and job (reference movielens.py:62)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+    def __str__(self):
+        return (f"<UserInfo id({self.index}), gender({self.is_male}), "
+                f"age({self.age}), job({self.job_id})>")
+
+    __repr__ = __str__
+
+
+class _WMTBase(Dataset):
+    """Shared synthetic seq2seq machinery for WMT14/WMT16.
+
+    Items follow the reference schema (wmt14.py:169-171): a tuple of
+    (src_ids, trg_ids, trg_ids_next) where trg_ids is <s>-prefixed and
+    trg_ids_next is </e>-suffixed.  The synthetic task is learnable:
+    the target sequence is the source sequence mapped through a fixed
+    random permutation of the dict (a toy "translation"), so a seq2seq
+    model can drive the loss to zero.
+    """
+
+    START_ID, END_ID, UNK_ID = 0, 1, 2
+    _N_SPECIAL = 3
+
+    def _build(self, n: int, seed: int, src_size: int, trg_size: int,
+               min_len: int = 4, max_len: int = 16):
+        rng = np.random.RandomState(seed)
+        content = min(src_size, trg_size) - self._N_SPECIAL
+        enforce(content > 0, "dict_size must exceed the 3 special tokens")
+        perm = np.arange(content)
+        rng.shuffle(perm)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for _ in range(n):
+            L = rng.randint(min_len, max_len + 1)
+            src = rng.randint(0, content, L)
+            trg = perm[src]
+            self.src_ids.append((src + self._N_SPECIAL).astype(np.int64))
+            self.trg_ids.append(np.concatenate(
+                [[self.START_ID], trg + self._N_SPECIAL]).astype(np.int64))
+            self.trg_ids_next.append(np.concatenate(
+                [trg + self._N_SPECIAL, [self.END_ID]]).astype(np.int64))
+
+    @staticmethod
+    def _make_dict(size: int, prefix: str, reverse: bool):
+        words = {0: "<s>", 1: "<e>", 2: "<unk>"}
+        for i in range(3, size):
+            words[i] = f"{prefix}{i}"
+        if reverse:
+            return words
+        return {w: i for i, w in words.items()}
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx],
+                self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_WMTBase):
+    """EN→FR translation token streams (reference text/datasets/wmt14.py:42).
+
+    Zero-egress synthetic stand-in; ``data_file`` parsing of the reference
+    tarball format is not supported here and raises.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = 30000,
+                 synthetic_size: Optional[int] = None):
+        enforce(data_file is None,
+                "WMT14 corpus parsing is not supported in this "
+                "environment; omit data_file for the synthetic schema")
+        enforce(mode in ("train", "test", "gen"),
+                "mode must be train|test|gen")
+        enforce(dict_size > 0, "dict_size should be set as positive number")
+        self.mode = mode
+        self.dict_size = dict_size
+        n = synthetic_size or {"train": 4096, "test": 512, "gen": 128}[mode]
+        self._build(n, {"train": 41, "test": 43, "gen": 47}[mode],
+                    dict_size, dict_size)
+
+    def get_dict(self, reverse: bool = False):
+        """(src_dict, trg_dict); id→word when reverse (wmt14.py:176)."""
+        return (self._make_dict(self.dict_size, "en", reverse),
+                self._make_dict(self.dict_size, "fr", reverse))
+
+
+class WMT16(_WMTBase):
+    """EN↔DE translation token streams (reference text/datasets/wmt16.py:43)
+    with per-language dict sizes.  Zero-egress synthetic stand-in."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en", synthetic_size: Optional[int] = None):
+        enforce(data_file is None,
+                "WMT16 corpus parsing is not supported in this "
+                "environment; omit data_file for the synthetic schema")
+        enforce(mode in ("train", "test", "val"),
+                "mode must be train|test|val")
+        enforce(lang in ("en", "de"), "lang must be en|de")
+        enforce(src_dict_size > 0 and trg_dict_size > 0,
+                "dict_size should be set as positive number")
+        self.mode = mode
+        self.lang = lang
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        n = synthetic_size or {"train": 4096, "test": 512, "val": 512}[mode]
+        self._build(n, {"train": 53, "test": 59, "val": 61}[mode],
+                    src_dict_size, trg_dict_size)
+
+    def get_dict(self, lang: str, reverse: bool = False):
+        """Word dict for ``lang`` ('en'|'de'); id→word when reverse
+        (wmt16.py get_dict)."""
+        enforce(lang in ("en", "de"), "lang must be en|de")
+        size = (self.src_dict_size if lang == self.lang
+                else self.trg_dict_size)
+        return self._make_dict(size, lang, reverse)

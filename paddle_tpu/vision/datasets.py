@@ -203,15 +203,31 @@ class VOC2012(Dataset):
         return len(self.images)
 
 
+# reference folder.py IMG_EXTENSIONS — stray non-image files (README,
+# .DS_Store, csv sidecars) must not enter the sample list
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+def _default_loader(path):
+    return np.asarray(__import__("PIL.Image", fromlist=["open"]).open(path))
+
+
+def _has_valid_ext(fname: str, extensions) -> bool:
+    return fname.lower().endswith(tuple(extensions))
+
+
 class DatasetFolder(Dataset):
-    """Reference: vision/datasets/folder.py — class-per-subdir image tree."""
+    """Reference: vision/datasets/folder.py — class-per-subdir image tree.
+    Only files matching ``extensions`` (IMG_EXTENSIONS by default) are
+    indexed; an empty result raises like the reference."""
 
     def __init__(self, root: str, transform: Optional[Callable] = None,
-                 loader: Optional[Callable] = None):
+                 loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS):
         self.root = root
         self.transform = transform
-        self.loader = loader or (lambda p: np.asarray(
-            __import__("PIL.Image", fromlist=["open"]).open(p)))
+        self.loader = loader or _default_loader
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
@@ -219,8 +235,13 @@ class DatasetFolder(Dataset):
         for c in classes:
             cdir = os.path.join(root, c)
             for fname in sorted(os.listdir(cdir)):
-                self.samples.append((os.path.join(cdir, fname),
-                                     self.class_to_idx[c]))
+                if _has_valid_ext(fname, extensions):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of {root}; supported "
+                f"extensions: {','.join(extensions)}")
 
     def __getitem__(self, idx):
         path, label = self.samples[idx]
@@ -233,4 +254,32 @@ class DatasetFolder(Dataset):
         return len(self.samples)
 
 
-ImageFolder = DatasetFolder
+class ImageFolder(Dataset):
+    """Reference: vision/datasets/folder.py ImageFolder — a flat recursive
+    scan of image files under ``root``; unlike DatasetFolder items carry
+    NO label (the reference yields ``[sample]``)."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        self.samples = []
+        for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+            for fname in sorted(filenames):
+                if _has_valid_ext(fname, extensions):
+                    self.samples.append(os.path.join(dirpath, fname))
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files in {root}; supported extensions: "
+                f"{','.join(extensions)}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -263,3 +263,30 @@ class TestExecutionMode:
         assert not pt.is_compiled_with_rocm()
         assert not pt.is_compiled_with_xpu()
         assert pt.Model is pt.hapi.Model
+
+
+class TestGradModeNesting:
+    def test_same_instance_reentry_restores_state(self):
+        # regression: a per-instance _prev slot corrupted global grad
+        # mode when one cm/no_grad instance was entered while active
+        assert pt.is_grad_enabled()
+        ng = pt.no_grad()
+        with ng:
+            with ng:
+                assert not pt.is_grad_enabled()
+            assert not pt.is_grad_enabled()
+        assert pt.is_grad_enabled()
+
+        cm = pt.set_grad_enabled(False)
+        with cm:
+            with cm:
+                pass
+        assert pt.is_grad_enabled()
+
+    def test_recursive_no_grad_decorated_fn(self):
+        @pt.no_grad()
+        def fact(n):
+            return 1 if n <= 1 else n * fact(n - 1)
+
+        assert fact(5) == 120
+        assert pt.is_grad_enabled()

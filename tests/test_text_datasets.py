@@ -88,3 +88,49 @@ def test_imdb_trains_bow_classifier():
                 first = float(loss)
             last = float(loss)
     assert last < 0.5 * first
+
+
+def test_wmt_schema_and_dicts():
+    from paddle_tpu.text import WMT14, WMT16
+
+    d = WMT14(mode="train", dict_size=200, synthetic_size=32)
+    src, trg, trg_next = d[0]
+    # reference wmt14.py:162-163: trg is <s>-prefixed, trg_next </e>-suffixed
+    assert trg[0] == 0 and trg_next[-1] == 1
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    assert len(trg) == len(src) + 1
+    src_dict, trg_dict = d.get_dict()
+    assert src_dict["<unk>"] == 2 and len(trg_dict) == 200
+    assert d.get_dict(reverse=True)[0][0] == "<s>"
+    # determinism + disjoint splits
+    d2 = WMT14(mode="train", dict_size=200, synthetic_size=32)
+    np.testing.assert_array_equal(d[5][0], d2[5][0])
+    dt = WMT14(mode="test", dict_size=200, synthetic_size=32)
+    assert not (len(d[0][0]) == len(dt[0][0])
+                and np.array_equal(d[0][0], dt[0][0]))
+
+    w = WMT16(mode="val", src_dict_size=150, trg_dict_size=180, lang="en",
+              synthetic_size=16)
+    src, trg, trg_next = w[3]
+    assert trg[0] == 0 and trg_next[-1] == 1
+    assert len(w.get_dict("en")) == 150 and len(w.get_dict("de")) == 180
+
+    # the synthetic "translation" is a fixed dict permutation: the same
+    # source token always maps to the same target token (learnable task)
+    mapping = {}
+    for i in range(len(d)):
+        s, _, tn = d[i]
+        for a, b in zip(s, tn[:-1]):
+            assert mapping.setdefault(int(a), int(b)) == int(b)
+
+
+def test_movielens_record_types():
+    from paddle_tpu.text import MovieInfo, UserInfo
+
+    u = UserInfo(7, "F", 35, 11)
+    assert u.value() == [[7], [1], [3], [11]]
+    m = MovieInfo(2, ["action", "war"], "Saving Private Ryan")
+    cats = {"action": 0, "war": 1}
+    titles = {"saving": 10, "private": 11, "ryan": 12}
+    assert m.value(cats, titles) == [[2], [0, 1], [10, 11, 12]]
+    assert "MovieInfo" in str(m) and "UserInfo" in str(u)

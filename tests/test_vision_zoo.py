@@ -137,3 +137,43 @@ def test_adaptive_avg_pool_non_divisible():
             ws, we = (j * 14) // 4, -(-((j + 1) * 14) // 4)
             ref[:, :, i, j] = x[:, :, hs:he, ws:we].mean(axis=(2, 3))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_image_folder_label_free(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    import numpy as _np
+    for sub in ("a", "b"):
+        (tmp_path / sub).mkdir()
+        for i in range(2):
+            _np.save(tmp_path / sub / f"{i}.npy",
+                     _np.full((4, 4), ord(sub) + i, _np.uint8))
+    loader = lambda p: _np.load(p)
+
+    flat = ImageFolder(str(tmp_path), loader=loader,
+                       extensions=(".npy",))
+    assert len(flat) == 4
+    item = flat[0]
+    assert isinstance(item, list) and len(item) == 1  # no label
+    assert item[0].shape == (4, 4)
+
+    tree = DatasetFolder(str(tmp_path), loader=loader,
+                         extensions=(".npy",))
+    img, label = tree[0]
+    assert label == 0 and tree.class_to_idx == {"a": 0, "b": 1}
+
+
+def test_image_folder_filters_non_images(tmp_path):
+    import pytest
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    (tmp_path / "c").mkdir()
+    (tmp_path / "c" / "README.txt").write_text("not an image")
+    (tmp_path / "c" / "x.npy").write_bytes(b"")
+    # default extensions exclude both .txt and .npy -> reference-style error
+    with pytest.raises(RuntimeError, match="Found 0 files"):
+        ImageFolder(str(tmp_path))
+    with pytest.raises(RuntimeError, match="Found 0 files"):
+        DatasetFolder(str(tmp_path))
+    # widening extensions indexes only the matching file
+    assert len(ImageFolder(str(tmp_path), extensions=(".npy",))) == 1
