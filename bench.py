@@ -448,7 +448,7 @@ def _sweep_block_sizes(bh=96, S=2048, d=64):
     results = {}
     orig = fa_mod._block_sizes
     try:
-        for b in (128, 256, 512):
+        for b in (128, 256, 512, 1024):
             fa_mod._block_sizes = lambda sq, sk, _b=b: (_b, _b)
 
             def loss(q_, k_, v_):
@@ -458,11 +458,15 @@ def _sweep_block_sizes(bh=96, S=2048, d=64):
             g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
             out = g(q, k, v)          # compile
             _ = float(out[0][0, 0, 0, 0])
-            t0 = time.perf_counter()
-            for _i in range(5):
-                out = g(q, k, v)
-            _ = float(out[0][0, 0, 0, 0])
-            dt = (time.perf_counter() - t0) / 5
+            # best-of-3: single-shot timings on the tunneled chip are
+            # noisy enough to invert the block ranking (seen in r05)
+            dt = 1e9
+            for _r in range(3):
+                t0 = time.perf_counter()
+                for _i in range(5):
+                    out = g(q, k, v)
+                _ = float(out[0][0, 0, 0, 0])
+                dt = min(dt, (time.perf_counter() - t0) / 5)
             results[f"{b}/{b}"] = {"fwd_bwd_ms": dt * 1e3}
             print(f"[block-sweep {b}/{b}] fwd+bwd={dt * 1e3:.1f}ms",
                   file=sys.stderr, flush=True)

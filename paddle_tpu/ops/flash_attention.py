@@ -13,8 +13,12 @@ Layout: q, k, v are (batch, heads, seq, head_dim), flattened to
 (batch*heads, seq, head_dim) for the kernel; grid = (batch*heads, q blocks);
 each program streams this head's KV blocks with `fori_loop`, carrying the
 running max/denominator (m, l) in fp32 — the standard flash recurrence.
-Backward is recompute-based (no probability tensor saved): a dkdv kernel over
-KV blocks and a dq kernel over Q blocks, both replaying p = exp(qk - lse).
+Backward is recompute-based (no probability tensor saved): a dkdv kernel on a
+(bh, kv block, q block) grid accumulating into revisited f32 output blocks,
+and a dq kernel over Q blocks, both replaying p = exp(qk - lse).  Backward
+VMEM residency is O(block), so sequence length is bounded by HBM, not the
+16MB scoped-vmem limit (S=8192 fwd+bwd measured 30ms vs 737ms for XLA
+attention on v5e; benchmarks/flash_seqlen_ab.json).
 
 Causal masking is block-skipped: programs never visit KV blocks strictly
 above the diagonal, so the causal fwd does ~half the FLOPs — the fusion
@@ -89,14 +93,17 @@ def _stat_tile(x, width):
 
 
 def _block_sizes(seq_q: int, seq_k: int):
-    # swept on v5e at (8, 12, 2048, 64): 512/512 gives 1.6x over 128/128
-    # (19.3ms vs 30.4ms fwd+bwd; benchmarks/flash_block_sweep.json — small
-    # blocks starve the MXU when the contraction dim is only 64).
+    # swept on v5e (3D-grid kernels, bh·S·d with d=64, best-of-3 fwd+bwd;
+    # benchmarks/flash_block_sweep.json): at S=2048, 512/512 = 13.9ms vs
+    # 19.5ms for 1024 and 46ms for 128 (small blocks starve the MXU when
+    # the contraction dim is only 64); at S>=4096 the longer grid favors
+    # 1024/1024 (S=4096: 23.1 vs 25.6ms; S=8192: 30.1 vs 35.2ms).
     # Fall back to the largest power-of-two block that divides the sequence
     # so every multiple of 128 stays supported; the resulting widths are
     # always either <=128 or a multiple of _LANES, which _stat_tile needs.
     def pick(seq):
-        for b in (512, 256, 128):
+        cands = (1024, 512, 256, 128) if seq >= 4096 else (512, 256, 128)
+        for b in cands:
             if seq % b == 0:
                 return b
         return seq
@@ -138,87 +145,102 @@ def _keep_mask(seed_u32, bh, rows, cols, dropout_p):
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                causal, block_q, block_k, seq_k, kv_len, offset, dropout_p):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                kv_len, offset, dropout_p):
+    # 3D grid (bh, q block, kv block): k/v arrive as per-kv-block tiles and
+    # the flash (m, l, acc) state lives in VMEM scratch across the innermost
+    # kv steps — residency is O(block) in sequence length (a 2D grid that
+    # kept full k/v resident hit the 16MB scoped-vmem limit at S=8192 f32).
     bh = pl.program_id(0)
     qi = pl.program_id(1)
-    # dots stay in the input dtype (bf16 on the fast path) with fp32
-    # accumulation — casting inputs to fp32 would run the MXU at 1/4 rate
-    q = q_ref[0]                                          # (bq, d)
-    num_kv = -(-kv_len // block_k)       # only blocks touching real keys
-    if causal:
-        # visit only blocks intersecting the lower triangle; queries are
-        # bottom-right aligned against the REAL key length (decode
-        # semantics, matches F.scaled_dot_product_attention); ``offset``
-        # = kv_len - q_len over unpadded lengths
-        last = (offset + (qi + 1) * block_q + block_k - 1) // block_k
-        num_iter = jnp.minimum(last, num_kv)
-    else:
-        num_iter = num_kv
+    kj = pl.program_id(2)
+    num_kv = pl.num_programs(2)
     seed = seed_ref[0, 0].astype(jnp.uint32)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    # visit only blocks that touch real keys and (causal) the lower
+    # triangle; queries are bottom-right aligned against the REAL key
+    # length (``offset`` = kv_len - q_len over unpadded lengths)
+    work = kj * block_k < kv_len
+    if causal:
+        work &= (qi + 1) * block_q - 1 + offset >= kj * block_k
+
+    @pl.when(work)
+    def _step():
+        # dots stay in the input dtype (bf16 on the fast path) with fp32
+        # accumulation — casting to fp32 would run the MXU at 1/4 rate
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]
         s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
         rows = qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        cols = j * block_k + lax.broadcasted_iota(
+        cols = kj * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = cols < kv_len
         if causal:
             valid = valid & (rows + offset >= cols)
         s = jnp.where(valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1)
+        # stats are lane-broadcast (bq, _LANES) tiles, all lanes equal
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _stat_tile(m_new, block_k))
+        alpha = jnp.exp(m_prev - m_new)
+        # PV accumulation uses the dropped probabilities; the softmax
+        # normalizer l does not (dropout applies after normalization)
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=1)[:, None]
         if dropout_p > 0.0:
-            # PV accumulation uses the dropped probabilities; the softmax
-            # normalizer l does not (dropout applies after normalization)
             p = jnp.where(_keep_mask(seed, bh, rows, cols, dropout_p),
                           p, 0.0)
-        acc_new = acc * alpha[:, None] + _dot(
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + _dot(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())))
-        return m_new, l_new, acc_new
+        m_scr[...] = m_new
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    m, l, acc = lax.fori_loop(0, num_iter, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / (l_safe[:, None] * (1.0 - dropout_p))
-                ).astype(o_ref.dtype)
-    lse = m + jnp.log(l_safe)
-    lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...]
+                    / (l_safe[:, :1] * (1.0 - dropout_p))).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, seed, scale, causal, dropout_p, kv_len, offset):
+    from jax.experimental.pallas import tpu as pltpu
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
-    grid = (bh, sq // bq)
+    grid = (bh, sq // bq, sk // bk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, seq_k=sk, kv_len=kv_len, offset=offset,
+        block_q=bq, block_k=bk, kv_len=kv_len, offset=offset,
         dropout_p=dropout_p)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0)),       # seed
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1), lambda b, i, j: (0, 0)),       # seed
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # m
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # l
+            pltpu.VMEM((bq, d), jnp.float32),        # acc
         ],
         interpret=_interpret(),
     )(seed, q, k, v)
@@ -229,31 +251,42 @@ def _flash_fwd(q, k, v, seed, scale, causal, dropout_p, kv_len, offset):
 # Backward (recompute): dkdv over KV blocks, dq over Q blocks
 # ---------------------------------------------------------------------------
 def _dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                 dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q,
-                 seq_k, kv_len, offset, dropout_p):
+                 dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                 kv_len, offset, dropout_p):
+    # 3D grid (bh, kv block, q block): q/do/lse/delta arrive as per-q-block
+    # tiles, so VMEM residency is O(block) — a 2D grid that kept the full
+    # sequence resident hit the 16MB scoped-vmem limit at S=8192.  dk/dv
+    # accumulate in the (revisited) f32 output blocks across the innermost
+    # q-block steps.
     bh = pl.program_id(0)
     kj = pl.program_id(1)
-    k = k_ref[0]                                          # (bk, d)
-    v = v_ref[0]
-    num_q = seq_q // block_q
-    if causal:
-        start = jnp.maximum((kj * block_k - offset) // block_q, 0)
-    else:
-        start = 0
+    qi = pl.program_id(2)
     seed = seed_ref[0, 0].astype(jnp.uint32)
     keep_scale = 1.0 / (1.0 - dropout_p)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    # causal block-skip: a block whose every (row, col) pair sits strictly
+    # above the diagonal contributes nothing; padded-KV blocks likewise
+    work = kj * block_k < kv_len
+    if causal:
+        work &= (qi + 1) * block_q - 1 + offset >= kj * block_k
+
+    @pl.when(work)
+    def _accumulate():
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]
+        q = q_ref[0]                                      # (bq, d)
+        do = do_ref[0]
         # lane-broadcast stats: every lane holds the row's value, so widening
         # to block_k lanes gives an elementwise-ready (bq, bk) tile
-        lse = _stat_tile(lse_ref[0, pl.ds(i * block_q, block_q), :], block_k)
-        delta = _stat_tile(
-            delta_ref[0, pl.ds(i * block_q, block_q), :], block_k)
+        lse = _stat_tile(lse_ref[0], block_k)
+        delta = _stat_tile(delta_ref[0], block_k)
         s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
-        rows = i * block_q + lax.broadcasted_iota(
+        rows = qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = kj * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -267,46 +300,46 @@ def _dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            p * keep_scale, 0.0)
         else:
             pd = p
-        dv_new = dv + _dot(
+        dv_ref[0] += _dot(
             pd.astype(do.dtype), do, (((0,), (0,)), ((), ())))
         dp = _dot(do, v, (((1,), (1,)), ((), ())))
         ds = (pd * dp - p * delta) * scale
-        dk_new = dk + _dot(
+        dk_ref[0] += _dot(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())))
-        return dk_new, dv_new
-
-    z = jnp.zeros((block_k, k.shape[1]), jnp.float32)
-    dk, dv = lax.fori_loop(start, num_q, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, scale, causal, block_q, block_k, seq_k, kv_len,
+               dq_ref, *, scale, causal, block_q, block_k, kv_len,
                offset, dropout_p):
+    # 3D grid (bh, q block, kv block), mirroring _dkdv_kernel: k/v arrive
+    # per-kv-block and dq accumulates in the revisited f32 output block.
     bh = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = _stat_tile(lse_ref[0], block_k)     # lane-broadcast → (bq, bk)
-    delta = _stat_tile(delta_ref[0], block_k)
-    num_kv = -(-kv_len // block_k)
-    if causal:
-        last = (offset + (qi + 1) * q.shape[0] + block_k - 1) // block_k
-        num_iter = jnp.minimum(last, num_kv)
-    else:
-        num_iter = num_kv
+    kj = pl.program_id(2)
     seed = seed_ref[0, 0].astype(jnp.uint32)
     keep_scale = 1.0 / (1.0 - dropout_p)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    work = kj * block_k < kv_len
+    if causal:
+        work &= (qi + 1) * block_q - 1 + offset >= kj * block_k
+
+    @pl.when(work)
+    def _accumulate():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = _stat_tile(lse_ref[0], block_k)  # lane-broadcast → (bq, bk)
+        delta = _stat_tile(delta_ref[0], block_k)
+        k = k_ref[0]
+        v = v_ref[0]
         s = _dot(q, k, (((1,), (1,)), ((), ()))) * scale
-        rows = qi * q.shape[0] + lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], block_k), 0)
-        cols = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], block_k), 1)
+        rows = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kj * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         valid = cols < kv_len
         if causal:
             valid = valid & (rows + offset >= cols)
@@ -319,12 +352,8 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             pd = p
         dp = _dot(do, v, (((1,), (1,)), ((), ())))
         ds = (pd * dp - p * delta) * scale
-        return dq + _dot(
+        dq_ref[0] += _dot(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())))
-
-    dq = lax.fori_loop(0, num_iter, body,
-                       jnp.zeros((q.shape[0], q.shape[1]), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd(scale, causal, dropout_p, kv_len, offset, res, g):
@@ -342,50 +371,55 @@ def _flash_bwd(scale, causal, dropout_p, kv_len, offset, res, g):
 
     dkdv = functools.partial(
         _dkdv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        seq_q=sq, seq_k=sk, kv_len=kv_len, offset=offset,
-        dropout_p=dropout_p)
+        kv_len=kv_len, offset=offset, dropout_p=dropout_p)
+    # f32 outputs: they double as the cross-q-block accumulators (Mosaic
+    # keeps a revisited output block in VMEM until the revisit chain ends)
     dk, dv = pl.pallas_call(
         dkdv,
-        grid=(bh, sk // bk),
+        grid=(bh, sk // bk, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, j: (0, 0)),          # seed
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),   # q
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # k
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),   # v
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),   # do
-            pl.BlockSpec((1, sq, _LANES), lambda b, j: (b, 0, 0)),   # lse
-            pl.BlockSpec((1, sq, _LANES), lambda b, j: (b, 0, 0)),   # delta
+            pl.BlockSpec((1, 1), lambda b, j, i: (0, 0)),        # seed
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, _LANES),
+                         lambda b, j, i: (b, i, 0)),              # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(seed, q, k, v, do, lse_b, delta_b)
+    dk = dk.astype(k.dtype)
+    dv = dv.astype(v.dtype)
 
     dqk = functools.partial(
         _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        seq_k=sk, kv_len=kv_len, offset=offset, dropout_p=dropout_p)
+        kv_len=kv_len, offset=offset, dropout_p=dropout_p)
     dq = pl.pallas_call(
         dqk,
-        grid=(bh, sq // bq),
+        grid=(bh, sq // bq, sk // bk),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, i: (0, 0)),          # seed
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),   # lse
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),   # delta
+            pl.BlockSpec((1, 1), lambda b, i, j: (0, 0)),         # seed
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, _LANES),
+                         lambda b, i, j: (b, i, 0)),              # delta
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
         interpret=_interpret(),
-    )(seed, q, k, v, do, lse_b, delta_b)
+    )(seed, q, k, v, do, lse_b, delta_b).astype(q.dtype)
     seed_zero = np.zeros(seed.shape, jax.dtypes.float0)
     return dq, dk, dv, seed_zero
 

@@ -314,7 +314,8 @@ class WMT14(_WMTBase):
         enforce(dict_size > 0, "dict_size should be set as positive number")
         self.mode = mode
         self.dict_size = dict_size
-        n = synthetic_size or {"train": 4096, "test": 512, "gen": 128}[mode]
+        n = ({"train": 4096, "test": 512, "gen": 128}[mode]
+             if synthetic_size is None else synthetic_size)
         self._build(n, {"train": 41, "test": 43, "gen": 47}[mode],
                     dict_size, dict_size)
 
@@ -343,7 +344,8 @@ class WMT16(_WMTBase):
         self.lang = lang
         self.src_dict_size = src_dict_size
         self.trg_dict_size = trg_dict_size
-        n = synthetic_size or {"train": 4096, "test": 512, "val": 512}[mode]
+        n = ({"train": 4096, "test": 512, "val": 512}[mode]
+             if synthetic_size is None else synthetic_size)
         self._build(n, {"train": 53, "test": 59, "val": 61}[mode],
                     src_dict_size, trg_dict_size)
 

@@ -214,6 +214,8 @@ def _default_loader(path):
 
 
 def _has_valid_ext(fname: str, extensions) -> bool:
+    if isinstance(extensions, str):   # a bare ".npy" must not explode into
+        extensions = (extensions,)    # per-character suffixes via tuple()
     return fname.lower().endswith(tuple(extensions))
 
 
