@@ -43,7 +43,8 @@ from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
 from . import version  # noqa: F401
-from .framework import (CPUPlace, TPUPlace, get_device, load, save, seed,  # noqa: F401
+from .framework import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
+                        NPUPlace, TPUPlace, get_device, load, save, seed,
                         set_device)
 from .framework.dtype import convert_dtype
 from .framework.flags import get_flags, set_flags  # noqa: F401
@@ -64,6 +65,9 @@ int16 = jnp.int16
 int32 = jnp.int32
 int64 = jnp.int64
 bool = jnp.bool_  # noqa: A001
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+dtype = jnp.dtype            # paddle.dtype: the dtype *type*
 
 Tensor = jax.Array
 
@@ -75,6 +79,15 @@ def _arr(x):
 # ---------------------------------------------------------------------------
 # creation (reference python/paddle/tensor/creation.py)
 # ---------------------------------------------------------------------------
+_default_dtype = jnp.float32
+
+
+def _float_dtype(dtype):
+    """Resolve a creation-API dtype: None -> the global default float
+    (paddle.set_default_dtype)."""
+    return _default_dtype if dtype is None else convert_dtype(dtype)
+
+
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     x = jnp.asarray(_arr(data), dtype=convert_dtype(dtype))
     if place is not None:
@@ -82,16 +95,16 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return x
 
 
-def zeros(shape, dtype="float32"):
-    return jnp.zeros(shape, convert_dtype(dtype))
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _float_dtype(dtype))
 
 
-def ones(shape, dtype="float32"):
-    return jnp.ones(shape, convert_dtype(dtype))
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _float_dtype(dtype))
 
 
-def full(shape, fill_value, dtype="float32"):
-    return jnp.full(shape, fill_value, convert_dtype(dtype))
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, _float_dtype(dtype))
 
 
 def zeros_like(x, dtype=None):
@@ -110,24 +123,24 @@ def arange(start, end=None, step=1, dtype=None):
     return jnp.arange(start, end, step, convert_dtype(dtype))
 
 
-def linspace(start, stop, num, dtype="float32"):
-    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_float_dtype(dtype))
 
 
-def eye(num_rows, num_columns=None, dtype="float32"):
-    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_float_dtype(dtype))
 
 
-def empty(shape, dtype="float32"):
-    return jnp.zeros(shape, convert_dtype(dtype))
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, _float_dtype(dtype))
 
 
-def rand(shape, dtype="float32"):
-    return jax.random.uniform(next_key(), shape, convert_dtype(dtype))
+def rand(shape, dtype=None):
+    return jax.random.uniform(next_key(), shape, _float_dtype(dtype))
 
 
-def randn(shape, dtype="float32"):
-    return jax.random.normal(next_key(), shape, convert_dtype(dtype))
+def randn(shape, dtype=None):
+    return jax.random.normal(next_key(), shape, _float_dtype(dtype))
 
 
 def randint(low, high=None, shape=(1,), dtype="int64"):
@@ -141,8 +154,8 @@ def randperm(n, dtype="int64"):
     return jax.random.permutation(next_key(), n).astype(convert_dtype(dtype))
 
 
-def uniform(shape, dtype="float32", min=-1.0, max=1.0):
-    return jax.random.uniform(next_key(), shape, convert_dtype(dtype), min, max)
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(next_key(), shape, _float_dtype(dtype), min, max)
 
 
 def normal(mean=0.0, std=1.0, shape=(1,)):
@@ -580,6 +593,121 @@ def synchronize():
     synchronize analog)."""
     for a in jax.live_arrays():
         a.block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# top-level parity fill (reference python/paddle/__init__.py __all__)
+# ---------------------------------------------------------------------------
+def set_default_dtype(d):
+    """Global default float dtype for creation APIs called with dtype=None
+    (reference paddle.set_default_dtype)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return jnp.dtype(_default_dtype).name
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """numpy print options govern jax.Array reprs too."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (maps onto the framework key stream; the
+    reference returns per-GPU generator states)."""
+    return [framework.random.default_generator().get_state()]
+
+
+def set_cuda_rng_state(states):
+    framework.random.default_generator().set_state(states[0])
+
+
+def disable_signal_handler():
+    """No-op: the reference unhooks its C++ signal handlers; this runtime
+    installs none (dataloader workers use multiprocessing defaults)."""
+
+
+def check_shape(shape):
+    """Validate a creation-API shape (reference fluid data_feeder
+    check_shape): ints, or a list/tuple of ints with at most one -1."""
+    from .framework.errors import enforce
+    if isinstance(shape, int):
+        shape = (shape,)
+    enforce(isinstance(shape, (list, tuple)),
+            f"shape must be int or list/tuple of int, got {type(shape)}")
+    negs = 0
+    for s in shape:
+        enforce(isinstance(s, int), f"shape entries must be int, got {s!r}")
+        negs += s < 0
+    enforce(negs <= 1, f"at most one -1 allowed in shape, got {shape}")
+    return tuple(shape)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter (reference paddle.create_parameter): the
+    free-function twin of Layer.create_parameter — same initializer
+    convention (framework Initializer called as init(key, shape, dtype)),
+    same attr handling (ParamAttr initializer + trainable honored)."""
+    from .nn import initializer as I
+    shape = check_shape(shape)
+    d = convert_dtype(dtype)
+    trainable = True
+    init = default_initializer
+    if attr is not None:
+        if getattr(attr, "initializer", None) is not None and init is None:
+            init = attr.initializer
+        trainable = getattr(attr, "trainable", True)
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    val = init(framework.random.next_key(), shape, d)
+    return Parameter(val, trainable=trainable, is_bias=is_bias)
+
+
+class DataParallel(nn.Layer):
+    """Reference paddle.DataParallel(model) wrapper.  Under GSPMD the
+    gradient synchronization the reference does with allreduce hooks
+    (python/paddle/fluid/dygraph/parallel.py:413) is emitted by XLA from
+    the dp sharding — the wrapper only needs to preserve the reference's
+    surface: forward delegation, ``_layers``, state_dict passthrough, and
+    the no-op scale_loss/apply_collective_grads pair."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
 
 
 # extended op corpus (reference tensor/{math,manipulation,search,random}.py

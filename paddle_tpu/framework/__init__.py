@@ -6,7 +6,8 @@ XLA owns streams and buffers — so this layer reduces to configuration,
 diagnostics and identity.
 """
 from . import dtype, errors, flags, io, random  # noqa: F401
-from .dtype import (CPUPlace, Place, TPUPlace, convert_dtype, get_device,  # noqa: F401
+from .dtype import (CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace,  # noqa: F401
+                    Place, TPUPlace, convert_dtype, get_device,
                     is_compiled_with_tpu, set_device)
 from .errors import EnforceNotMet, enforce  # noqa: F401
 from .flags import define_flag, get_flags, set_flags  # noqa: F401

@@ -65,6 +65,21 @@ def TPUPlace(index: int = 0) -> Place:
     return Place(devs[index])
 
 
+def CUDAPlace(index: int = 0) -> Place:
+    """Reference CUDAPlace — on this stack "the accelerator" is the TPU;
+    ported GPU scripts land on the default accelerator device
+    (docs/MIGRATION.md device-mapping table)."""
+    return TPUPlace(index)
+
+
+def CUDAPinnedPlace() -> Place:
+    return CPUPlace()      # host staging memory ≙ the host platform
+
+
+def NPUPlace(index: int = 0) -> Place:
+    return TPUPlace(index)
+
+
 _current_device = None
 
 
@@ -73,7 +88,9 @@ def set_device(device: str):
     global _current_device
     if device == "cpu":
         _current_device = CPUPlace()
-    elif device.startswith("tpu"):
+    elif device.startswith(("tpu", "gpu", "cuda", "npu", "xpu")):
+        # ported accelerator scripts (set_device('gpu')) land on the
+        # default accelerator — the TPU here (docs/MIGRATION.md)
         idx = int(device.split(":")[1]) if ":" in device else 0
         _current_device = TPUPlace(idx)
     else:

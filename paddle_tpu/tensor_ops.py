@@ -19,6 +19,12 @@ from .framework.dtype import convert_dtype
 from .framework.errors import enforce
 
 __all__ = [
+    # top-level gap fill (reference __init__ __all__ parity)
+    "add_n", "lgamma", "asinh", "acosh", "atanh", "floor_mod",
+    "bitwise_not", "rank", "empty_like", "is_empty", "unstack", "reverse",
+    "increment", "slice", "strided_slice", "crop", "shard_index",
+    "scatter_nd", "scatter_nd_add", "reshape_", "squeeze_", "unsqueeze_",
+    "tanh_", "scatter_",
     # math
     "amax", "amin", "addmm", "angle", "conj", "real", "imag", "deg2rad",
     "rad2deg", "diff", "digamma", "erfinv", "expm1", "gcd", "lcm", "lerp",
@@ -529,3 +535,198 @@ def exponential(x, lam: float = 1.0):
     u = jax.random.uniform(fw_random.next_key(), _arr(x).shape,
                            _arr(x).dtype, minval=1e-9, maxval=1.0)
     return -jnp.log(u) / lam
+
+
+# ---------------------------------------------------------------------------
+# top-level gap fill (reference python/paddle/__init__.py __all__ parity):
+# manipulation/search ops + the documented-in-place aliases
+# ---------------------------------------------------------------------------
+def add_n(inputs):
+    """Elementwise sum of a tensor list (reference tensor/math.py add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        return _arr(inputs)
+    out = _arr(inputs[0])
+    for x in inputs[1:]:
+        out = out + _arr(x)
+    return out
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(_arr(x))
+
+
+def asinh(x):
+    return jnp.arcsinh(_arr(x))
+
+
+def acosh(x):
+    return jnp.arccosh(_arr(x))
+
+
+def atanh(x):
+    return jnp.arctanh(_arr(x))
+
+
+def floor_mod(x, y):
+    return jnp.mod(_arr(x), _arr(y))
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(_arr(x))
+
+
+def rank(x):
+    """Number of dimensions as a 0-d int32 tensor (reference rank op)."""
+    return jnp.asarray(_arr(x).ndim, jnp.int32)
+
+
+def empty_like(x, dtype=None):
+    x = _arr(x)
+    return jnp.empty(x.shape, convert_dtype(dtype) if dtype else x.dtype)
+
+
+def is_empty(x):
+    """Whether the tensor holds zero elements (0-d bool; logic.py:229)."""
+    return jnp.asarray(_arr(x).size == 0)
+
+
+def unstack(x, axis=0, num=None):
+    """Split along ``axis`` into that dim's many tensors, squeezing it."""
+    x = _arr(x)
+    n = x.shape[axis] if num is None else num
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+def reverse(x, axis):
+    """Flip along the given axes (reference fluid reverse op)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(_arr(x), axis=tuple(axis))
+
+
+def increment(x, value=1.0):
+    """x + value for a single-element tensor (control-flow counter idiom,
+    reference tensor/math.py:3324; jax arrays are immutable so the
+    incremented tensor is returned)."""
+    x = _arr(x)
+    enforce(x.size == 1, "increment requires a single-element tensor")
+    return x + jnp.asarray(value, x.dtype)
+
+
+def slice(input, axes, starts, ends):  # noqa: A001
+    """Static slice over the given axes (reference slice op semantics:
+    negative indices wrap, ends clamp to the dim size)."""
+    import builtins
+    x = _arr(input)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        d = x.shape[ax]
+        st = int(st); en = int(en)
+        if st < 0:
+            st += d
+        if en < 0:
+            en += d
+        # reference clamps to [0, d]: out-of-range ends never re-wrap
+        st = builtins.max(builtins.min(st, d), 0)
+        en = builtins.max(builtins.min(en, d), 0)
+        idx[ax] = builtins.slice(st, en)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    """slice() with per-axis strides (reference strided_slice op)."""
+    import builtins
+    x = _arr(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        d = x.shape[ax]
+        st = int(st); en = int(en); sd = int(sd)
+        if st < 0:
+            st += d
+        if en < 0:
+            en += d
+        if sd > 0:
+            st = builtins.max(builtins.min(st, d), 0)
+            en = builtins.max(builtins.min(en, d), 0)
+            idx[ax] = builtins.slice(st, en, sd)
+        else:
+            # negative stride: a still-negative end after one wrap means
+            # "run past index 0" (python slice would re-wrap it) — None
+            st = builtins.min(st, d - 1)
+            idx[ax] = builtins.slice(st, None if en < 0 else en, sd)
+    return x[tuple(idx)]
+
+
+def crop(x, shape=None, offsets=None):
+    """Crop to ``shape`` starting at ``offsets`` (reference crop op;
+    -1 in shape keeps the rest of that dim)."""
+    import builtins
+    x = _arr(x)
+    if shape is None:
+        shape = x.shape
+    if offsets is None:
+        offsets = [0] * x.ndim
+    idx = []
+    for d, off, s in zip(x.shape, offsets, shape):
+        off = int(off)
+        end = d if int(s) == -1 else off + int(s)
+        idx.append(builtins.slice(off, end))
+    return x[tuple(idx)]
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Re-base class indices onto one shard of [0, index_num)
+    (reference fluid/layers/nn.py:15231; the vocab-parallel label
+    transform).  Values outside this shard's range become
+    ``ignore_value``."""
+    enforce(0 <= shard_id < nshards,
+            f"shard_id {shard_id} out of range [0, {nshards})")
+    x = _arr(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, jnp.asarray(ignore_value, x.dtype))
+
+
+def scatter_nd_add(x, index, updates):
+    """x with ``updates`` scatter-added at ``index`` (reference
+    scatter_nd_add op; duplicate indices accumulate)."""
+    x, index, updates = _arr(x), _arr(index), _arr(updates)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    """Zeros of ``shape`` with updates scatter-added (reference
+    scatter_nd: scatter_nd_add onto a zero tensor)."""
+    updates = _arr(updates)
+    return scatter_nd_add(jnp.zeros(tuple(shape), updates.dtype), index,
+                          updates)
+
+
+# Reference in-place variants (tensor/manipulation.py reshape_ etc.).
+# jax arrays are immutable: these return the result like their non-inplace
+# counterparts — the paddle convention `y = x.reshape_(...)` still works,
+# assignment-free mutation of `x` does not (documented in MIGRATION.md).
+def reshape_(x, shape):
+    return jnp.reshape(_arr(x), tuple(shape))
+
+
+def squeeze_(x, axis=None):
+    from . import squeeze as _squeeze
+    return _squeeze(x, axis)
+
+
+def unsqueeze_(x, axis):
+    from . import unsqueeze as _unsqueeze
+    return _unsqueeze(x, axis)
+
+
+def tanh_(x):
+    return jnp.tanh(_arr(x))
+
+
+def scatter_(x, index, updates, overwrite=True):
+    from . import scatter as _scatter
+    return _scatter(x, index, updates, overwrite)
