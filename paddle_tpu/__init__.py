@@ -676,6 +676,8 @@ def create_parameter(shape, dtype, name=None, attr=None,
             init = attr.initializer
         trainable = getattr(attr, "trainable", True)
     if init is None:
+        init = I._global_initializer["bias" if is_bias else "weight"]
+    if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierUniform()
     val = init(framework.random.next_key(), shape, d)
     return Parameter(val, trainable=trainable, is_bias=is_bias)

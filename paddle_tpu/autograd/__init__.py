@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["grad", "value_and_grad", "vjp", "jvp", "jacobian", "hessian",
-           "PyLayer", "no_grad"]
+           "PyLayer", "no_grad", "backward"]
 
 # functional autograd — direct jax transforms
 vjp = jax.vjp
@@ -144,3 +144,15 @@ class PyLayer(metaclass=_PyLayerMeta):
     @staticmethod
     def backward(ctx, *grads):
         raise NotImplementedError
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Reference paddle.autograd.backward.  Functional JAX has no global
+    tape to walk: gradients come from ``grad``/``value_and_grad``
+    transforms over functions.  This surface point exists to fail loudly
+    with the migration recipe instead of silently doing nothing."""
+    raise RuntimeError(
+        "autograd.backward walks a mutable autograd tape, which does not "
+        "exist in this functional runtime. Compute gradients with "
+        "paddle_tpu.autograd.grad(fn)(params) or jax.value_and_grad over "
+        "your loss function (docs/MIGRATION.md: autograd).")

@@ -63,3 +63,16 @@ __all__ = [
     "get_hybrid_communicate_group", "get_mesh",
     "set_hybrid_communicate_group",
 ]
+
+from . import launch  # noqa: F401,E402  (reference paddle.distributed.launch)
+from .compat import (ParallelMode, Group, new_group, get_group,  # noqa: F401,E402
+                     alltoall, send, recv, wait, gloo_init_parallel_env,
+                     gloo_barrier, gloo_release, QueueDataset,
+                     InMemoryDataset, CountFilterEntry, ShowClickEntry,
+                     ProbabilityEntry)
+
+__all__ += ["launch", "ParallelMode", "Group", "new_group", "get_group",
+            "alltoall", "send", "recv", "wait", "gloo_init_parallel_env",
+            "gloo_barrier", "gloo_release", "QueueDataset",
+            "InMemoryDataset", "CountFilterEntry", "ShowClickEntry",
+            "ProbabilityEntry"]

@@ -1,0 +1,236 @@
+"""Reference-surface compat for paddle.distributed's eager/PS-era API
+(reference python/paddle/distributed/__init__.py __all__): process groups,
+list-style alltoall, p2p send/recv, gloo rendezvous, and the
+parameter-server dataset/entry config classes.
+
+The SPMD design note: collectives here are *facades over mesh axes* — the
+real communication is emitted by XLA from shardings (see collective.py).
+The PS-specific pieces (InMemoryDataset pipelines, feature entries) are
+config-surface only, consistent with SURVEY A11's parameter-server
+out-of-scope ruling (documented in docs/MIGRATION.md).
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+
+__all__ = ["ParallelMode", "Group", "new_group", "get_group", "alltoall",
+           "send", "recv", "wait", "gloo_init_parallel_env", "gloo_barrier",
+           "gloo_release", "QueueDataset", "InMemoryDataset",
+           "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry"]
+
+
+class ParallelMode(enum.IntEnum):
+    """Reference fleet.base.topology.ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class Group:
+    """Process-group facade (reference collective.Group): a set of ranks
+    with an id; mesh-axis collectives accept ``group.axis`` when the
+    group was built from a mesh axis."""
+
+    def __init__(self, rank: int, nranks: int, id: int,
+                 ranks: Sequence[int], axis: Optional[str] = None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = list(ranks)
+        self.axis = axis
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return (f"Group(rank={self.rank}, nranks={self.nranks}, "
+                f"id={self.id}, ranks={self.ranks})")
+
+
+_groups: dict = {}
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              axis: Optional[str] = None) -> Group:
+    """Create a process group over ``ranks`` (reference
+    collective.new_group).  Under the one-SPMD-program design membership
+    is structural (mesh axes), so the group records identity; pass
+    ``axis`` to bind it to a mesh axis for the collective facades."""
+    me = jax.process_index()
+    if ranks is None:
+        ranks = list(range(jax.process_count()))
+    gid = len(_groups) + 1
+    rank = ranks.index(me) if me in ranks else -1
+    g = Group(rank, len(ranks), gid, ranks, axis)
+    _groups[gid] = g
+    return g
+
+
+def get_group(id: int = 0) -> Group:  # noqa: A002
+    enforce(id in _groups, f"no group with id {id}; create with new_group")
+    return _groups[id]
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             use_calc_stream: bool = True):
+    """List-style all_to_all (reference collective.alltoall).  Inside
+    shard_map the split/concat rides lax.all_to_all over the group's
+    axis; outside (single process) it is the identity exchange —
+    world=1 semantics."""
+    from .collective import all_to_all as _a2a
+    stacked = jnp.stack([jnp.asarray(t) for t in in_tensor_list])
+    axis = getattr(group, "axis", None) or (group if isinstance(group, str)
+                                            else "ep")
+    try:
+        out = _a2a(stacked, group=axis, split_axis=0, concat_axis=0)
+        outs = [out[i] for i in range(out.shape[0])]
+    except Exception:
+        outs = list(in_tensor_list)     # world=1: each rank keeps its slice
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(outs)
+        return None
+    return outs
+
+
+_mailbox: dict = {}
+
+
+def send(tensor, dst: int = 0, group=None, use_calc_stream: bool = True):
+    """Eager p2p send (reference collective.send).  Single-process
+    semantics: the tensor lands in an in-process mailbox keyed by dst —
+    true cross-chip p2p is expressed with send_recv_permute (ppermute)
+    inside the SPMD program (the pipeline does exactly this)."""
+    enforce(jax.process_count() == 1,
+            "multi-process eager send is not supported: use "
+            "send_recv_permute inside the SPMD program (pipeline.py)")
+    _mailbox.setdefault(dst, []).append(jnp.asarray(tensor))
+
+
+def recv(tensor=None, src: int = 0, group=None, use_calc_stream: bool = True):
+    """Eager p2p recv — pops the mailbox the matching send filled."""
+    enforce(jax.process_count() == 1,
+            "multi-process eager recv is not supported: use "
+            "send_recv_permute inside the SPMD program (pipeline.py)")
+    me = jax.process_index()
+    box = _mailbox.get(me, [])
+    enforce(len(box) > 0, "recv before any matching send")
+    return box.pop(0)
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    """Block until the tensor's device work is done (reference wait)."""
+    jax.block_until_ready(tensor)
+    return tensor
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str):
+    """Reference gloo_init_parallel_env: CPU rendezvous for host-side
+    barriers.  jax.distributed owns rendezvous here (launch/init_from_env);
+    single-process initialization is a no-op."""
+    enforce(rank_num == 1 or jax.process_count() == rank_num,
+            "gloo rendezvous is owned by jax.distributed.initialize — "
+            "bring the cluster up via paddle_tpu.distributed.launch")
+
+
+def gloo_barrier():
+    if jax.process_count() > 1:
+        from .collective import barrier as _barrier
+        _barrier()
+
+
+def gloo_release():
+    pass
+
+
+# --- parameter-server dataset/entry configs (SURVEY A11: PS out of scope;
+# these are the config surface so ported scripts can construct them) ------
+class _PSEntry:
+    def __init__(self, *args):
+        self._args = args
+
+    def __repr__(self):
+        return f"{type(self).__name__}{self._args}"
+
+
+class CountFilterEntry(_PSEntry):
+    def __init__(self, count_filter: int = 0):
+        enforce(count_filter >= 0, "count_filter must be >= 0")
+        super().__init__(count_filter)
+
+
+class ShowClickEntry(_PSEntry):
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__(show_name, click_name)
+
+
+class ProbabilityEntry(_PSEntry):
+    def __init__(self, probability: float = 1.0):
+        enforce(0 <= probability <= 1, "probability in [0, 1]")
+        super().__init__(probability)
+
+
+class _PSDatasetBase:
+    """Config surface of the PS datasets (reference fleet InMemoryDataset/
+    QueueDataset).  File-backed init/iteration works (delegates to plain
+    host IO); the PS-distributed shuffle/fleet-send paths raise with the
+    out-of-scope note."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._pipe_command = None
+        self._batch_size = 1
+        self._use_var = []
+
+    def init(self, batch_size: int = 1, use_var=None, pipe_command=None,
+             **kwargs):
+        self._batch_size = batch_size
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, files: List[str]):
+        self._files = list(files)
+
+    def _ps_only(self, what: str):
+        raise NotImplementedError(
+            f"{what} is parameter-server infrastructure (reference fleet "
+            f"PS mode) — out of scope for the TPU build (SURVEY A11; "
+            f"docs/MIGRATION.md 'parameter server').")
+
+
+class InMemoryDataset(_PSDatasetBase):
+    def load_into_memory(self):
+        self._records = []
+        for f in self._files:
+            with open(f) as fh:
+                self._records.extend(fh.read().splitlines())
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(getattr(self, "_records", []))
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        self._ps_only("global_shuffle")
+
+    def release_memory(self):
+        self._records = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(getattr(self, "_records", []))
+
+
+class QueueDataset(_PSDatasetBase):
+    def local_shuffle(self):
+        self._ps_only("QueueDataset.local_shuffle")
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        self._ps_only("global_shuffle")

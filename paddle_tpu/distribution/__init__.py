@@ -514,3 +514,39 @@ def _kl_uniform(p, q):
     kl = jnp.log(q.high - q.low) - jnp.log(p.high - p.low)
     contained = (q.low <= p.low) & (p.high <= q.high)
     return jnp.where(contained, kl, jnp.inf)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py): subclasses expose natural
+    parameters + log-normalizer; entropy falls out via the Bregman
+    identity (autodiff of the log-normalizer against the natural
+    parameters — the reference's _mean_carrier_measure pattern)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """-E[log p] via η·∇A(η) - A(η) (Bregman / Legendre duality),
+        elementwise over batched natural parameters — entropy keeps the
+        distribution's batch shape like every other Distribution here."""
+        nat = [jnp.asarray(p) for p in self._natural_parameters]
+        logA = self._log_normalizer(*nat)
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = logA - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return ent
+
+
+__all__.append("ExponentialFamily")

@@ -85,3 +85,30 @@ def fftshift(x, axes=None):
 
 def ifftshift(x, axes=None):
     return jnp.fft.ifftshift(_arr(x), axes=axes)
+
+
+def _inv_norm(norm: str) -> str:
+    """hfftn(x, norm) == irfftn(conj(x), swapped norm) (scipy identity:
+    the hermitian transform swaps the forward/backward scaling)."""
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[norm]
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfftn(jnp.conj(jnp.asarray(x)), s=s, axes=axes,
+                          norm=_inv_norm(norm))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.conj(jnp.fft.rfftn(jnp.asarray(x), s=s, axes=axes,
+                                  norm=_inv_norm(norm)))
+
+
+def hfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(jnp.conj(jnp.asarray(x)), s=s, axes=axes,
+                          norm=_inv_norm(norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.conj(jnp.fft.rfftn(jnp.asarray(x), s=s, axes=axes,
+                                  norm=_inv_norm(norm)))

@@ -25,7 +25,7 @@ from ..framework.errors import enforce
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-    "Subset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
+    "Subset", "ChainDataset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
     "BatchSampler", "DistributedBatchSampler", "WeightedRandomSampler",
     "DataLoader", "default_collate_fn", "WorkerInfo", "get_worker_info",
 ]
@@ -521,3 +521,14 @@ class _DevicePrefetcher:
         if isinstance(item, Exception):
             raise item
         return item
+
+
+class ChainDataset(IterableDataset):
+    """Chain iterable datasets back-to-back (reference ChainDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for ds in self.datasets:
+            yield from ds

@@ -67,9 +67,21 @@ class InputSpec:
 
 def to_static(function=None, input_spec=None, **kw):
     """≙ @paddle.jit.to_static — jax traces python directly, so this is
-    jax.jit with the decorator calling conventions preserved."""
+    jax.jit with the decorator calling conventions preserved.
+    ``ProgramTranslator.enable(False)`` routes calls to the raw python
+    function (the reference's debug-eagerly workflow)."""
     def deco(fn):
-        return jax.jit(fn)
+        jitted = jax.jit(fn)
+        import functools
+
+        @functools.wraps(fn)
+        def dispatch(*args, **kwargs):
+            if not _translator_state["enabled"] or getattr(
+                    fn, "__not_to_static__", False):
+                return fn(*args, **kwargs)
+            return jitted(*args, **kwargs)
+        dispatch.__wrapped_jit__ = jitted
+        return dispatch
     if function is None:
         return deco
     return deco(function)
@@ -124,3 +136,79 @@ class TranslatedLayer:
 def load(path: str) -> TranslatedLayer:
     enforce(os.path.isdir(path), f"no exported model at {path!r}")
     return TranslatedLayer(path)
+
+
+def not_to_static(fn=None):
+    """Mark a function to be excluded from to_static conversion (reference
+    jit.not_to_static).  One-codepath runtime: tracing is jax's and the
+    marker is metadata — the function runs as plain python either way."""
+    if fn is None:
+        return not_to_static
+    fn.__not_to_static__ = True
+    return fn
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Reference jit.set_code_level: controls dy2static transformed-code
+    logging.  There is no source transform here (jax traces python
+    directly), so this records the setting for API parity."""
+    _translator_state["code_level"] = level
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    _translator_state["verbosity"] = level
+
+
+_translator_state = {"enabled": True, "code_level": 0, "verbosity": 0}
+
+
+class ProgramTranslator:
+    """Reference dy2static ProgramTranslator singleton: enable() toggles
+    whether @to_static functions are traced (False = run eagerly)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool = True):
+        _translator_state["enabled"] = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return _translator_state["enabled"]
+
+
+class TracedLayer:
+    """Reference jit.TracedLayer (dygraph trace → static program).  The
+    jax analog: trace(layer, inputs) jit-compiles the layer's forward and
+    records example inputs; ``save_inference_model`` delegates to jit.save
+    via the captured InputSpec."""
+
+    def __init__(self, layer, inputs):
+        import jax
+        self._layer = layer
+        self._inputs = inputs
+        sd = layer.state_dict()
+        self._fn = jax.jit(lambda params, *a: layer.apply(params, *a))
+        self._params = sd
+
+    def __call__(self, *inputs):
+        return self._fn(self._params, *inputs)
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer, inputs)
+        return tl(*inputs), tl
+
+    def save_inference_model(self, path: str, feed=None, fetch=None):
+        specs = [InputSpec(tuple(jnp.asarray(i).shape),
+                           str(jnp.asarray(i).dtype)) for i in self._inputs]
+        save(self._layer, path, specs)
+
+
+__all__ += ["TracedLayer", "ProgramTranslator", "set_code_level",
+            "set_verbosity", "not_to_static"]

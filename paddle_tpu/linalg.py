@@ -170,3 +170,37 @@ def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
 
 def corrcoef(x, rowvar: bool = True):
     return jnp.corrcoef(_arr(x), rowvar=rowvar)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata: bool = True,
+              unpack_pivots: bool = True):
+    """Unpack paddle.linalg.lu output into (P, L, U) (reference
+    lu_unpack op).  ``lu_pivots`` are 1-based row swaps as returned by
+    :func:`lu`."""
+    lu_data = _arr(lu_data)
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+        U = jnp.triu(lu_data[..., :k, :])
+    if unpack_pivots:
+        piv = jnp.asarray(lu_pivots) - 1          # back to 0-based swaps
+
+        def perm_one(pv):
+            perm = jnp.arange(m)
+
+            def body(i, perm):
+                j = pv[i]
+                pi, pj = perm[i], perm[j]
+                return perm.at[i].set(pj).at[j].set(pi)
+
+            return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+
+        flat = piv.reshape((-1, piv.shape[-1]))
+        perms = jax.vmap(perm_one)(flat)
+        perms = perms.reshape(piv.shape[:-1] + (m,))
+        P = jax.nn.one_hot(perms, m, dtype=lu_data.dtype)
+        # rows of P: P[perm[i], i] = 1 → P @ L @ U == A
+        P = jnp.swapaxes(P, -1, -2)
+    return P, L, U

@@ -24,3 +24,5 @@ from .layers import (AdaptiveMaxPool2D, AvgPool1D, Conv1D, Conv3D,  # noqa: F401
                      Upsample, UpsamplingBilinear2D, UpsamplingNearest2D)
 from .rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401
                   SimpleRNN, SimpleRNNCell)
+from .layers_ext import *  # noqa: F401,F403,E402  (long-tail layer classes)
+from .layers_ext import dynamic_decode  # noqa: F401

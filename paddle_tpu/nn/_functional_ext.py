@@ -1,0 +1,839 @@
+"""nn.functional long-tail ops (reference: python/paddle/nn/functional/
+{activation,common,conv,loss,norm,pooling,vision,extension}.py) — the last
+names of the reference functional ``__all__`` beyond the core set in
+``functional.py``.
+
+Same design stance as ``functional.py``: thin, paddle-shaped adapters over
+jnp/lax — XLA owns the kernels; anything that is a windowed reduction rides
+``reduce_window``/``conv_general_dilated_patches``, anything dense rides
+einsum/matmul so it tiles onto the MXU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import random as fw_random
+from ..framework.errors import enforce
+
+__all__ = [
+    # activations
+    "celu", "elu_", "hardshrink", "hardtanh", "log_sigmoid", "maxout",
+    "relu_", "selu", "softmax_", "softshrink", "softsign", "tanh_",
+    "tanhshrink", "thresholded_relu", "gumbel_softmax",
+    # conv
+    "conv1d_transpose", "conv3d_transpose",
+    # common / extension
+    "diag_embed", "sequence_mask", "dropout2d", "dropout3d",
+    "alpha_dropout", "zeropad2d", "unfold", "fold", "upsample", "bilinear",
+    "temporal_shift",
+    # pooling
+    "avg_pool3d", "max_pool3d", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "adaptive_avg_pool1d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool3d",
+    # losses
+    "binary_cross_entropy", "dice_loss", "hsigmoid_loss", "log_loss",
+    "npair_loss", "sigmoid_focal_loss", "softmax_with_cross_entropy",
+    "margin_cross_entropy", "class_center_sample",
+    # norm
+    "local_response_norm", "instance_norm",
+    # vision
+    "affine_grid", "grid_sample",
+    # decoding
+    "gather_tree",
+]
+
+
+def _arr(x):
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        enforce(len(v) == n, f"expected {n} values, got {v}")
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+def celu(x, alpha: float = 1.0):
+    x = _arr(x)
+    enforce(alpha != 0, "celu alpha must be non-zero")
+    return jnp.maximum(x, 0) + jnp.minimum(
+        alpha * jnp.expm1(x / alpha), 0).astype(x.dtype)
+
+
+def selu(x, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    x = _arr(x)
+    return (scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))).astype(x.dtype)
+
+
+def softsign(x):
+    x = _arr(x)
+    return x / (1 + jnp.abs(x))
+
+
+def softshrink(x, threshold: float = 0.5):
+    x = _arr(x)
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold,
+                               jnp.zeros((), x.dtype)))
+
+
+def hardshrink(x, threshold: float = 0.5):
+    x = _arr(x)
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros((), x.dtype))
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0):  # noqa: A002
+    return jnp.clip(_arr(x), min, max)
+
+
+def tanhshrink(x):
+    x = _arr(x)
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    x = _arr(x)
+    return jnp.where(x > threshold, x, jnp.zeros((), x.dtype))
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(_arr(x))
+
+
+def maxout(x, groups: int, axis: int = 1):
+    """Max over ``groups`` consecutive channel slices (maxout op)."""
+    x = _arr(x)
+    if axis < 0:
+        axis += x.ndim
+    c = x.shape[axis]
+    enforce(c % groups == 0,
+            f"maxout: channels {c} not divisible by groups {groups}")
+    shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1, key=None):
+    """Gumbel-softmax sampling with optional straight-through hard mode."""
+    x = _arr(x)
+    if key is None:
+        key = fw_random.op_key()
+    u = jax.random.uniform(key, x.shape, jnp.float32, 1e-20, 1.0)
+    g = -jnp.log(-jnp.log(u))
+    y = jax.nn.softmax((x.astype(jnp.float32) + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y).at[...].set(0)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                    inplace=False)
+        y = onehot + y - lax.stop_gradient(y)   # straight-through
+    return y.astype(x.dtype)
+
+
+# documented in-place aliases — arrays are immutable, result is returned
+def relu_(x):
+    return jax.nn.relu(_arr(x))
+
+
+def elu_(x, alpha: float = 1.0):
+    from . import functional as F
+    return F.elu(x, alpha)
+
+
+def tanh_(x):
+    return jnp.tanh(_arr(x))
+
+
+def softmax_(x, axis: int = -1):
+    return jax.nn.softmax(_arr(x), axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Transposed convs (reference conv2d_transpose generalized; same padding
+# arithmetic: out = (in-1)*s - 2*p + d*(k-1) + 1 + output_padding)
+# ---------------------------------------------------------------------------
+def _convnd_transpose(x, weight, bias, stride, padding, output_padding,
+                      dilation, groups, nd, channel_last):
+    from ..amp import state as amp_state
+    x, weight = amp_state.cast_for_op("conv2d", _arr(x), _arr(weight))
+    s = _ntuple(stride, nd)
+    d = _ntuple(dilation, nd)
+    p = _ntuple(padding, nd)
+    op = _ntuple(output_padding, nd)
+    ksp = [(weight.shape[2 + i] - 1) * d[i] + 1 for i in range(nd)]
+    pad = [(ksp[i] - 1 - p[i], ksp[i] - 1 - p[i] + op[i]) for i in range(nd)]
+    spat = "DHW"[3 - nd:]
+    fmt = ("N" + spat + "C") if channel_last else ("NC" + spat)
+    dn = lax.conv_dimension_numbers(
+        x.shape, (weight.shape[1] * groups, weight.shape[0] // groups,
+                  *weight.shape[2:]),
+        (fmt, "OI" + spat, fmt))
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))  # (in, out/g, *k)
+    in_g = weight.shape[0] // groups
+    w = w.reshape(groups, in_g, weight.shape[1], *weight.shape[2:])
+    w = jnp.swapaxes(w, 1, 2)
+    w = w.reshape(groups * weight.shape[1], in_g, *weight.shape[2:])
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=s,
+        rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        b = _arr(bias).astype(y.dtype)
+        shape = ((1,) * (y.ndim - 1) + (-1,)) if channel_last \
+            else ((1, -1) + (1,) * nd)
+        y = y + b.reshape(shape)
+    return y
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups: int = 1, dilation=1,
+                     data_format: str = "NCL"):
+    """(N, C, L) transposed conv; weight (in, out/g, k)."""
+    cl = data_format == "NLC"
+    x2 = _arr(x)[:, :, None, :] if not cl else _arr(x)[:, None, :, :]
+    w2 = _arr(weight)[:, :, None, :]
+    y = _convnd_transpose(x2, w2, bias, (1, _ntuple(stride, 1)[0]),
+                          (0, _ntuple(padding, 1)[0]),
+                          (0, _ntuple(output_padding, 1)[0]),
+                          (1, _ntuple(dilation, 1)[0]), groups, 2, cl)
+    return y[:, :, 0, :] if not cl else y[:, 0, :, :]
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups: int = 1, dilation=1,
+                     data_format: str = "NCDHW"):
+    """(N, C, D, H, W) transposed conv; weight (in, out/g, kd, kh, kw)."""
+    return _convnd_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, 3,
+                             data_format == "NDHWC")
+
+
+# ---------------------------------------------------------------------------
+# Common / extension (reference nn/functional/{common,extension}.py)
+# ---------------------------------------------------------------------------
+def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1):  # noqa: A002
+    """Embed the last dim as (offset) diagonals of new square matrices."""
+    x = _arr(input)
+    n = x.shape[-1] + abs(offset)
+    rows = jnp.arange(x.shape[-1]) + max(-offset, 0)
+    cols = jnp.arange(x.shape[-1]) + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    # move the two new dims to (dim1, dim2)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for dst, src in order:
+            perm.insert(dst, src)
+        out = jnp.transpose(out, perm)
+    return out
+
+
+def sequence_mask(x, maxlen: Optional[int] = None, dtype="int64"):
+    """(..., maxlen) mask of position < length (reference sequence_mask)."""
+    from ..framework.dtype import convert_dtype
+    x = _arr(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x))  # eager only; pass maxlen under jit
+    pos = jnp.arange(maxlen)
+    return (pos < x[..., None]).astype(convert_dtype(dtype))
+
+
+def _dropout_channels(x, p, training, ndim_spatial, key=None):
+    x = _arr(x)
+    enforce(x.ndim == 2 + ndim_spatial,
+            f"expected {2 + ndim_spatial}-D input, got {x.ndim}-D")
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        key = fw_random.op_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape[:2])
+    keep = keep.reshape(keep.shape + (1,) * ndim_spatial)
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype)).astype(
+        x.dtype)
+
+
+def dropout2d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCHW", key=None):
+    """Drop whole channels of a 4-D tensor (reference dropout2d)."""
+    enforce(data_format == "NCHW", "dropout2d supports NCHW")
+    return _dropout_channels(x, p, training, 2, key)
+
+
+def dropout3d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCDHW", key=None):
+    enforce(data_format == "NCDHW", "dropout3d supports NCDHW")
+    return _dropout_channels(x, p, training, 3, key)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True, key=None):
+    """SELU-preserving dropout (reference alpha_dropout): dropped units go
+    to -alpha' and the output is affinely corrected to keep (0, 1) stats."""
+    x = _arr(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772 * 1.0507009873554805
+    neg = -alpha
+    a = (1 - p + p * neg ** 2) ** -0.5
+    b = -a * p * neg
+    if key is None:
+        key = fw_random.op_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return (a * jnp.where(keep, x, jnp.asarray(neg, x.dtype)) + b).astype(
+        x.dtype)
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW"):
+    l, r, t, b = _ntuple(padding, 4)
+    cfg = ((0, 0), (0, 0), (t, b), (l, r)) if data_format == "NCHW" \
+        else ((0, 0), (t, b), (l, r), (0, 0))
+    return jnp.pad(_arr(x), cfg)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference unfold op): (N, C, H, W) → (N, C*kh*kw, L)."""
+    x = _arr(x)
+    k = _ntuple(kernel_sizes, 2)
+    s = _ntuple(strides, 2)
+    p = _ntuple(paddings, 2)
+    d = _ntuple(dilations, 2)
+    patches = lax.conv_general_dilated_patches(
+        x, k, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, x.shape[1], *k), ("NCHW", "OIHW", "NCHW")))
+    # patches: (N, C*kh*kw, oh, ow)
+    return patches.reshape(patches.shape[0], patches.shape[1], -1)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im, the scatter-add inverse of unfold (reference fold op)."""
+    x = _arr(x)
+    oh, ow = _ntuple(output_sizes, 2)
+    kh, kw = _ntuple(kernel_sizes, 2)
+    s = _ntuple(strides, 2)
+    p = _ntuple(paddings, 2)
+    d = _ntuple(dilations, 2)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    nw = (ow + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    enforce(nh * nw == L,
+            f"fold: {L} columns inconsistent with output {oh}x{ow}")
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    # target row/col for each (kh, nh) / (kw, nw) pair, in padded coords
+    ph = oh + 2 * p[0]
+    pw = ow + 2 * p[1]
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    rows = (np.arange(kh)[:, None] * d[0]
+            + np.arange(nh)[None, :] * s[0]).reshape(-1)
+    colsi = (np.arange(kw)[:, None] * d[1]
+             + np.arange(nw)[None, :] * s[1]).reshape(-1)
+    src = cols.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, kh * nh, kw * nw)
+    out = out.at[:, :, rows[:, None], colsi[None, :]].add(src)
+    return out[:, :, p[0]:ph - p[0] if p[0] else ph,
+               p[1]:pw - p[1] if p[1] else pw]
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    from . import functional as F
+    return F.interpolate(x, size=size, scale_factor=scale_factor,
+                         mode=mode, align_corners=align_corners,
+                         data_format=data_format)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """out[n, o] = x1[n] @ W[o] @ x2[n] (reference bilinear op);
+    weight (out, in1, in2)."""
+    x1, x2, weight = _arr(x1), _arr(x2), _arr(weight)
+    y = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        y = y + _arr(bias).reshape(1, -1)
+    return y
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW"):
+    """TSM channel shift along the segment (time) axis (reference
+    temporal_shift_op): the first ``shift_ratio`` of channels shift
+    backward in time, the next ``shift_ratio`` forward, rest stay."""
+    enforce(data_format == "NCHW", "temporal_shift supports NCHW")
+    x = _arr(x)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1)
+    out = jnp.concatenate([back, fwd, xr[:, :, c2:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference nn/functional/pooling.py) — N-D generalizations
+# ---------------------------------------------------------------------------
+def _pool_nd(x, kernel, stride, padding, nd, reducer, init, channel_last):
+    k = _ntuple(kernel, nd)
+    s = _ntuple(stride if stride is not None else kernel, nd)
+    p = _ntuple(padding, nd)
+    if channel_last:
+        window = (1, *k, 1)
+        strides = (1, *s, 1)
+        pads = ((0, 0), *[(i, i) for i in p], (0, 0))
+    else:
+        window = (1, 1, *k)
+        strides = (1, 1, *s)
+        pads = ((0, 0), (0, 0), *[(i, i) for i in p])
+    return lax.reduce_window(x, init, reducer, window, strides, pads)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCDHW"):
+    x = _arr(x)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return _pool_nd(x, kernel_size, stride, padding, 3, lax.max, init,
+                    data_format == "NDHWC")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCDHW"):
+    x = _arr(x)
+    cl = data_format == "NDHWC"
+    summed = _pool_nd(x, kernel_size, stride, padding, 3, lax.add, 0.0, cl)
+    counts = _pool_nd(jnp.ones_like(x), kernel_size, stride, padding, 3,
+                      lax.add, 0.0, cl)
+    return summed / counts
+
+
+def _adaptive_pool_axis(x, axis, out_size, op):
+    """Adaptive pool one axis via trace-time bin edges (shared with the
+    2-D adaptive pools in functional.py)."""
+    from .functional import _adaptive_avg_matrix, _adaptive_bins
+    in_size = x.shape[axis]
+    if op == "avg":
+        m = jnp.asarray(_adaptive_avg_matrix(in_size, out_size), x.dtype)
+        return jnp.moveaxis(
+            jnp.tensordot(jnp.moveaxis(x, axis, -1), m, axes=[[-1], [1]]),
+            -1, axis)
+    idx, mask = _adaptive_bins(in_size, out_size)
+    xm = jnp.moveaxis(x, axis, -1)
+    g = xm[..., jnp.asarray(idx)]                    # (..., out, span)
+    neg = jnp.asarray(jnp.finfo(x.dtype).min
+                      if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    g = jnp.where(jnp.asarray(mask), g, neg)
+    return jnp.moveaxis(g.max(axis=-1), -1, axis)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    """(N, C, L) → (N, C, output_size)."""
+    return _adaptive_pool_axis(_arr(x), 2, int(output_size), "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask: bool = False):
+    enforce(not return_mask, "return_mask unsupported on adaptive 1d")
+    return _adaptive_pool_axis(_arr(x), 2, int(output_size), "max")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format: str = "NCDHW"):
+    enforce(data_format == "NCDHW", "adaptive_avg_pool3d supports NCDHW")
+    x = _arr(x)
+    od, oh, ow = _ntuple(output_size, 3)
+    for axis, o in ((2, od), (3, oh), (4, ow)):
+        x = _adaptive_pool_axis(x, axis, o, "avg")
+    return x
+
+
+def adaptive_max_pool3d(x, output_size, data_format: str = "NCDHW"):
+    enforce(data_format == "NCDHW", "adaptive_max_pool3d supports NCDHW")
+    x = _arr(x)
+    od, oh, ow = _ntuple(output_size, 3)
+    for axis, o in ((2, od), (3, oh), (4, ow)):
+        x = _adaptive_pool_axis(x, axis, o, "max")
+    return x
+
+
+# --- max-unpool family: scatter values back to argmax positions ----------
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size):
+    x, indices = _arr(x), _arr(indices)
+    k = _ntuple(kernel_size, nd)
+    s = _ntuple(stride if stride is not None else kernel_size, nd)
+    p = _ntuple(padding, nd)
+    n, c = x.shape[0], x.shape[1]
+    in_sp = x.shape[2:]
+    if output_size is None:
+        out_sp = tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                       for i in range(nd))
+    else:
+        out_sp = _ntuple(output_size, nd)
+    flat = int(np.prod(out_sp))
+    xf = x.reshape(n, c, -1)
+    idxf = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, flat), x.dtype).at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idxf
+    ].set(xf)
+    return out.reshape(n, c, *out_sp)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format: str = "NCL"):
+    enforce(data_format == "NCL", "max_unpool1d supports NCL")
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format: str = "NCHW"):
+    """Scatter pooled values to their argmax positions (reference
+    max_unpool2d; ``indices`` as returned by max_pool2d(return_mask=True),
+    flattened over the output plane)."""
+    enforce(data_format == "NCHW", "max_unpool2d supports NCHW")
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format: str = "NCDHW"):
+    enforce(data_format == "NCDHW", "max_unpool3d supports NCDHW")
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size)
+
+
+# ---------------------------------------------------------------------------
+# Losses (reference nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    enforce(reduction == "none", f"unknown reduction {reduction!r}")
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    """BCE on probabilities (reference binary_cross_entropy; see also
+    F.binary_cross_entropy_with_logits for the logits form)."""
+    x = _arr(input).astype(jnp.float32)
+    y = _arr(label).astype(jnp.float32)
+    eps = 1e-12
+    loss = -(y * jnp.log(jnp.maximum(x, eps))
+             + (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+    if weight is not None:
+        loss = loss * _arr(weight)
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):  # noqa: A002
+    """1 - dice coefficient over the last dim's class probs (reference
+    dice_loss): label holds class ids with a trailing singleton dim."""
+    x = _arr(input)
+    y = _arr(label)
+    if y.shape[-1] == 1:
+        y = y[..., 0]
+    oh = jax.nn.one_hot(y, x.shape[-1], dtype=x.dtype)
+    red = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * oh, axis=red)
+    union = jnp.sum(x, axis=red) + jnp.sum(oh, axis=red)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+def log_loss(input, label, epsilon: float = 1e-4):  # noqa: A002
+    x = _arr(input).astype(jnp.float32)
+    y = _arr(label).astype(jnp.float32)
+    return -(y * jnp.log(x + epsilon)
+             + (1 - y) * jnp.log(1 - x + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """N-pair loss (reference npair_loss): cross-entropy over the
+    anchor·positiveᵀ similarity with same-label targets + L2 on embeds."""
+    a = _arr(anchor).astype(jnp.float32)
+    p = _arr(positive).astype(jnp.float32)
+    y = _arr(labels).reshape(-1)
+    sim = a @ p.T                                   # (B, B)
+    tgt = (y[:, None] == y[None, :]).astype(jnp.float32)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    ce = -jnp.mean(jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                    + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+    return ce + reg
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum"):
+    """Focal loss on logits (reference sigmoid_focal_loss)."""
+    x = _arr(logit).astype(jnp.float32)
+    y = _arr(label).astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / _arr(normalizer)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100,
+                               numeric_stable_mode: bool = True,
+                               return_softmax: bool = False, axis: int = -1):
+    """The fused op the reference trains with (softmax_with_cross_entropy):
+    per-sample loss keeping the class axis as a singleton; optionally the
+    softmax too."""
+    x = _arr(logits)
+    y = _arr(label)
+    lsm = jax.nn.log_softmax(x.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(y.astype(jnp.float32) * lsm, axis=axis,
+                        keepdims=True)
+    else:
+        yi = y if y.ndim == x.ndim else jnp.expand_dims(y, axis)
+        safe = jnp.where(yi == ignore_index, 0, yi)
+        nll = -jnp.take_along_axis(lsm, safe.astype(jnp.int32), axis=axis)
+        loss = jnp.where(yi == ignore_index, 0.0, nll)
+    if return_softmax:
+        return loss, jax.nn.softmax(x.astype(jnp.float32), axis=axis)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1: float = 1.0,
+                         margin2: float = 0.5, margin3: float = 0.0,
+                         scale: float = 64.0, group=None,
+                         return_softmax: bool = False,
+                         reduction: Optional[str] = "mean"):
+    """ArcFace/CosFace-family margin softmax (reference
+    margin_cross_entropy): logits are cosines; the target class cosine
+    becomes cos(m1·θ + m2) - m3 before scaling."""
+    x = _arr(logits).astype(jnp.float32)
+    y = _arr(label).reshape(-1)
+    cos_t = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+    theta = jnp.arccos(jnp.clip(cos_t, -1.0 + 1e-7, 1.0 - 1e-7))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    oh = jax.nn.one_hot(y, x.shape[1], dtype=x.dtype)
+    adj = x + oh * (target - cos_t)
+    adj = adj * scale
+    lsm = jax.nn.log_softmax(adj, axis=1)
+    loss = -jnp.take_along_axis(lsm, y[:, None].astype(jnp.int32), axis=1)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jax.nn.softmax(adj, axis=1)
+    return loss
+
+
+def class_center_sample(label, num_classes: int, num_samples: int,
+                        group=None, seed: Optional[int] = None):
+    """Sample class centers: positives plus random negatives up to
+    ``num_samples`` (reference class_center_sample, the PartialFC
+    primitive).  Host-side sampling (numpy): the op prepares training
+    metadata, not traced compute."""
+    y = np.asarray(label).reshape(-1)
+    rng = np.random.RandomState(seed if seed is not None
+                                else np.random.randint(2 ** 31))
+    pos = np.unique(y)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos,
+                            assume_unique=False)
+        rng.shuffle(rest)
+        sampled = np.concatenate([pos, rest[:num_samples - len(pos)]])
+    sampled = np.sort(sampled)
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (jnp.asarray(remap[y]), jnp.asarray(sampled))
+
+
+def hsigmoid_loss(input, label, num_classes: int, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse: bool = False):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hsigmoid_loss / hierarchical_sigmoid_op): word2vec-style
+    heap layout — leaf ``l`` sits at heap position ``l + num_classes``;
+    internal node ``k``'s parameters are row ``k - 1`` of ``weight``
+    ((num_classes - 1, feature)).  Custom trees ride path_table/path_code
+    ((B, L) node ids / branch codes, -1 padded)."""
+    x = _arr(input).astype(jnp.float32)
+    y = np.asarray(label).reshape(-1) if path_table is None else None
+    w = _arr(weight).astype(jnp.float32)
+    if path_table is None:
+        depth = int(np.ceil(np.log2(num_classes))) + 1
+        tables, codes = [], []
+        for l in y:
+            node = int(l) + num_classes
+            t, c = [], []
+            while node > 1:
+                t.append(node // 2 - 1)     # internal node row
+                c.append(node % 2)          # branch taken
+                node //= 2
+            t += [-1] * (depth - len(t))
+            c += [0] * (depth - len(c))
+            tables.append(t[:depth])
+            codes.append(c[:depth])
+        path_table = jnp.asarray(tables, jnp.int32)
+        path_code = jnp.asarray(codes, jnp.float32)
+    else:
+        path_table = _arr(path_table).astype(jnp.int32)
+        path_code = _arr(path_code).astype(jnp.float32)
+    valid = path_table >= 0
+    safe = jnp.where(valid, path_table, 0)
+    wn = w[safe]                                    # (B, L, F)
+    z = jnp.einsum("bf,blf->bl", x, wn)
+    if bias is not None:
+        z = z + _arr(bias).astype(jnp.float32).reshape(-1)[safe]
+    # code 1 → sigmoid(z), code 0 → sigmoid(-z)
+    sign = 2.0 * path_code - 1.0
+    ll = jax.nn.log_sigmoid(sign * z)
+    loss = -jnp.sum(jnp.where(valid, ll, 0.0), axis=1)
+    return loss[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Norm (reference nn/functional/norm.py)
+# ---------------------------------------------------------------------------
+def local_response_norm(x, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0,
+                        data_format: str = "NCHW"):
+    """AlexNet LRN (reference local_response_norm): divide by
+    (k + alpha/size * Σ_window x²)^beta over a channel window."""
+    x = _arr(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    window = [1] * x.ndim
+    window[ch_axis] = size
+    pads = [(0, 0)] * x.ndim
+    pads[ch_axis] = (lo, hi)
+    acc = lax.reduce_window(sq, 0.0, lax.add, tuple(window),
+                            (1,) * x.ndim, tuple(pads))
+    div = jnp.power(k + alpha / size * acc, beta)
+    return x / div
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats: bool = True,
+                  momentum: float = 0.9, eps: float = 1e-5,
+                  data_format: str = "NCHW"):
+    """Per-sample per-channel normalization (reference instance_norm)."""
+    x = _arr(x)
+    enforce(data_format.startswith("NC"),
+            "instance_norm supports channel-first layouts")
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * _arr(weight).reshape(shape)
+    if bias is not None:
+        y = y + _arr(bias).reshape(shape)
+    return y.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Vision (reference nn/functional/vision.py)
+# ---------------------------------------------------------------------------
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """(N, 2, 3) affine matrices → (N, H, W, 2) sampling grid in [-1, 1]
+    coords (reference affine_grid)."""
+    theta = _arr(theta).astype(jnp.float32)
+    n, c, h, w = out_shape
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+        xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # (H, W, 3)
+    # coordinates, not MXU work: the fast low-precision matmul path would
+    # shift sample positions by ~1e-3
+    return jnp.einsum("hwk,njk->nhwj", base, theta,
+                      precision=lax.Precision.HIGHEST)
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """Sample (N, C, H, W) at (N, Ho, Wo, 2) normalized grid coords
+    (reference grid_sample): bilinear/nearest; zeros/border padding."""
+    x = _arr(x)
+    grid = _arr(grid).astype(jnp.float32)
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def gather(ix, iy):
+        inside = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        if padding_mode == "border":
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+        else:
+            ixc = jnp.where(inside, ix, 0)
+            iyc = jnp.where(inside, iy, 0)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # (N,Ho,Wo,C)
+        if padding_mode == "zeros":
+            vals = jnp.where(inside[..., None], vals, 0)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        dx = (fx - x0)[..., None]
+        dy = (fy - y0)[..., None]
+        out = (gather(x0, y0) * (1 - dx) * (1 - dy)
+               + gather(x0 + 1, y0) * dx * (1 - dy)
+               + gather(x0, y0 + 1) * (1 - dx) * dy
+               + gather(x0 + 1, y0 + 1) * dx * dy)
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Decoding (reference nn/decode.py gather_tree op)
+# ---------------------------------------------------------------------------
+def gather_tree(ids, parents):
+    """Backtrace beam-search parent pointers into full sequences
+    (reference gather_tree op): ids/parents are (T, B, beam)."""
+    ids, parents = _arr(ids), _arr(parents)
+    T = ids.shape[0]
+    beams = jnp.arange(ids.shape[2])[None, :] * jnp.ones(
+        (ids.shape[1], 1), jnp.int32)
+
+    def step(carry, t):
+        beam = carry
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        parent = jnp.take_along_axis(parents[t], beam, axis=1)
+        return parent.astype(jnp.int32), tok
+
+    _, toks = lax.scan(step, beams.astype(jnp.int32),
+                       jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)

@@ -211,7 +211,8 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
     return y[..., 0, :]
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               data_format="NCHW"):
     x = _arr(x)
     k, s = _pair(kernel_size), _pair(stride if stride is not None else kernel_size)
     p = _pair(padding)
@@ -224,7 +225,38 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
         strides = (1, s[0], s[1], 1)
         pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-    return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if not return_mask:
+        return out
+    # argmax indices (flattened over the input plane) for max_unpool2d:
+    # per-window argmax over patch positions, converted to global offsets.
+    # dilated_patches pads with ZEROS (it is a conv with one-hot kernels),
+    # which would beat negative maxima and emit out-of-range indices; pad
+    # manually with the FINITE dtype minimum first (-inf is unusable here:
+    # the one-hot conv computes -inf * 0 = NaN).
+    enforce(data_format == "NCHW", "return_mask supports NCHW")
+    n, c, h, w = x.shape
+    lowest = (jnp.finfo(x.dtype).min
+              if jnp.issubdtype(x.dtype, jnp.floating)
+              else jnp.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                 constant_values=lowest)
+    patches = lax.conv_general_dilated_patches(
+        xp, k, s, [(0, 0), (0, 0)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            xp.shape, (1, c, *k), ("NCHW", "OIHW", "NCHW")))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+    within = jnp.argmax(patches, axis=2)           # (N, C, oh, ow)
+    ky = within // k[1]
+    kx = within % k[1]
+    oy = jnp.arange(oh)[:, None] * s[0] - p[0]
+    ox = jnp.arange(ow)[None, :] * s[1] - p[1]
+    # clip guards the degenerate real-value == dtype-min tie with padding
+    rows = jnp.clip(oy[None, None] + ky, 0, h - 1)
+    cols = jnp.clip(ox[None, None] + kx, 0, w - 1)
+    mask = (rows * w + cols).astype(jnp.int32)
+    return out, mask
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
@@ -1029,3 +1061,8 @@ def square_error_cost(input, label):
     static-graph regression staple)."""
     d = _arr(input) - _arr(label)
     return d * d
+
+
+# long-tail functional surface (reference functional __all__ parity) —
+# see _functional_ext.py
+from ._functional_ext import *  # noqa: F401,F403,E402

@@ -178,3 +178,51 @@ class ParamAttr:
         self.learning_rate = learning_rate
         self.regularizer = regularizer
         self.need_clip = need_clip
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """Recommended init gain per nonlinearity (reference
+    initializer.calculate_gain)."""
+    import math
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0), "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        slope = 0.01 if param is None else float(param)
+        return math.sqrt(2.0 / (1 + slope ** 2))
+    if nonlinearity in gains:
+        return gains[nonlinearity]
+    raise ValueError(f"unknown nonlinearity {nonlinearity!r}")
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    initializer/Bilinear): weight (C_in, C_out, k, k) gets the classic
+    interpolation stencil per channel pair's diagonal."""
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        from ..framework.errors import enforce
+        enforce(len(shape) == 4, "Bilinear init expects a 4-D conv weight")
+        k = shape[-1]
+        enforce(shape[-2] == k, "Bilinear init expects square kernels")
+        f = (k + 1) // 2
+        c = f - 1 if k % 2 == 1 else f - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] - c) / f)
+                * (1 - np.abs(og[1] - c) / f)).astype(np.float32)
+        w = np.broadcast_to(filt, shape).copy()
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference set_global_initializer: default initializers used by
+    Layer.create_parameter when no per-parameter initializer is given.
+    Pass (None, None) to reset."""
+    _global_initializer["weight"] = weight_init
+    _global_initializer["bias"] = bias_init

@@ -199,6 +199,8 @@ class Layer:
         if init is None and attr is not None and getattr(attr, "initializer", None):
             init = attr.initializer
         if init is None:
+            init = I._global_initializer["bias" if is_bias else "weight"]
+        if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(fw_random.next_key(), tuple(shape), dtype)
         return Parameter(value, trainable=trainable, is_bias=is_bias)

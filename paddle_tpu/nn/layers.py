@@ -99,7 +99,7 @@ class MaxPool2D(Layer):
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.data_format)
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):

@@ -1,0 +1,567 @@
+"""nn layer long tail (reference: python/paddle/nn/layer/{activation,
+common,conv,norm,pooling,loss,container}.py + nn/decode.py) — the last
+classes of the reference ``nn.__all__`` beyond layers.py, all thin
+stateful wrappers over the functional surface.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+from ..framework.errors import enforce
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+from .layers import _BatchNormBase
+from .rnn import RNNCellBase  # noqa: F401  (re-export; reference nn.__all__)
+
+__all__ = [
+    "CELU", "ELU", "SELU", "Silu", "Swish", "Softsign", "LogSigmoid",
+    "Maxout", "Hardshrink", "Softshrink", "Hardtanh", "ThresholdedReLU",
+    "Tanhshrink",
+    "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
+    "Dropout2D", "Dropout3D", "AlphaDropout",
+    "Unfold", "Fold", "Bilinear",
+    "MaxPool3D", "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool1D", "AdaptiveMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "Conv1DTranspose", "Conv3DTranspose",
+    "BatchNorm", "SyncBatchNorm", "LocalResponseNorm",
+    "BCELoss", "HSigmoidLoss",
+    "LayerDict", "RNNCellBase", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+def _act(name, fn, extra=()):
+    """Build a stateless activation Layer class around a functional."""
+    keys = [k for k, _ in extra]
+
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        params = dict(extra)
+        for i, a in enumerate(args):
+            params[keys[i]] = a
+        for k, v in kwargs.items():
+            if k in params:
+                params[k] = v
+        self._extra = [params[k] for k in keys]
+
+    def forward(self, x):
+        return fn(x, *self._extra)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward,
+                                 "__doc__": f"Stateless {name} activation "
+                                            f"(reference nn.{name})."})
+
+
+CELU = _act("CELU", F.celu, (("alpha", 1.0),))
+ELU = _act("ELU", F.elu, (("alpha", 1.0),))
+SELU = _act("SELU", F.selu)
+Silu = _act("Silu", F.silu)
+Swish = _act("Swish", F.swish)
+Softsign = _act("Softsign", F.softsign)
+LogSigmoid = _act("LogSigmoid", F.log_sigmoid)
+Hardshrink = _act("Hardshrink", F.hardshrink, (("threshold", 0.5),))
+Softshrink = _act("Softshrink", F.softshrink, (("threshold", 0.5),))
+Tanhshrink = _act("Tanhshrink", F.tanhshrink)
+ThresholdedReLU = _act("ThresholdedReLU", F.thresholded_relu,
+                       (("threshold", 1.0),))
+
+
+class Hardtanh(Layer):
+    def __init__(self, min: float = -1.0, max: float = 1.0):  # noqa: A002
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return F.hardtanh(x, self.min, self.max)
+
+
+class Maxout(Layer):
+    def __init__(self, groups: int, axis: int = 1):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+# ---------------------------------------------------------------------------
+# Padding (reference nn/layer/common.py PadXD: flat [pre, post] per
+# trailing spatial dim, passed through to F.pad's flat convention)
+# ---------------------------------------------------------------------------
+class _PadND(Layer):
+    SPATIAL = 1
+
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0,
+                 data_format: Optional[str] = None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self.SPATIAL)
+        enforce(len(padding) == 2 * self.SPATIAL,
+                f"padding must have {2 * self.SPATIAL} entries")
+        self.padding = list(padding)
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class Pad1D(_PadND):
+    SPATIAL = 1
+
+
+class Pad2D(_PadND):
+    SPATIAL = 2
+
+
+class Pad3D(_PadND):
+    SPATIAL = 3
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format: str = "NCHW"):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 4
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# Dropout variants
+# ---------------------------------------------------------------------------
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW"):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCDHW"):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+# ---------------------------------------------------------------------------
+# Shape ops / bilinear
+# ---------------------------------------------------------------------------
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_features,), is_bias=True, attr=bias_attr))
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# ---------------------------------------------------------------------------
+# Pooling layers
+# ---------------------------------------------------------------------------
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCDHW"):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+
+    def forward(self, x):
+        k, s, p, df = self.args
+        return F.max_pool3d(x, k, s, p, data_format=df)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCDHW"):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+
+    def forward(self, x):
+        k, s, p, df = self.args
+        return F.avg_pool3d(x, k, s, p, data_format=df)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask: bool = False):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format: str = "NCDHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask: bool = False,
+                 data_format: str = "NCDHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, self.data_format)
+
+
+class _MaxUnPoolND(Layer):
+    FN = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self.args
+        return type(self).FN(x, indices, k, s, p, o)
+
+
+class MaxUnPool1D(_MaxUnPoolND):
+    FN = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPoolND):
+    FN = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPoolND):
+    FN = staticmethod(F.max_unpool3d)
+
+
+# ---------------------------------------------------------------------------
+# Transposed convs
+# ---------------------------------------------------------------------------
+class _ConvTransposeND(Layer):
+    ND = 1
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, output_padding=0, groups: int = 1,
+                 dilation=1, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        nd = self.ND
+        k = ((kernel_size,) * nd if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *k), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True, attr=bias_attr))
+        self.conv_args = (stride, padding, output_padding, groups, dilation)
+
+    def forward(self, x):
+        s, p, op, g, d = self.conv_args
+        fn = F.conv1d_transpose if self.ND == 1 else F.conv3d_transpose
+        return fn(x, self.weight, self.bias, stride=s, padding=p,
+                  output_padding=op, groups=g, dilation=d)
+
+
+class Conv1DTranspose(_ConvTransposeND):
+    ND = 1
+
+
+class Conv3DTranspose(_ConvTransposeND):
+    ND = 3
+
+
+# ---------------------------------------------------------------------------
+# Norm layers
+# ---------------------------------------------------------------------------
+class BatchNorm(_BatchNormBase):
+    """Legacy paddle.nn.BatchNorm (fluid dygraph BatchNorm signature:
+    positional num_channels, optional act)."""
+
+    def __init__(self, num_channels: int, act=None, momentum: float = 0.9,
+                 epsilon: float = 1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True, use_global_stats=False,
+                 trainable_statistics=False):
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout, dtype=dtype)
+        self._act = act
+
+    def forward(self, x):
+        y = super().forward(x)
+        if self._act is not None:
+            fn = getattr(F, self._act, None)
+            enforce(fn is not None, f"BatchNorm: unknown act {self._act!r}")
+            y = fn(y)
+        return y
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Reference SyncBatchNorm (python/paddle/nn/layer/norm.py): batch
+    statistics synchronized across data-parallel workers.  Under GSPMD the
+    batch axis is sharded over the dp mesh axis and ``jnp.mean`` over it
+    compiles to a global reduction (XLA inserts the collective), so the
+    plain batch-norm math IS synchronized — no side channel needed.  The
+    class exists for the reference surface: `convert_sync_batchnorm`
+    rewrites _BatchNormBase instances in a layer tree."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            out = cls.__new__(cls)
+            out.__dict__.update(layer.__dict__)
+            return out
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size: int = 5, alpha: float = 1e-4,
+                 beta: float = 0.75, k: float = 1.0,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.binary_cross_entropy(input, label, self.weight,
+                                      self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size: int, num_classes: int,
+                 weight_attr=None, bias_attr=None, is_custom: bool = False,
+                 is_sparse: bool = False):
+        super().__init__()
+        enforce(num_classes >= 2, "num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), is_bias=True, attr=bias_attr))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+class LayerDict(Layer):
+    """Dict container (reference nn.LayerDict): ordered, registers values
+    as sublayers."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, sublayer):
+        self.add_sublayer(key, sublayer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        v = self._sub_layers[key]
+        del self._sub_layers[key]
+        return v
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        pairs = sublayers.items() if hasattr(sublayers, "items") \
+            else sublayers
+        for k, v in pairs:
+            self.add_sublayer(k, v)
+
+
+# ---------------------------------------------------------------------------
+# Beam-search decoding (reference nn/decode.py BeamSearchDecoder:64 +
+# dynamic_decode:1000)
+# ---------------------------------------------------------------------------
+class BeamSearchDecoder:
+    """Beam search over an RNN cell (reference nn/decode.py:64).
+
+    The cell contract matches paddle: ``cell(inputs, states) -> (out,
+    new_states)``; ``output_fn`` maps cell output to vocab logits.  State
+    tensors are tiled to (batch * beam, ...).
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size: int):
+        """(B, ...) → (B*beam, ...) by repeating each row beam times."""
+        x = jnp.asarray(x)
+        return jnp.repeat(x, beam_size, axis=0)
+
+    def initialize(self, initial_states, batch_size: int):
+        k = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda s: self.tile_beam_merge_with_batch(s, k), initial_states)
+        tokens = jnp.full((batch_size, k), self.start_token, jnp.int32)
+        # beam 0 live, others -inf so the first expansion is from one beam
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (k - 1), jnp.float32)[None, :],
+            (batch_size, 1))
+        finished = jnp.zeros((batch_size, k), bool)
+        return tokens, log_probs, finished, states
+
+    def step(self, tokens, log_probs, finished, states):
+        b, k = tokens.shape
+        inp = tokens.reshape(b * k)
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        out, new_states = self.cell(inp, states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(b, k, v)
+        # finished beams only extend with end_token at no cost
+        fin_mask = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], fin_mask[None, None, :], logp)
+        total = log_probs[..., None] + logp            # (B, K, V)
+        top_val, top_idx = jax.lax.top_k(total.reshape(b, k * v), k)
+        parent = (top_idx // v).astype(jnp.int32)      # (B, K)
+        token = (top_idx % v).astype(jnp.int32)
+        # reorder states by parent beam
+        def reorder(s):
+            s = s.reshape(b, k, *s.shape[1:])
+            s = jnp.take_along_axis(
+                s, parent.reshape(b, k, *([1] * (s.ndim - 2))), axis=1)
+            return s.reshape(b * k, *s.shape[2:])
+        new_states = jax.tree_util.tree_map(reorder, new_states)
+        new_fin = jnp.take_along_axis(finished, parent, axis=1) \
+            | (token == self.end_token)
+        return token, top_val, new_fin, new_states, parent
+
+
+def dynamic_decode(decoder, inits=None, max_step_num: int = 32,
+                   batch_size: Optional[int] = None, **kwargs):
+    """Run a BeamSearchDecoder to completion (reference nn/decode.py:1000):
+    returns (token ids (B, beam, T) backtraced via gather_tree, final
+    sequence log-probs (B, beam))."""
+    enforce(batch_size is not None or inits is not None,
+            "dynamic_decode needs inits or batch_size")
+    if batch_size is None:
+        leaves = jax.tree_util.tree_leaves(inits)
+        batch_size = leaves[0].shape[0]
+    tokens, log_probs, finished, states = decoder.initialize(
+        inits, batch_size)
+    ids_steps, parent_steps = [], []
+    for _ in range(max_step_num):
+        tokens, log_probs, finished, states, parent = decoder.step(
+            tokens, log_probs, finished, states)
+        ids_steps.append(tokens)
+        parent_steps.append(parent)
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(ids_steps)                 # (T, B, K)
+    parents = jnp.stack(parent_steps)
+    seqs = F.gather_tree(ids, parents)         # (T, B, K)
+    return jnp.transpose(seqs, (1, 2, 0)), log_probs

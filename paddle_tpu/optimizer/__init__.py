@@ -500,3 +500,33 @@ class Lars(Optimizer):
 
 
 __all__.append("Lars")
+
+
+class Adadelta(Optimizer):
+    """Reference adadelta_op: accumulated-gradient / accumulated-update
+    adaptive steps; no explicit learning-rate dependence beyond scaling."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.epsilon, self.rho = epsilon, rho
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(jnp.asarray(p), jnp.float32)
+        return {"avg_squared_grad": z, "avg_squared_update": z}
+
+    def _update(self, g, p, slots, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        eg = self.rho * slots["avg_squared_grad"] + (1 - self.rho) * jnp.square(g)
+        upd = (jnp.sqrt(slots["avg_squared_update"] + self.epsilon)
+               / jnp.sqrt(eg + self.epsilon)) * g
+        eu = self.rho * slots["avg_squared_update"] + (1 - self.rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_squared_grad": eg,
+                              "avg_squared_update": eu}
+
+
+Adamax = AdamMax      # reference spells the public class "Adamax"
+__all__ += ["Adadelta", "Adamax"]

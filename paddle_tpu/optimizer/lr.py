@@ -193,3 +193,46 @@ class ReduceOnPlateau(LRScheduler):
             if self._bad > self.patience:
                 self._lr = max(self._lr * self.factor, self.min_lr)
                 self._bad, self._cool = 0, self.cooldown
+
+
+class NaturalExpDecay(LRScheduler):
+    """lr * e^(-gamma * epoch) (reference lr.py NaturalExpDecay)."""
+
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        return self.base_lr * jnp.exp(-self.gamma
+                                      * jnp.asarray(step, jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    """lr / (1 + gamma * epoch) (reference lr.py InverseTimeDecay)."""
+
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        return self.base_lr / (1.0 + self.gamma
+                               * jnp.asarray(step, jnp.float32))
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr * prod_{e<=epoch} lmbda(e) (reference lr.py MultiplicativeDecay).
+    The running product makes this a host-side (non-traced) schedule."""
+
+    def __init__(self, learning_rate: float, lr_lambda,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _compute(self, step):
+        step = int(step)
+        factor = 1.0
+        for e in range(1, step + 1):
+            factor *= self.lr_lambda(e)
+        return jnp.asarray(self.base_lr * factor, jnp.float32)

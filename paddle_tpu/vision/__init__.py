@@ -2,3 +2,35 @@
 from . import datasets, transforms  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """Reference vision.set_image_backend: 'pil' | 'cv2' | 'tensor'."""
+    from ..framework.errors import enforce
+    enforce(backend in ("pil", "cv2", "tensor"),
+            f"unknown image backend {backend!r}")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path: str, backend=None):
+    """Load an image per the active backend (reference vision.image_load);
+    'tensor'/'cv2' return HWC numpy, 'pil' a PIL Image."""
+    b = backend or _image_backend
+    from PIL import Image
+    img = Image.open(path)
+    if b == "pil":
+        return img
+    import numpy as np
+    return np.asarray(img)
+
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load",
+           "transforms", "datasets", "models", "ops"]

@@ -467,3 +467,144 @@ def decode_jpeg(x, mode: str = "unchanged"):
     else:
         arr = np.transpose(arr, (2, 0, 1))
     return jnp.asarray(arr)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num: int,
+              ignore_thresh: float, downsample_ratio: int, gt_score=None,
+              use_label_smooth: bool = True, name=None,
+              scale_x_y: float = 1.0):
+    """YOLOv3 training loss (reference ops.py yolo_loss /
+    yolov3_loss_op.cc): per-sample sum of location (BCE x/y + L1 w/h,
+    box-scale weighted), objectness (BCE; negatives whose best IoU with
+    any gt exceeds ignore_thresh are ignored), and class BCE terms.
+
+    x: (N, A*(5+C), H, W) head output; gt_box: (N, B, 4) normalized
+    center-xywh; gt_label: (N, B) int (negative/zero-area boxes are
+    padding); anchors: flat pixel pairs for ALL anchors; anchor_mask:
+    indices of this head's anchors.  Returns (N,) loss.
+    """
+    x = jnp.asarray(x)
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gt_label = jnp.asarray(gt_label, jnp.int32)
+    n, c, h, w = x.shape
+    a = len(anchor_mask)
+    enforce(c == a * (5 + class_num),
+            f"yolo_loss expects {a * (5 + class_num)} channels, got {c}")
+    all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    mask_anchors = all_anchors[jnp.asarray(anchor_mask)]
+    input_h = float(downsample_ratio * h)
+    input_w = float(downsample_ratio * w)
+    b = gt_box.shape[1]
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), jnp.float32)
+    else:
+        gt_score = jnp.asarray(gt_score, jnp.float32)
+
+    feats = x.reshape(n, a, 5 + class_num, h, w)
+    px, py = feats[:, :, 0], feats[:, :, 1]          # raw logits
+    pw, ph = feats[:, :, 2], feats[:, :, 3]
+    pobj = feats[:, :, 4]
+    pcls = feats[:, :, 5:]                           # (n, a, C, h, w)
+
+    valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0)   # (n, b)
+
+    # --- responsible anchor per gt: best wh-IoU over ALL anchors --------
+    gw = gt_box[:, :, 2] * input_w                   # pixels
+    gh = gt_box[:, :, 3] * input_h
+    inter = (jnp.minimum(gw[:, :, None], all_anchors[None, None, :, 0])
+             * jnp.minimum(gh[:, :, None], all_anchors[None, None, :, 1]))
+    union = (gw * gh)[:, :, None] \
+        + (all_anchors[:, 0] * all_anchors[:, 1])[None, None, :] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=2)  # (n, b)
+    # position in THIS head's mask (or -1)
+    mask_arr = jnp.asarray(anchor_mask)
+    in_head = best[:, :, None] == mask_arr[None, None, :]        # (n,b,a)
+    head_slot = jnp.where(jnp.any(in_head, 2),
+                          jnp.argmax(in_head, 2), -1)            # (n, b)
+    responsible = valid & (head_slot >= 0)
+
+    gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # --- scatter targets over the (n, a, h, w) grid ---------------------
+    slot = jnp.where(responsible, head_slot, 0)
+    ni = jnp.arange(n)[:, None] * jnp.ones((1, b), jnp.int32)
+    sel = (ni, slot, gj, gi)
+    on = responsible.astype(jnp.float32)
+
+    def scat(values):
+        z = jnp.zeros((n, a, h, w), jnp.float32)
+        return z.at[sel].add(values * on)
+
+    obj_t = scat(gt_score)
+    obj_pos = scat(jnp.ones_like(gt_score))
+    tx = scat(gt_box[:, :, 0] * w - gi.astype(jnp.float32))
+    ty = scat(gt_box[:, :, 1] * h - gj.astype(jnp.float32))
+    aw = mask_anchors[slot, 0]
+    ah = mask_anchors[slot, 1]
+    tw = scat(jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-9), 1e-9)))
+    th = scat(jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-9), 1e-9)))
+    # box-scale weight 2 - w*h de-emphasizes large boxes (darknet trick)
+    bweight = scat(2.0 - gt_box[:, :, 2] * gt_box[:, :, 3])
+
+    delta = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+    cls_t = jnp.zeros((n, a, class_num, h, w), jnp.float32)
+    lbl = jnp.clip(gt_label, 0, class_num - 1)
+    cls_t = cls_t.at[ni, slot, lbl, gj, gi].add(on)
+    cls_t = jnp.clip(cls_t, 0.0, 1.0)
+    if delta:
+        cls_t = cls_t * (1.0 - delta) + delta / class_num
+
+    def bce(logit, target):
+        return (jnp.maximum(logit, 0) - logit * target
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    pos = obj_pos
+    loss_xy = pos * bweight * (bce(px, tx) + bce(py, ty))
+    loss_wh = pos * bweight * 0.5 * (jnp.abs(pw - tw) + jnp.abs(ph - th))
+
+    # --- ignore mask: negatives overlapping a gt box beyond thresh ------
+    gx_grid = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy_grid = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(px) * scale_x_y - bias + gx_grid) / w
+    cy = (jax.nn.sigmoid(py) * scale_x_y - bias + gy_grid) / h
+    bw = jnp.exp(jnp.clip(pw, -10, 10)) * mask_anchors[None, :, 0,
+                                                       None, None] / input_w
+    bh = jnp.exp(jnp.clip(ph, -10, 10)) * mask_anchors[None, :, 1,
+                                                       None, None] / input_h
+    p1 = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+                   axis=-1)                          # (n, a, h, w, 4)
+    g1 = jnp.stack([gt_box[:, :, 0] - gt_box[:, :, 2] / 2,
+                    gt_box[:, :, 1] - gt_box[:, :, 3] / 2,
+                    gt_box[:, :, 0] + gt_box[:, :, 2] / 2,
+                    gt_box[:, :, 1] + gt_box[:, :, 3] / 2], axis=-1)
+    px1 = p1[:, :, :, :, None, :]
+    gb1 = g1[:, None, None, None, :, :]
+    iw = jnp.maximum(jnp.minimum(px1[..., 2], gb1[..., 2])
+                     - jnp.maximum(px1[..., 0], gb1[..., 0]), 0)
+    ih = jnp.maximum(jnp.minimum(px1[..., 3], gb1[..., 3])
+                     - jnp.maximum(px1[..., 1], gb1[..., 1]), 0)
+    inter2 = iw * ih
+    area_p = ((px1[..., 2] - px1[..., 0])
+              * (px1[..., 3] - px1[..., 1]))
+    area_g = ((gb1[..., 2] - gb1[..., 0])
+              * (gb1[..., 3] - gb1[..., 1]))
+    iou = inter2 / jnp.maximum(area_p + area_g - inter2, 1e-9)
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1)                 # (n, a, h, w)
+    noobj_mask = ((best_iou <= ignore_thresh)
+                  & (pos == 0)).astype(jnp.float32)
+
+    loss_obj = pos * obj_t * bce(pobj, jnp.ones_like(pobj)) \
+        + noobj_mask * bce(pobj, jnp.zeros_like(pobj))
+    loss_cls = pos[:, :, None] * bce(pcls, cls_t)
+
+    total = (jnp.sum(loss_xy, axis=(1, 2, 3))
+             + jnp.sum(loss_wh, axis=(1, 2, 3))
+             + jnp.sum(loss_obj, axis=(1, 2, 3))
+             + jnp.sum(loss_cls, axis=(1, 2, 3, 4)))
+    return total
+
+
+__all__.append("yolo_loss")

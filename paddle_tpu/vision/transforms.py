@@ -316,3 +316,121 @@ class RandomRotation:
 __all__ += ["RandomVerticalFlip", "Pad", "Grayscale", "BrightnessTransform",
             "ContrastTransform", "SaturationTransform", "HueTransform",
             "ColorJitter", "RandomResizedCrop", "RandomRotation"]
+
+
+# ---------------------------------------------------------------------------
+# Functional forms (reference vision/transforms/functional.py) + the
+# BaseTransform class-transform base.  Host-side numpy like the classes.
+# ---------------------------------------------------------------------------
+class BaseTransform:
+    """Reference transforms.BaseTransform: keys-aware transform base.
+    Subclasses implement _apply_image (and optionally _apply_boxes /
+    _apply_mask); __call__ routes inputs per ``keys``."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def _apply_boxes(self, boxes):
+        return boxes
+
+    def _apply_mask(self, mask):
+        return mask
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outs.append(fn(data) if fn is not None else data)
+        return tuple(outs)
+
+
+def to_tensor(pic, data_format: str = "CHW"):
+    out = ToTensor()(pic)
+    return out if data_format == "CHW" else out.transpose(1, 2, 0)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    return Resize(size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    return np.asarray(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def rotate(img, angle: float, interpolation: str = "nearest",
+           expand: bool = False, center=None, fill=0):
+    """Rotate an HWC image by ``angle`` degrees (nearest-neighbor inverse
+    mapping, host-side)."""
+    x = np.asarray(img)
+    h, w = x.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    yy, xx = np.mgrid[0:h, 0:w]
+    # inverse rotation: output pixel ← source position
+    sx = cos * (xx - cx) + sin * (yy - cy) + cx
+    sy = -sin * (xx - cx) + cos * (yy - cy) + cy
+    sxi = np.round(sx).astype(np.int64)
+    syi = np.round(sy).astype(np.int64)
+    inside = (sxi >= 0) & (sxi < w) & (syi >= 0) & (syi < h)
+    out = np.full_like(x, fill)
+    out[inside] = x[syi[inside], sxi[inside]]
+    return out
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    return Grayscale(num_output_channels)(img)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    orig = np.asarray(img).dtype
+    return _jitter_out(np.asarray(img, np.float32) * brightness_factor,
+                       orig)
+
+
+def adjust_contrast(img, contrast_factor: float):
+    orig = np.asarray(img).dtype
+    x = np.asarray(img, np.float32)
+    mean = x.mean()
+    return _jitter_out(mean + contrast_factor * (x - mean), orig)
+
+
+def adjust_hue(img, hue_factor: float):
+    orig = np.asarray(img).dtype
+    x = np.asarray(img, np.float32)
+    alpha = float(np.clip(abs(hue_factor), 0.0, 1.0))
+    return _jitter_out((1 - alpha) * x + alpha * np.roll(x, 1, axis=-1),
+                       orig)
+
+
+def normalize(img, mean, std, data_format: str = "CHW",
+              to_rgb: bool = False):
+    return Normalize(mean, std, data_format)(img)
+
+
+__all__ += ["BaseTransform", "to_tensor", "hflip", "vflip", "resize",
+            "pad", "crop", "center_crop", "rotate", "to_grayscale",
+            "adjust_brightness", "adjust_contrast", "adjust_hue",
+            "normalize"]

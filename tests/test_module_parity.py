@@ -1,0 +1,64 @@
+"""Module-level __all__ parity against the reference package: every public
+name the reference exports from its major modules must exist here (the
+test-suite rendering of SURVEY C13's compat contract)."""
+import ast
+import importlib
+import os
+
+import pytest
+
+R = "/root/reference/python/paddle/"
+
+PAIRS = [
+    ("paddle", R + "__init__.py", "paddle_tpu"),
+    ("nn", R + "nn/__init__.py", "paddle_tpu.nn"),
+    ("nn.functional", R + "nn/functional/__init__.py",
+     "paddle_tpu.nn.functional"),
+    ("nn.initializer", R + "nn/initializer/__init__.py",
+     "paddle_tpu.nn.initializer"),
+    ("optimizer", R + "optimizer/__init__.py", "paddle_tpu.optimizer"),
+    ("optimizer.lr", R + "optimizer/lr.py", "paddle_tpu.optimizer.lr"),
+    ("metric", R + "metric/__init__.py", "paddle_tpu.metric"),
+    ("distribution", R + "distribution/__init__.py",
+     "paddle_tpu.distribution"),
+    ("linalg", R + "linalg.py", "paddle_tpu.linalg"),
+    ("vision.ops", R + "vision/ops.py", "paddle_tpu.vision.ops"),
+    ("vision.transforms", R + "vision/transforms/__init__.py",
+     "paddle_tpu.vision.transforms"),
+    ("vision", R + "vision/__init__.py", "paddle_tpu.vision"),
+    ("distributed", R + "distributed/__init__.py",
+     "paddle_tpu.distributed"),
+    ("io", R + "io/__init__.py", "paddle_tpu.io"),
+    ("amp", R + "amp/__init__.py", "paddle_tpu.amp"),
+    ("jit", R + "jit/__init__.py", "paddle_tpu.jit"),
+    ("static", R + "static/__init__.py", "paddle_tpu.static"),
+    ("autograd", R + "autograd/__init__.py", "paddle_tpu.autograd"),
+    ("utils", R + "utils/__init__.py", "paddle_tpu.utils"),
+    ("sparse", R + "sparse/__init__.py", "paddle_tpu.sparse"),
+    ("fft", R + "fft.py", "paddle_tpu.fft"),
+    ("signal", R + "signal.py", "paddle_tpu.signal"),
+    ("regularizer", R + "regularizer.py", "paddle_tpu.regularizer"),
+    ("text", R + "text/__init__.py", "paddle_tpu.text"),
+]
+
+
+def _ref_all(path):
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    return [ast.literal_eval(e) for e in node.value.elts]
+    return []
+
+
+@pytest.mark.quick
+@pytest.mark.skipif(not os.path.exists(R), reason="reference not present")
+@pytest.mark.parametrize("label,ref_path,module", PAIRS,
+                         ids=[p[0] for p in PAIRS])
+def test_module_all_parity(label, ref_path, module):
+    names = _ref_all(ref_path)
+    assert names, f"no __all__ parsed from {ref_path}"
+    mod = importlib.import_module(module)
+    missing = [n for n in names if not hasattr(mod, n)]
+    assert not missing, f"{label}: missing {missing}"

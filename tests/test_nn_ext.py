@@ -1,0 +1,435 @@
+"""Tests for the nn/nn.functional long-tail surface added for reference
+__all__ parity: activations, pools, unfold/fold, grid sampling, losses,
+beam decode, layer wrappers (reference nn/functional/* semantics)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+@pytest.mark.quick
+class TestActivationsExt:
+    def test_values_against_formulas(self):
+        x = jnp.asarray(np.linspace(-3, 3, 13, dtype=np.float32))
+        xn = np.asarray(x)
+        np.testing.assert_allclose(
+            np.asarray(F.celu(x, 1.5)),
+            np.maximum(xn, 0) + np.minimum(1.5 * np.expm1(xn / 1.5), 0),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(F.selu(x)),
+            1.0507009873554805 * np.where(
+                xn > 0, xn, 1.6732632423543772 * np.expm1(xn)), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(F.softsign(x)),
+                                   xn / (1 + np.abs(xn)), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(F.tanhshrink(x)),
+                                   xn - np.tanh(xn), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(F.softshrink(x, 0.5)),
+            np.where(xn > 0.5, xn - 0.5, np.where(xn < -0.5, xn + 0.5, 0)),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(F.hardshrink(x)), np.where(np.abs(xn) > 0.5, xn, 0))
+        np.testing.assert_allclose(
+            np.asarray(F.thresholded_relu(x)), np.where(xn > 1.0, xn, 0))
+        np.testing.assert_allclose(np.asarray(F.hardtanh(x, -2, 2)),
+                                   np.clip(xn, -2, 2))
+        np.testing.assert_allclose(np.asarray(F.log_sigmoid(x)),
+                                   -np.log1p(np.exp(-xn)), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_maxout_grouping(self):
+        x = jnp.asarray(np.arange(6, dtype=np.float32).reshape(1, 6, 1, 1))
+        out = F.maxout(x, groups=2)
+        # channels pair up: (0,1) (2,3) (4,5) -> max of each
+        np.testing.assert_allclose(np.asarray(out).ravel(), [1, 3, 5])
+
+    def test_gumbel_softmax_hard_is_onehot_and_differentiable(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 7), jnp.float32)
+        y = F.gumbel_softmax(x, hard=True, key=jax.random.key(0))
+        np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-6)
+        assert set(np.unique(np.asarray(y))) <= {0.0, 1.0}
+        g = jax.grad(lambda x_: jnp.sum(
+            F.gumbel_softmax(x_, hard=True, key=jax.random.key(0)) ** 2))(x)
+        assert float(jnp.abs(g).sum()) > 0   # straight-through grads
+
+    def test_layer_wrappers(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 6, 6),
+                        jnp.float32)
+        for cls in (nn.CELU, nn.ELU, nn.SELU, nn.Silu, nn.Swish,
+                    nn.Softsign, nn.LogSigmoid, nn.Hardshrink,
+                    nn.Softshrink, nn.Tanhshrink, nn.ThresholdedReLU):
+            assert cls()(x).shape == x.shape
+        assert nn.Hardtanh(-2, 2)(x).shape == x.shape
+        assert nn.Maxout(2)(x).shape == (2, 2, 6, 6)
+
+
+@pytest.mark.quick
+class TestPoolingExt:
+    def test_pool3d_matches_manual(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 4, 4, 4),
+                        jnp.float32)
+        out = F.max_pool3d(x, 2)
+        ref = np.asarray(x).reshape(1, 2, 2, 2, 2, 2, 2, 2)[
+            :, :, :, :, :].reshape(1, 2, 2, 2, 2, 2, 2, 2)
+        manual = np.asarray(x).reshape(1, 2, 2, 2, 2, 2, 2, 2)
+        manual = manual.max(axis=(3, 5, 7))
+        np.testing.assert_allclose(np.asarray(out), manual, rtol=1e-6)
+        avg = F.avg_pool3d(x, 2)
+        manual_avg = np.asarray(x).reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(
+            axis=(3, 5, 7))
+        np.testing.assert_allclose(np.asarray(avg), manual_avg, rtol=1e-5)
+
+    def test_adaptive_1d_3d(self):
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 3, 10),
+                        jnp.float32)
+        out = F.adaptive_avg_pool1d(x, 5)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(x).reshape(2, 3, 5, 2).mean(-1), rtol=1e-5)
+        om = F.adaptive_max_pool1d(x, 3)
+        assert om.shape == (2, 3, 3)
+        x3 = jnp.asarray(np.random.RandomState(2).randn(1, 2, 5, 6, 7),
+                         jnp.float32)
+        assert F.adaptive_avg_pool3d(x3, 2).shape == (1, 2, 2, 2, 2)
+        assert F.adaptive_max_pool3d(x3, (2, 3, 2)).shape == (1, 2, 2, 3, 2)
+
+    def test_max_pool_mask_and_unpool(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 3, 6, 6), jnp.float32)
+        out, mask = F.max_pool2d(x, 2, return_mask=True)
+        flat = np.asarray(x).reshape(2, 3, -1)
+        gathered = np.take_along_axis(
+            flat, np.asarray(mask).reshape(2, 3, -1), -1)
+        np.testing.assert_allclose(gathered,
+                                   np.asarray(out).reshape(2, 3, -1))
+        rec = F.max_unpool2d(out, mask, 2)
+        assert rec.shape == x.shape
+        np.testing.assert_allclose(
+            np.take_along_axis(np.asarray(rec).reshape(2, 3, -1),
+                               np.asarray(mask).reshape(2, 3, -1), -1),
+            np.asarray(out).reshape(2, 3, -1))
+        # layer forms
+        assert nn.MaxUnPool2D(2)(out, mask).shape == x.shape
+
+    def test_unfold_fold_roundtrip_counts(self):
+        x = jnp.asarray(np.random.RandomState(3).randn(2, 3, 8, 8),
+                        jnp.float32)
+        u = F.unfold(x, 2, 2)        # non-overlapping: fold inverts exactly
+        assert u.shape == (2, 3 * 4, 16)
+        back = F.fold(u, (8, 8), 2, 2)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=1e-6)
+        # overlapping windows scatter-ADD (each pixel counted per visit)
+        u2 = F.unfold(x, 3, 1, 1)
+        acc = F.fold(u2, (8, 8), 3, 1, 1)
+        ones = F.fold(F.unfold(jnp.ones_like(x), 3, 1, 1), (8, 8), 3, 1, 1)
+        np.testing.assert_allclose(np.asarray(acc / ones), np.asarray(x),
+                                   rtol=1e-5)
+
+
+@pytest.mark.quick
+class TestVisionFunctional:
+    def test_affine_grid_sample_identity_and_shift(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8, 8),
+                        jnp.float32)
+        theta = jnp.tile(jnp.asarray([[[1.0, 0, 0], [0, 1, 0]]]), (2, 1, 1))
+        g = F.affine_grid(theta, (2, 3, 8, 8))
+        np.testing.assert_allclose(np.asarray(F.grid_sample(x, g)),
+                                   np.asarray(x), atol=1e-4)
+        # horizontal flip via theta
+        flip = jnp.tile(jnp.asarray([[[-1.0, 0, 0], [0, 1, 0]]]), (2, 1, 1))
+        gf = F.affine_grid(flip, (2, 3, 8, 8))
+        np.testing.assert_allclose(np.asarray(F.grid_sample(x, gf)),
+                                   np.asarray(x)[:, :, :, ::-1], atol=1e-4)
+
+    def test_temporal_shift_layout(self):
+        x = jnp.asarray(np.arange(2 * 2 * 8, dtype=np.float32
+                                  ).reshape(4, 8, 1, 1))
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        xr = np.asarray(x).reshape(2, 2, 8, 1, 1)
+        on = np.asarray(out).reshape(2, 2, 8, 1, 1)
+        # first quarter shifted backward: t gets t+1, last t zero
+        np.testing.assert_allclose(on[:, 0, :2], xr[:, 1, :2])
+        np.testing.assert_allclose(on[:, 1, :2], 0)
+        # second quarter forward: t gets t-1, first t zero
+        np.testing.assert_allclose(on[:, 1, 2:4], xr[:, 0, 2:4])
+        np.testing.assert_allclose(on[:, 0, 2:4], 0)
+        # rest untouched
+        np.testing.assert_allclose(on[:, :, 4:], xr[:, :, 4:])
+
+
+@pytest.mark.quick
+class TestLossesExt:
+    def test_bce_and_focal_and_log_loss(self):
+        p = jnp.asarray([0.9, 0.1, 0.8], jnp.float32)
+        y = jnp.asarray([1.0, 0.0, 1.0])
+        ref = -(np.log([0.9, 0.9, 0.8])).mean()
+        np.testing.assert_allclose(float(F.binary_cross_entropy(p, y)), ref,
+                                   rtol=1e-5)
+        assert float(nn.BCELoss()(p, y)) == pytest.approx(ref, rel=1e-5)
+        ll = F.log_loss(p, y, epsilon=0.0)
+        np.testing.assert_allclose(np.asarray(ll),
+                                   -np.log([0.9, 0.9, 0.8]), rtol=1e-5)
+        fl = F.sigmoid_focal_loss(jnp.zeros(3), y, reduction="none")
+        assert fl.shape == (3,) and np.all(np.asarray(fl) > 0)
+
+    def test_softmax_with_cross_entropy_matches_manual(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(5, 7), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 7, 5))
+        loss, sm = F.softmax_with_cross_entropy(logits, y,
+                                                return_softmax=True)
+        lsm = np.log(np.asarray(sm))
+        manual = -lsm[np.arange(5), np.asarray(y)]
+        np.testing.assert_allclose(np.asarray(loss)[:, 0], manual,
+                                   rtol=1e-5)
+        # ignore_index zeroes the loss
+        y2 = y.at[0].set(-100)
+        l2 = F.softmax_with_cross_entropy(logits, y2, ignore_index=-100)
+        assert float(l2[0, 0]) == 0.0
+
+    def test_margin_cross_entropy_margins_increase_loss(self):
+        rng = np.random.RandomState(0)
+        cos = jnp.asarray(np.clip(rng.randn(6, 10) * 0.3, -0.95, 0.95),
+                          jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, 6))
+        plain = float(F.margin_cross_entropy(cos, y, margin1=1.0,
+                                             margin2=0.0, margin3=0.0))
+        arc = float(F.margin_cross_entropy(cos, y, margin1=1.0,
+                                           margin2=0.5, margin3=0.0))
+        assert arc > plain   # margins make the target harder
+
+    def test_hsigmoid_learnable(self):
+        # the hierarchical loss trains a linear model to separate classes
+        rng = np.random.RandomState(0)
+        C, D, N = 4, 8, 64
+        protos = rng.randn(C, D).astype(np.float32) * 2
+        y = rng.randint(0, C, N)
+        x = jnp.asarray(protos[y] + 0.1 * rng.randn(N, D).astype(np.float32))
+        yj = jnp.asarray(y)
+        w0 = jnp.asarray(rng.randn(C - 1, D).astype(np.float32) * 0.1)
+
+        def loss_fn(w):
+            return jnp.mean(F.hsigmoid_loss(x, yj, C, w))
+
+        w = w0
+        first = float(loss_fn(w))
+        for _ in range(60):
+            w = w - 0.5 * jax.grad(loss_fn)(w)
+        assert float(loss_fn(w)) < first * 0.5
+
+    def test_npair_and_dice(self):
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(6, 4), jnp.float32)
+        p = jnp.asarray(rng.randn(6, 4), jnp.float32)
+        lbl = jnp.asarray([0, 0, 1, 1, 2, 2])
+        assert float(F.npair_loss(a, p, lbl)) > 0
+        probs = jnp.asarray([[0.9, 0.1], [0.2, 0.8]], jnp.float32)
+        dl = F.dice_loss(probs, jnp.asarray([[0], [1]]))
+        assert 0 < float(dl) < 1
+
+    def test_class_center_sample(self):
+        lbl, sampled = F.class_center_sample(
+            jnp.asarray([1, 5, 9, 5]), 20, 6, seed=0)
+        s = np.asarray(sampled)
+        assert len(s) == 6 and {1, 5, 9} <= set(s.tolist())
+        # positives remap inside the sampled set
+        remapped = np.asarray(lbl)
+        assert all(s[r] == orig for r, orig in zip(remapped, [1, 5, 9, 5]))
+
+
+@pytest.mark.quick
+class TestNormAndMisc:
+    def test_local_response_norm_formula(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 6, 3, 3),
+                        jnp.float32)
+        out = F.local_response_norm(x, size=3, alpha=1e-2, beta=0.5, k=1.0)
+        xn = np.asarray(x)
+        acc = np.zeros_like(xn)
+        for c in range(6):
+            lo, hi = max(0, c - 1), min(6, c + 2)
+            acc[:, c] = (xn[:, lo:hi] ** 2).sum(1)
+        ref = xn / (1.0 + 1e-2 / 3 * acc) ** 0.5
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+    def test_instance_norm_zero_mean_unit_var(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8, 8) * 5 + 2,
+                        jnp.float32)
+        y = np.asarray(F.instance_norm(x))
+        np.testing.assert_allclose(y.mean(axis=(2, 3)), 0, atol=1e-5)
+        np.testing.assert_allclose(y.var(axis=(2, 3)), 1, atol=1e-3)
+
+    def test_dropout_channels_and_alpha(self):
+        x = jnp.ones((4, 8, 5, 5))
+        y = np.asarray(F.dropout2d(x, 0.5, key=jax.random.key(0)))
+        per_channel = y.reshape(4, 8, -1)
+        # each channel all-zero or all-scaled
+        assert all(len(np.unique(c)) == 1 for b in per_channel for c in b)
+        ya = F.alpha_dropout(x, 0.3, key=jax.random.key(1))
+        assert ya.shape == x.shape
+        m = nn.AlphaDropout(0.3); m.eval()
+        np.testing.assert_array_equal(np.asarray(m(x)), np.asarray(x))
+
+    def test_sequence_mask_and_diag_embed(self):
+        np.testing.assert_array_equal(
+            np.asarray(F.sequence_mask(jnp.asarray([1, 3]), maxlen=4)),
+            [[1, 0, 0, 0], [1, 1, 1, 0]])
+        d = F.diag_embed(jnp.asarray([[1.0, 2.0]]))
+        assert d.shape == (1, 2, 2)
+        np.testing.assert_allclose(np.asarray(d)[0], [[1, 0], [0, 2]])
+
+    def test_conv_transpose_1d3d_shapes_and_grad(self):
+        pt.seed(0)
+        ct = nn.Conv1DTranspose(4, 6, 3, stride=2)
+        y = ct(jnp.ones((2, 4, 5)))
+        assert y.shape == (2, 6, 11)
+        c3 = nn.Conv3DTranspose(2, 3, 3)
+        assert c3(jnp.ones((1, 2, 4, 4, 4))).shape == (1, 3, 6, 6, 6)
+        # functional gradcheck via conv identity: transpose of conv
+        g = jax.grad(lambda w: jnp.sum(F.conv1d_transpose(
+            jnp.ones((1, 2, 4)), w) ** 2))(jnp.ones((2, 3, 2)) * 0.1)
+        assert g.shape == (2, 3, 2)
+
+    def test_bilinear_einsum(self):
+        x1 = jnp.asarray([[1.0, 2.0]])
+        x2 = jnp.asarray([[3.0, 4.0, 5.0]])
+        w = jnp.ones((1, 2, 3))
+        out = F.bilinear(x1, x2, w)
+        assert float(out[0, 0]) == pytest.approx((1 + 2) * (3 + 4 + 5))
+
+
+@pytest.mark.quick
+class TestBeamDecode:
+    def test_gather_tree_backtrace(self):
+        ids = jnp.asarray([[[1, 5]], [[2, 6]], [[3, 7]]])      # (T=3,B=1,K=2)
+        parents = jnp.asarray([[[0, 0]], [[0, 0]], [[1, 0]]])
+        out = np.asarray(F.gather_tree(ids, parents))
+        # beam 0's chain: t2 token 3 (parent 1) <- t1 token 6 (parent 0)
+        # <- t0 token 1
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 6, 3])
+
+    def test_beam_search_decodes_argmax_chain(self):
+        class ToyCell:
+            def __call__(self, tok, states):
+                V = 7
+                logits = jnp.full((tok.shape[0], V), -5.0)
+                logits = logits.at[jnp.arange(tok.shape[0]),
+                                   (tok + 1) % V].set(5.0)
+                return logits, states
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=6,
+                                   beam_size=2)
+        seqs, lp = nn.dynamic_decode(dec, inits={"h": jnp.zeros((2, 1))},
+                                     max_step_num=10)
+        np.testing.assert_array_equal(np.asarray(seqs)[0, 0][:6],
+                                      [1, 2, 3, 4, 5, 6])
+        assert float(lp[0, 0]) > float(lp[0, 1])
+
+
+@pytest.mark.quick
+class TestContainersAndNorm:
+    def test_layer_dict(self):
+        ld = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+        assert set(ld.keys()) == {"a", "b"} and "a" in ld
+        ld["c"] = nn.Tanh()
+        assert len(ld) == 3
+        popped = ld.pop("c")
+        assert isinstance(popped, nn.Tanh) and len(ld) == 2
+        # registered as sublayers -> parameters visible
+        assert any("a" in k for k in ld.state_dict())
+
+    def test_batchnorm_legacy_and_sync_convert(self):
+        pt.seed(0)
+        bn = nn.BatchNorm(4, act="relu")
+        bn.train()
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 4, 3, 3),
+                        jnp.float32)
+        y = bn(x)
+        assert float(jnp.min(y)) >= 0          # act applied
+        net = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+        net2 = nn.SyncBatchNorm.convert_sync_batchnorm(net)
+        assert isinstance(net2[1], nn.SyncBatchNorm)
+        net2.train()
+        assert net2(jnp.ones((2, 3, 8, 8))).shape == (2, 4, 6, 6)
+
+
+@pytest.mark.quick
+class TestReviewRegressions:
+    """Round-5 review findings pinned as regressions."""
+
+    def test_maxpool_layer_returns_tensor_not_tuple(self):
+        out = nn.MaxPool2D(2, data_format="NCHW")(jnp.ones((1, 1, 4, 4)))
+        assert not isinstance(out, tuple)
+
+    def test_return_mask_with_padding_and_negative_values(self):
+        x = -jnp.asarray(np.random.RandomState(0).rand(1, 1, 4, 4) + 0.5,
+                         jnp.float32)
+        o, m = F.max_pool2d(x, 2, stride=2, padding=1, return_mask=True)
+        mv = np.asarray(m).ravel()
+        assert mv.min() >= 0 and mv.max() < 16
+        flat = np.asarray(x).reshape(1, 1, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, np.asarray(m).reshape(1, 1, -1), -1),
+            np.asarray(o).reshape(1, 1, -1))
+
+    def test_exponential_family_batched_entropy(self):
+        from paddle_tpu.distribution import ExponentialFamily
+
+        class DiagNormalEF(ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.asarray(loc)
+                self.scale = jnp.asarray(scale)
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * np.log(2 * np.pi)
+
+        scale = np.asarray([1.0, 2.0, 0.5])
+        d = DiagNormalEF(jnp.asarray([0.0, 1.0, 2.0]), jnp.asarray(scale))
+        ent = d.entropy()
+        assert ent.shape == (3,)
+        np.testing.assert_allclose(
+            np.asarray(ent), 0.5 * np.log(2 * np.pi * np.e * scale ** 2),
+            rtol=1e-5)
+
+    def test_program_translator_enable_false_runs_eagerly(self):
+        from paddle_tpu import jit
+        calls = []
+
+        @jit.to_static
+        def f(a):
+            calls.append(1)
+            return a * 2
+
+        t = jit.ProgramTranslator.get_instance()
+        t.enable(True)
+        f(jnp.ones(2)); f(jnp.ones(2))
+        traced_calls = len(calls)
+        t.enable(False)
+        try:
+            f(jnp.ones(2)); f(jnp.ones(2))
+            assert len(calls) == traced_calls + 2   # eager: runs per call
+        finally:
+            t.enable(True)
+
+    def test_global_initializer_top_level_create_parameter(self):
+        nn.initializer.set_global_initializer(nn.initializer.Constant(0.25))
+        try:
+            w = pt.create_parameter([3, 3], "float32")
+            assert float(w.value[0, 0]) == 0.25
+        finally:
+            nn.initializer.set_global_initializer(None, None)
