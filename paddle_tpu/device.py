@@ -94,3 +94,82 @@ class _Namespace:
 
 cuda = _Namespace()   # source compat for ported monitoring code
 tpu = _Namespace()
+
+
+# reference paddle.device __all__ parity: vendor-probe surface.  On this
+# stack there is exactly one accelerator vendor (TPU via XLA); the CUDA/
+# XPU/IPU/MLU probes answer honestly (False / N/A) so ported
+# capability-detection code takes its CPU-or-accelerator branches
+# correctly (docs/MIGRATION.md device table).
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # the XLA compiler plays CINN's role; answer False to the literal
+    # "is CINN present" probe (scripts branch to plain execution)
+    return False
+
+
+def get_cudnn_version():
+    return None      # reference returns None when CUDA is absent
+
+
+def XPUPlace(index: int = 0):
+    from .framework import TPUPlace
+    return TPUPlace(index)
+
+
+def IPUPlace(index: int = 0):
+    from .framework import TPUPlace
+    return TPUPlace(index)
+
+
+def MLUPlace(index: int = 0):
+    from .framework import TPUPlace
+    return TPUPlace(index)
+
+
+def get_all_device_type() -> List[str]:
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type() -> List[str]:
+    return []
+
+
+def get_available_device() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device() -> List[str]:
+    return []
+
+
+__all__ += ["is_compiled_with_cuda", "is_compiled_with_rocm",
+            "is_compiled_with_xpu", "is_compiled_with_ipu",
+            "is_compiled_with_npu", "is_compiled_with_mlu",
+            "is_compiled_with_cinn", "get_cudnn_version", "XPUPlace",
+            "IPUPlace", "MLUPlace", "get_all_device_type",
+            "get_all_custom_device_type", "get_available_device",
+            "get_available_custom_device"]

@@ -75,6 +75,10 @@ def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
 
 
 def get_group(id: int = 0) -> Group:  # noqa: A002
+    if id == 0 and 0 not in _groups:
+        # the global/default group exists implicitly (reference semantics)
+        _groups[0] = Group(jax.process_index(), jax.process_count(), 0,
+                           list(range(jax.process_count())))
     enforce(id in _groups, f"no group with id {id}; create with new_group")
     return _groups[id]
 
@@ -89,10 +93,17 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
     stacked = jnp.stack([jnp.asarray(t) for t in in_tensor_list])
     axis = getattr(group, "axis", None) or (group if isinstance(group, str)
                                             else "ep")
+    # inside shard_map the named axis is bound: run the real collective
+    # (errors there must propagate); outside, world=1 identity exchange
     try:
+        jax.lax.axis_index(axis)
+        bound = True
+    except NameError:
+        bound = False
+    if bound:
         out = _a2a(stacked, group=axis, split_axis=0, concat_axis=0)
         outs = [out[i] for i in range(out.shape[0])]
-    except Exception:
+    else:
         outs = list(in_tensor_list)     # world=1: each rank keeps its slice
     if out_tensor_list is not None:
         out_tensor_list.clear()

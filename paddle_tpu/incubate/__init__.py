@@ -4,9 +4,27 @@ softmax_mask_fuse ops)."""
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import sparsity  # noqa: F401 (ASP n:m structured pruning)
-from .graph_ops import graph_send_recv  # noqa: F401
+from .graph_ops import (graph_send_recv, graph_khop_sampler,  # noqa: F401
+                        graph_sample_neighbors, graph_reindex,
+                        segment_sum, segment_mean, segment_max,
+                        segment_min)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from ..nn.functional import (  # noqa: F401
     softmax_mask_fuse_upper_triangle)
 
+
+def softmax_mask_fuse(x, mask):
+    """softmax(x + mask) fused (reference incubate.softmax_mask_fuse —
+    fused_softmax_mask_op); XLA fuses the add into the softmax."""
+    import jax
+    import jax.numpy as jnp
+    xf = jnp.asarray(x).astype(jnp.float32) + jnp.asarray(mask).astype(
+        jnp.float32)
+    return jax.nn.softmax(xf, axis=-1).astype(jnp.asarray(x).dtype)
+
+
 __all__ = ["nn", "optimizer", "sparsity", "graph_send_recv",
-           "softmax_mask_fuse_upper_triangle"]
+           "softmax_mask_fuse_upper_triangle", "softmax_mask_fuse",
+           "LookAhead", "ModelAverage", "graph_khop_sampler",
+           "graph_sample_neighbors", "graph_reindex", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]

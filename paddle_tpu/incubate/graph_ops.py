@@ -55,3 +55,144 @@ def graph_send_recv(x, src_index, dst_index, pool_type: str = "sum",
     # empty segments come back +/-inf from XLA; the reference zero-fills
     empty = (counts == 0).reshape((-1,) + (1,) * (x.ndim - 1))
     return jnp.where(empty, jnp.zeros_like(r), r)
+
+
+def segment_sum(data, segment_ids):
+    """Segment reduction over dim 0 (reference incubate segment_sum;
+    XLA-native via jax.ops.segment_*).  num_segments = max(id) + 1,
+    computed on host (eager op, like the reference)."""
+    import jax.numpy as jnp
+    n = int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_sum(jnp.asarray(data), jnp.asarray(segment_ids),
+                               num_segments=n)
+
+
+def segment_mean(data, segment_ids):
+    import jax.numpy as jnp
+    data = jnp.asarray(data)
+    ids = jnp.asarray(segment_ids)
+    n = int(jnp.max(ids)) + 1
+    s = jax.ops.segment_sum(data, ids, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones(data.shape[:1], data.dtype), ids,
+                            num_segments=n)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(c.reshape(shape), 1)
+
+
+def segment_max(data, segment_ids):
+    import jax.numpy as jnp
+    ids = jnp.asarray(segment_ids)
+    n = int(jnp.max(ids)) + 1
+    return jax.ops.segment_max(jnp.asarray(data), ids, num_segments=n)
+
+
+def segment_min(data, segment_ids):
+    import jax.numpy as jnp
+    ids = jnp.asarray(segment_ids)
+    n = int(jnp.max(ids)) + 1
+    return jax.ops.segment_min(jnp.asarray(data), ids, num_segments=n)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids: bool = False):
+    """K-hop neighbor sampling over a CSC graph (reference incubate
+    graph_khop_sampler: returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids])).  Host-side numpy sampling — graph
+    prep, not traced compute (the reference's is an eager op).
+    ``sample_index`` lists unique touched nodes with the INPUT nodes
+    first (first-seen order); ``reindex_nodes`` gives the input nodes'
+    positions in it."""
+    import numpy as np
+    rng = np.random
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    inputs = np.asarray(input_nodes).reshape(-1)
+    frontier = inputs
+    all_rows, all_cols, all_eids = [], [], []
+    for k in sample_sizes:
+        rs, cs, es = [], [], []
+        for dst in frontier:
+            lo, hi = int(colptr[dst]), int(colptr[dst + 1])
+            neigh = row[lo:hi]
+            eid = (np.asarray(sorted_eids)[lo:hi] if sorted_eids is not None
+                   else np.arange(lo, hi))
+            if k >= 0 and len(neigh) > k:
+                sel = rng.choice(len(neigh), k, replace=False)
+                neigh, eid = neigh[sel], eid[sel]
+            rs.append(neigh)
+            cs.append(np.full(len(neigh), dst, row.dtype))
+            es.append(eid)
+        rs = np.concatenate(rs) if rs else np.empty(0, row.dtype)
+        cs = np.concatenate(cs) if cs else np.empty(0, row.dtype)
+        es = np.concatenate(es) if es else np.empty(0, np.int64)
+        all_rows.append(rs); all_cols.append(cs); all_eids.append(es)
+        frontier = np.unique(rs)
+    rows = np.concatenate(all_rows)
+    cols = np.concatenate(all_cols)
+    # reindex in first-seen order with input nodes leading (reference
+    # contract: inputs occupy the head of sample_index)
+    mapping = {}
+    sample_index = []
+    for v in np.concatenate([inputs, cols, rows]):
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(sample_index)
+            sample_index.append(v)
+    r_re = np.asarray([mapping[int(v)] for v in rows], np.int64)
+    c_re = np.asarray([mapping[int(v)] for v in cols], np.int64)
+    reindex_nodes = np.arange(len(inputs), dtype=np.int64)
+    out = (jnp.asarray(r_re), jnp.asarray(c_re),
+           jnp.asarray(np.asarray(sample_index, np.int64)),
+           jnp.asarray(reindex_nodes))
+    if return_eids:
+        return out + (jnp.asarray(np.concatenate(all_eids)),)
+    return out
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size: int = -1,
+                           return_eids: bool = False,
+                           flag_perm_buffer: bool = False):
+    """One-hop neighbor sampling (reference graph_sample_neighbors)."""
+    import numpy as np
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    outs, counts, es = [], [], []
+    for dst in np.asarray(input_nodes).reshape(-1):
+        lo, hi = int(colptr[dst]), int(colptr[dst + 1])
+        neigh = row[lo:hi]
+        eid = np.arange(lo, hi)
+        if sample_size >= 0 and len(neigh) > sample_size:
+            sel = np.random.choice(len(neigh), sample_size, replace=False)
+            neigh, eid = neigh[sel], eid[sel]
+        outs.append(neigh); counts.append(len(neigh)); es.append(eid)
+    out = np.concatenate(outs) if outs else np.empty(0, row.dtype)
+    cnt = np.asarray(counts, np.int32)
+    if return_eids:
+        return (jnp.asarray(out), jnp.asarray(cnt),
+                jnp.asarray(np.concatenate(es) if es else np.empty(0)))
+    return jnp.asarray(out), jnp.asarray(cnt)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable: bool = False):
+    """Reindex a sampled subgraph to contiguous ids (reference
+    graph_reindex): x (dst nodes) keep ids 0..n-1; new neighbor ids
+    follow in first-seen order."""
+    import numpy as np
+    x = np.asarray(x).reshape(-1)
+    neighbors = np.asarray(neighbors).reshape(-1)
+    count = np.asarray(count).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(x)
+    reindexed = np.empty(len(neighbors), np.int64)
+    for i, v in enumerate(neighbors):
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+        reindexed[i] = mapping[v]
+    # reindexed dst per neighbor: repeat each x by its count
+    dst = np.repeat(np.arange(len(x), dtype=np.int64), count)
+    return (jnp.asarray(reindexed), jnp.asarray(dst),
+            jnp.asarray(np.asarray(out_nodes, np.int64)))

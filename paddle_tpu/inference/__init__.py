@@ -103,3 +103,73 @@ class _OutHandle:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# reference paddle.inference __all__ parity: type enums + utility surface
+import enum as _enum
+
+import numpy as _np
+
+
+class DataType(_enum.Enum):
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType(_enum.Enum):
+    CPU = "cpu"
+    GPU = "gpu"        # maps to the accelerator (TPU) on this stack
+    XPU = "xpu"
+    UNK = "unk"
+
+
+class PrecisionType(_enum.Enum):
+    Float32 = "float32"
+    Half = "float16"
+    Int8 = "int8"
+
+
+Tensor = _Handle      # the predictor's tensor handle role
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)       # TensorRT is N/A on TPU (XLA is the engine)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    name = dtype.value if isinstance(dtype, DataType) else str(dtype)
+    return _np.dtype(name).itemsize
+
+
+class PredictorPool:
+    """Reference PredictorPool(config, size): N independent predictors —
+    here they share the compiled XLA executable (compilation is cached),
+    so the pool is a list of Predictor facades."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [create_predictor(config) for _ in range(size)]
+
+    def retrive(self, idx: int) -> Predictor:   # reference spelling
+        return self._predictors[idx]
+
+    retrieve = retrive
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "Tensor",
+            "get_version", "get_trt_compile_version",
+            "get_trt_runtime_version", "get_num_bytes_of_data_type",
+            "PredictorPool"]

@@ -44,11 +44,18 @@ def _act(name, fn, extra=()):
     def __init__(self, *args, **kwargs):
         Layer.__init__(self)
         params = dict(extra)
+        if len(args) > len(keys):
+            raise TypeError(
+                f"{name}() takes at most {len(keys)} positional "
+                f"arguments ({len(args)} given)")
         for i, a in enumerate(args):
             params[keys[i]] = a
         for k, v in kwargs.items():
             if k in params:
                 params[k] = v
+            elif k != "name":
+                raise TypeError(f"{name}() got an unexpected keyword "
+                                f"argument {k!r}")
         self._extra = [params[k] for k in keys]
 
     def forward(self, x):
@@ -61,7 +68,8 @@ def _act(name, fn, extra=()):
 
 CELU = _act("CELU", F.celu, (("alpha", 1.0),))
 ELU = _act("ELU", F.elu, (("alpha", 1.0),))
-SELU = _act("SELU", F.selu)
+SELU = _act("SELU", F.selu, (("scale", 1.0507009873554805),
+                             ("alpha", 1.6732632423543772)))
 Silu = _act("Silu", F.silu)
 Swish = _act("Swish", F.swish)
 Softsign = _act("Softsign", F.softsign)
@@ -243,6 +251,8 @@ class AdaptiveAvgPool1D(Layer):
 class AdaptiveMaxPool1D(Layer):
     def __init__(self, output_size, return_mask: bool = False):
         super().__init__()
+        enforce(not return_mask,
+                "return_mask is unsupported on adaptive max pools here")
         self.output_size = output_size
 
     def forward(self, x):
@@ -262,6 +272,8 @@ class AdaptiveMaxPool3D(Layer):
     def __init__(self, output_size, return_mask: bool = False,
                  data_format: str = "NCDHW"):
         super().__init__()
+        enforce(not return_mask,
+                "return_mask is unsupported on adaptive max pools here")
         self.output_size, self.data_format = output_size, data_format
 
     def forward(self, x):
@@ -312,13 +324,16 @@ class _ConvTransposeND(Layer):
             default_initializer=I.XavierUniform())
         self.bias = (None if bias_attr is False else self.create_parameter(
             (out_channels,), is_bias=True, attr=bias_attr))
+        self.data_format = data_format or ("NCL" if self.ND == 1
+                                           else "NCDHW")
         self.conv_args = (stride, padding, output_padding, groups, dilation)
 
     def forward(self, x):
         s, p, op, g, d = self.conv_args
         fn = F.conv1d_transpose if self.ND == 1 else F.conv3d_transpose
         return fn(x, self.weight, self.bias, stride=s, padding=p,
-                  output_padding=op, groups=g, dilation=d)
+                  output_padding=op, groups=g, dilation=d,
+                  data_format=self.data_format)
 
 
 class Conv1DTranspose(_ConvTransposeND):

@@ -261,3 +261,46 @@ class Profiler:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+# reference paddle.profiler __all__ parity: exporter helpers + SortedKeys
+class SortedKeys(enum.Enum):
+    """Reference profiler.SortedKeys: summary-table sort orders."""
+    CPUTotal = "total"
+    CPUAvg = "avg"
+    CPUMax = "max"
+    CPUMin = "min"
+    GPUTotal = "device_total"
+    GPUAvg = "device_avg"
+
+
+def _copy_trace_handler(dir_name: str):
+    def handler(prof):
+        import shutil
+        os.makedirs(dir_name, exist_ok=True)
+        if os.path.isdir(prof.log_dir):
+            shutil.copytree(prof.log_dir, dir_name, dirs_exist_ok=True)
+    return handler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory (reference profiler.export_chrome_tracing):
+    the Profiler's trace machinery already emits chrome/XPlane files into
+    its log_dir; the handler lands a copy in ``dir_name``."""
+    return _copy_trace_handler(dir_name)
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    return _copy_trace_handler(dir_name)
+
+
+def load_profiler_result(file_name: str):
+    """Load an exported chrome trace back (reference
+    load_profiler_result)."""
+    import json
+    with open(file_name) as f:
+        return json.load(f)
+
+
+__all__ += ["SortedKeys", "export_chrome_tracing", "export_protobuf",
+            "load_profiler_result"]

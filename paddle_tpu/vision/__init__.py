@@ -29,7 +29,10 @@ def image_load(path: str, backend=None):
     if b == "pil":
         return img
     import numpy as np
-    return np.asarray(img)
+    arr = np.asarray(img)
+    if b == "cv2" and arr.ndim == 3 and arr.shape[-1] == 3:
+        arr = arr[..., ::-1]      # the cv2 backend convention is BGR
+    return arr
 
 
 __all__ = ["set_image_backend", "get_image_backend", "image_load",

@@ -463,3 +463,88 @@ class TestASP:
         assert "0.weight" not in masks and "1.weight" in masks
         sparsity.reset_excluded_layers()
         sparsity.reset_masks()
+
+
+class TestGraphSegmentOps:
+    """Round-5 incubate gap fill (+ review-finding regressions)."""
+
+    def test_segment_family(self):
+        import paddle_tpu.incubate as inc
+        d = jnp.asarray([1., 2., 3., 4.])
+        ids = jnp.asarray([0, 0, 1, 1])
+        np.testing.assert_allclose(np.asarray(inc.segment_sum(d, ids)), [3, 7])
+        np.testing.assert_allclose(np.asarray(inc.segment_mean(d, ids)), [1.5, 3.5])
+        np.testing.assert_allclose(np.asarray(inc.segment_max(d, ids)), [2, 4])
+        np.testing.assert_allclose(np.asarray(inc.segment_min(d, ids)), [1, 3])
+
+    def test_khop_sampler_reference_tuple_shape(self):
+        import paddle_tpu.incubate as inc
+        row = jnp.asarray([1, 2, 0, 0])
+        colptr = jnp.asarray([0, 2, 3, 4])
+        # the reference docstring unpack: 4 values (regression: was 3)
+        es, ed, sample_index, reindex_nodes = inc.graph_khop_sampler(
+            row, colptr, jnp.asarray([0]), [2])
+        assert int(np.asarray(sample_index)[0]) == 0   # inputs lead
+        assert int(np.asarray(reindex_nodes)[0]) == 0
+        # with eids: 5 values
+        out = inc.graph_khop_sampler(row, colptr, jnp.asarray([0]), [2],
+                                     return_eids=True)
+        assert len(out) == 5
+
+    def test_sample_neighbors_reference_positional_order(self):
+        import paddle_tpu.incubate as inc
+        row = jnp.asarray([1, 2, 0, 0])
+        colptr = jnp.asarray([0, 2, 3, 4])
+        # reference order: (row, colptr, nodes, eids, perm_buffer, size)
+        out, cnt = inc.graph_sample_neighbors(
+            row, colptr, jnp.asarray([0]), None, None, 1)
+        assert int(cnt[0]) == 1 and len(np.asarray(out)) == 1
+
+    def test_graph_reindex_first_seen_order(self):
+        import paddle_tpu.incubate as inc
+        rn, rd, nodes = inc.graph_reindex(
+            jnp.asarray([5, 9]), jnp.asarray([9, 7, 5]), jnp.asarray([2, 1]))
+        np.testing.assert_array_equal(np.asarray(nodes), [5, 9, 7])
+        np.testing.assert_array_equal(np.asarray(rn), [1, 2, 0])
+        np.testing.assert_array_equal(np.asarray(rd), [0, 0, 1])
+
+    def test_softmax_mask_fuse(self):
+        import paddle_tpu.incubate as inc
+        x = jnp.ones((2, 4))
+        m = jnp.asarray([[0., 0., -1e9, -1e9]] * 2)
+        out = np.asarray(inc.softmax_mask_fuse(x, m))
+        np.testing.assert_allclose(out[:, :2], 0.5, rtol=1e-5)
+        np.testing.assert_allclose(out[:, 2:], 0.0, atol=1e-6)
+
+
+class TestCompatRegressions:
+    def test_default_group_zero_exists(self):
+        import paddle_tpu.distributed as dist
+        g = dist.get_group()          # regression: raised before
+        assert g.id == 0 and g.nranks >= 1
+
+    def test_selu_layer_honors_params(self):
+        from paddle_tpu import nn
+        x = jnp.asarray(np.linspace(-2, 2, 9, dtype=np.float32))
+        assert not np.allclose(np.asarray(nn.SELU(scale=2.0)(x)),
+                               np.asarray(nn.SELU()(x)))
+        with pytest.raises(TypeError):
+            nn.SELU(1.0, 2.0, 3.0)
+        with pytest.raises(TypeError):
+            nn.Silu(bogus=1)
+
+    def test_adaptive_max_pool_return_mask_rejected(self):
+        from paddle_tpu import nn
+        with pytest.raises(Exception, match="return_mask"):
+            nn.AdaptiveMaxPool1D(4, return_mask=True)
+
+    def test_image_load_cv2_is_bgr(self, tmp_path):
+        import paddle_tpu.vision as pv
+        from PIL import Image
+        p = str(tmp_path / "red.png")
+        Image.fromarray(np.dstack([
+            np.full((2, 2), 200, np.uint8),
+            np.zeros((2, 2), np.uint8),
+            np.zeros((2, 2), np.uint8)])).save(p)
+        bgr = pv.image_load(p, backend="cv2")
+        assert bgr[0, 0, 2] == 200 and bgr[0, 0, 0] == 0

@@ -39,6 +39,11 @@ PAIRS = [
     ("signal", R + "signal.py", "paddle_tpu.signal"),
     ("regularizer", R + "regularizer.py", "paddle_tpu.regularizer"),
     ("text", R + "text/__init__.py", "paddle_tpu.text"),
+    ("incubate", R + "incubate/__init__.py", "paddle_tpu.incubate"),
+    ("device", R + "device/__init__.py", "paddle_tpu.device"),
+    ("inference", R + "inference/__init__.py", "paddle_tpu.inference"),
+    ("profiler", R + "profiler/__init__.py", "paddle_tpu.profiler"),
+    ("onnx", R + "onnx/__init__.py", "paddle_tpu.onnx"),
 ]
 
 
