@@ -57,12 +57,31 @@ def graph_send_recv(x, src_index, dst_index, pool_type: str = "sum",
     return jnp.where(empty, jnp.zeros_like(r), r)
 
 
+def _num_segments(segment_ids) -> int:
+    """max(id) + 1 as a STATIC int.  The output shape depends on it, so
+    it must be concrete: host numpy when ids are concrete (incl. numpy
+    constants closed over by a jit trace); a traced-ids call gets a
+    typed error (the reference's is likewise an eager dynamic-shape op)."""
+    import numpy as np
+
+    import jax.errors
+    try:
+        ids = np.asarray(segment_ids)
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        enforce(False,
+                "segment ops need concrete segment_ids (the output length "
+                "max(id)+1 is a shape): pass numpy/host ids, or keep the "
+                "op outside jit")
+    enforce(ids.size > 0, "segment ops need at least one segment id")
+    return int(ids.max()) + 1
+
+
 def segment_sum(data, segment_ids):
     """Segment reduction over dim 0 (reference incubate segment_sum;
-    XLA-native via jax.ops.segment_*).  num_segments = max(id) + 1,
-    computed on host (eager op, like the reference)."""
+    XLA-native via jax.ops.segment_*)."""
     import jax.numpy as jnp
-    n = int(jnp.max(segment_ids)) + 1
+    n = _num_segments(segment_ids)
     return jax.ops.segment_sum(jnp.asarray(data), jnp.asarray(segment_ids),
                                num_segments=n)
 
@@ -70,8 +89,8 @@ def segment_sum(data, segment_ids):
 def segment_mean(data, segment_ids):
     import jax.numpy as jnp
     data = jnp.asarray(data)
+    n = _num_segments(segment_ids)
     ids = jnp.asarray(segment_ids)
-    n = int(jnp.max(ids)) + 1
     s = jax.ops.segment_sum(data, ids, num_segments=n)
     c = jax.ops.segment_sum(jnp.ones(data.shape[:1], data.dtype), ids,
                             num_segments=n)
@@ -81,16 +100,16 @@ def segment_mean(data, segment_ids):
 
 def segment_max(data, segment_ids):
     import jax.numpy as jnp
-    ids = jnp.asarray(segment_ids)
-    n = int(jnp.max(ids)) + 1
-    return jax.ops.segment_max(jnp.asarray(data), ids, num_segments=n)
+    n = _num_segments(segment_ids)
+    return jax.ops.segment_max(jnp.asarray(data), jnp.asarray(segment_ids),
+                               num_segments=n)
 
 
 def segment_min(data, segment_ids):
     import jax.numpy as jnp
-    ids = jnp.asarray(segment_ids)
-    n = int(jnp.max(ids)) + 1
-    return jax.ops.segment_min(jnp.asarray(data), ids, num_segments=n)
+    n = _num_segments(segment_ids)
+    return jax.ops.segment_min(jnp.asarray(data), jnp.asarray(segment_ids),
+                               num_segments=n)
 
 
 def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
