@@ -968,8 +968,10 @@ def _populate_round5(unary, binary) -> None:
 
 def _populate_session3(unary, binary) -> None:
     """Round-5 session-3 corpus: the __all__-parity ops (activation tail,
-    N-D pools, unfold/fold, grid sampling, loss family, segment ops)
-    join the tested contract."""
+    N-D pools, unfold/fold, loss family, segment ops) join the tested
+    contract.  grid_sample/affine_grid are covered by the identity/flip
+    parity tests in tests/test_nn_ext.py (their numpy oracle is the
+    op itself, so an OpSpec entry would be circular)."""
     import scipy.special as sps
 
     import paddle_tpu as pt
@@ -1008,7 +1010,9 @@ def _populate_session3(unary, binary) -> None:
     unary("acosh", pt.acosh, np.arccosh,
           sample=lambda rng: (_pos(rng, 3, 4) + 1.0,))
     unary("atanh", pt.atanh, np.arctanh,
-          sample=lambda rng: (_r(rng, 3, 4) * 0.4,))
+          # tanh-bounded sample keeps every draw inside arctanh's (-1, 1)
+          # domain for any harness seed
+          sample=lambda rng: (np.tanh(_r(rng, 3, 4)) * 0.95,))
     binary("floor_mod", pt.floor_mod, np.mod,
            sample=lambda rng: (_pos(rng, 3, 4), _pos(rng, 3, 4)),
            grad_wrt=())

@@ -266,8 +266,12 @@ class _WMTBase(Dataset):
         rng = np.random.RandomState(seed)
         content = min(src_size, trg_size) - self._N_SPECIAL
         enforce(content > 0, "dict_size must exceed the 3 special tokens")
+        # the "translation" mapping comes from a FIXED seed shared by all
+        # splits (the Flowers shared-prototype pattern): train and
+        # test/gen must be the same task, only the sampled sequences
+        # differ by the split seed
         perm = np.arange(content)
-        rng.shuffle(perm)
+        np.random.RandomState(97 + content).shuffle(perm)
         self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
         for _ in range(n):
             L = rng.randint(min_len, max_len + 1)

@@ -134,3 +134,24 @@ def test_movielens_record_types():
     titles = {"saving": 10, "private": 11, "ryan": 12}
     assert m.value(cats, titles) == [[2], [0, 1], [10, 11, 12]]
     assert "MovieInfo" in str(m) and "UserInfo" in str(u)
+
+
+def test_wmt_translation_mapping_shared_across_splits():
+    """Regression: each split used to draw its own permutation, making
+    train and test DIFFERENT translation tasks — a model trained on one
+    could never decode the other."""
+    from paddle_tpu.text import WMT14
+
+    def mapping(ds):
+        m = {}
+        for i in range(len(ds)):
+            s, _, tn = ds[i]
+            for a, b in zip(s, tn[:-1]):
+                m.setdefault(int(a), int(b))
+        return m
+
+    tr = mapping(WMT14(mode="train", dict_size=40, synthetic_size=128))
+    ge = mapping(WMT14(mode="gen", dict_size=40, synthetic_size=128))
+    shared = set(tr) & set(ge)
+    assert len(shared) > 10
+    assert all(tr[k] == ge[k] for k in shared)
