@@ -143,7 +143,7 @@ def main():
             print(f"  src={s.tolist()}\n  ref={want.tolist()}"
                   f"\n  hyp={got.tolist()}  {'OK' if ok else 'MISS'}")
     print(f"beam-search exact-match: {correct}/{len(gen)}")
-    assert correct >= len(gen) - 1, "trained translator must decode"
+    assert correct == len(gen), "trained translator must decode exactly"
     print("seq2seq example OK")
 
 

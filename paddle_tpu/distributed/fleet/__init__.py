@@ -272,3 +272,159 @@ def worker_index() -> int:
 
 def worker_num() -> int:
     return get_world_size()
+
+
+# ---------------------------------------------------------------------------
+# Reference fleet __all__ parity: the module-level facade object, util
+# base, role makers (single-controller jax.distributed owns rendezvous;
+# the role surface answers identity queries), and the PS-era data
+# generators (config/format surface; PS compute is out of scope per
+# SURVEY A11 — documented in docs/MIGRATION.md).
+# ---------------------------------------------------------------------------
+import enum as _enum
+import sys as _sys
+
+
+class Role(_enum.IntEnum):
+    """Reference fleet.base.role_maker.Role."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class UtilBase:
+    """Reference fleet.UtilBase: cross-worker util helpers.  These are
+    HOST-side (eager) utilities, so the cross-process path rides
+    multihost_utils.process_allgather, not the in-program mesh
+    collectives (which only exist inside shard_map/jit)."""
+
+    def all_gather(self, input, comm_world: str = "worker"):  # noqa: A002
+        import numpy as _np
+        if jax.process_count() == 1:
+            return [input]
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            _np.asarray(input), tiled=False)
+        return [_np.asarray(g) for g in gathered]
+
+    def all_reduce(self, input, mode: str = "sum",  # noqa: A002
+                   comm_world: str = "worker"):
+        import numpy as _np
+        parts = _np.stack([_np.asarray(p) for p in self.all_gather(input)])
+        ops = {"sum": _np.sum, "min": _np.min, "max": _np.max}
+        enforce(mode in ops, f"all_reduce mode must be one of {list(ops)}")
+        return ops[mode](parts, axis=0)
+
+    def barrier(self, comm_world: str = "worker"):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fleet_util_barrier")
+
+    def get_file_shard(self, files):
+        """Shard a file list over workers with a balanced remainder
+        (reference UtilBase.get_file_shard: 5 files / 4 workers →
+        [2, 1, 1, 1], no idle worker while others hold 2)."""
+        n = jax.process_count()
+        i = jax.process_index()
+        base, rem = divmod(len(files), n)
+        start = i * base + min(i, rem)
+        return files[start:start + base + (1 if i < rem else 0)]
+
+    def print_on_rank(self, message: str, rank_id: int = 0):
+        if jax.process_index() == rank_id:
+            print(message)
+
+
+class PaddleCloudRoleMaker:
+    """Reference role_maker.PaddleCloudRoleMaker: env-derived identity.
+    jax.distributed owns rendezvous; this answers the identity queries
+    ported scripts make."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self) -> int:
+        return jax.process_index()
+
+    def _worker_num(self) -> int:
+        return jax.process_count()
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_first_worker(self) -> bool:
+        return jax.process_index() == 0
+
+    is_first_worker = _is_first_worker
+
+    def _server_num(self) -> int:
+        return 0        # no parameter servers on this stack
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective: bool = True, init_gloo: bool = False,
+                 **kwargs):
+        super().__init__(is_collective)
+        self._kwargs = kwargs
+
+
+class MultiSlotDataGenerator:
+    """Reference fleet MultiSlotDataGenerator: line-protocol generator
+    for slot data files.  The generate/run machinery works (it is plain
+    text IO); feeding a parameter server does not exist here."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) -> iterable of (name, values) lists")
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        for line in _sys.stdin:
+            for sample in self.generate_sample(line)():
+                _sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                out.append(self._format(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant: the line protocol is identical (values are
+    str()-ed either way); the class exists for the reference surface."""
+
+
+class Fleet:
+    """Reference fleet.Fleet: the class behind the module-level facade.
+    An instance delegates to this module's functions, so
+    `fleet.Fleet().init(...)` ≡ `fleet.init(...)`."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def __getattr__(self, name):
+        mod = _sys.modules[__name__]
+        if hasattr(mod, name):
+            return getattr(mod, name)
+        raise AttributeError(name)
+
+
+__all__ += ["Role", "UtilBase", "PaddleCloudRoleMaker",
+            "UserDefinedRoleMaker", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator", "Fleet"]

@@ -8,6 +8,8 @@ surrounding program."""
 from __future__ import annotations
 
 import math
+
+import numpy as np
 from typing import Optional, Sequence
 
 import jax
@@ -15,6 +17,7 @@ import jax.numpy as jnp
 from jax.scipy.special import betaln, digamma, gammaln
 
 from ..framework import random as fw_random
+from ..framework.errors import enforce
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Multinomial", "Independent",
@@ -550,3 +553,138 @@ class ExponentialFamily(Distribution):
 
 
 __all__.append("ExponentialFamily")
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` dims as
+    event dims: log-dets sum over them (reference transform.py
+    IndependentTransform)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self._base.forward(x)
+
+    def inverse(self, y):
+        return self._base.inverse(y)
+
+    def _sum_rightmost(self, v):
+        for _ in range(self._rank):
+            v = jnp.sum(v, axis=-1)
+        return v
+
+    def forward_log_det_jacobian(self, x):
+        return self._sum_rightmost(
+            self._base.forward_log_det_jacobian(x))
+
+
+class ReshapeTransform(Transform):
+    """Event reshape (reference transform.py ReshapeTransform); volume
+    preserving — log-det 0."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        enforce(int(np.prod(self.in_event_shape))
+                == int(np.prod(self.out_event_shape)),
+                "reshape must preserve the event volume")
+
+    def forward(self, x):
+        x = _arr(x)
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def inverse(self, y):
+        y = _arr(y)
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        x = _arr(x)
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, jnp.float32)
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax over the last dim (reference SoftmaxTransform; not
+    bijective on R^n, inverse is log up to an additive constant)."""
+
+    def forward(self, x):
+        return jax.nn.softmax(_arr(x), axis=-1)
+
+    def inverse(self, y):
+        return jnp.log(_arr(y))
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along slices of ``axis`` (reference
+    StackTransform)."""
+
+    def __init__(self, transforms, axis: int = 0):
+        self._transforms = list(transforms)
+        self._axis = axis
+
+    def _map(self, fn_name, v):
+        v = _arr(v)
+        parts = [getattr(t, fn_name)(s.squeeze(self._axis))
+                 for t, s in zip(self._transforms,
+                                 jnp.split(v, len(self._transforms),
+                                           axis=self._axis))]
+        return jnp.stack(parts, axis=self._axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """R^k -> interior of the (k+1)-simplex via stick-breaking
+    (reference StickBreakingTransform)."""
+
+    def forward(self, x):
+        x = _arr(x).astype(jnp.float32)
+        k = x.shape[-1]
+        offset = jnp.log(jnp.asarray(k, jnp.float32)
+                         - jnp.arange(k, dtype=jnp.float32))
+        z = jax.nn.sigmoid(x - offset)
+        one_minus = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(one_minus[..., :1]), one_minus[..., :-1]],
+            axis=-1)
+        head = z * lead
+        return jnp.concatenate([head, one_minus[..., -1:]], axis=-1)
+
+    def inverse(self, y):
+        y = _arr(y).astype(jnp.float32)
+        k = y.shape[-1] - 1
+        cum = jnp.concatenate(
+            [jnp.zeros_like(y[..., :1]), jnp.cumsum(y[..., :-1], -1)],
+            axis=-1)[..., :-1]
+        z = y[..., :-1] / jnp.maximum(1 - cum, 1e-30)
+        offset = jnp.log(jnp.asarray(k, jnp.float32)
+                         - jnp.arange(k, dtype=jnp.float32))
+        return jnp.log(z / jnp.maximum(1 - z, 1e-30)) + offset
+
+    def forward_log_det_jacobian(self, x):
+        x = _arr(x).astype(jnp.float32)
+        k = x.shape[-1]
+        offset = jnp.log(jnp.asarray(k, jnp.float32)
+                         - jnp.arange(k, dtype=jnp.float32))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        one_minus = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(one_minus[..., :1]), one_minus[..., :-1]],
+            axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), axis=-1)
+
+
+__all__ += ["IndependentTransform", "ReshapeTransform", "SoftmaxTransform",
+            "StackTransform", "StickBreakingTransform"]

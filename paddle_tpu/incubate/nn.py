@@ -93,3 +93,34 @@ class FusedFeedForward(Layer):
             activation=self.activation, dropout1=self.act_dropout_rate,
             dropout2=self.dropout_rate, epsilon=self.epsilon,
             pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference incubate/nn FusedTransformerEncoderLayer: the fused MHA +
+    fused FFN pair as one encoder block (fused_transformer.py)."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, activation: str = "relu",
+                 attn_dropout_rate: Optional[float] = None,
+                 act_dropout_rate: Optional[float] = None,
+                 normalize_before: bool = False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate
+                               if attn_dropout_rate is not None
+                               else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=(act_dropout_rate
+                              if act_dropout_rate is not None
+                              else dropout_rate),
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+__all__.append("FusedTransformerEncoderLayer")

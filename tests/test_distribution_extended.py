@@ -184,3 +184,50 @@ class TestNewDatasets:
         from paddle_tpu.vision.datasets import Cifar100
         ds = Cifar100(synthetic_size=32)
         assert len(ds) == 32 and ds.NUM_CLASSES == 100
+
+
+class TestTransformFill:
+    """Round-5 transform tail (reference transform.py __all__ parity)."""
+
+    def test_reshape_roundtrip_zero_logdet(self):
+        from paddle_tpu.distribution import ReshapeTransform
+        rt = ReshapeTransform((4,), (2, 2))
+        x = jnp.arange(8.0).reshape(2, 4)
+        assert rt.forward(x).shape == (2, 2, 2)
+        np.testing.assert_allclose(np.asarray(rt.inverse(rt.forward(x))),
+                                   np.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(rt.forward_log_det_jacobian(x)), 0.0)
+
+    def test_stick_breaking_simplex_and_logdet_vs_autodiff(self):
+        from paddle_tpu.distribution import StickBreakingTransform
+        sb = StickBreakingTransform()
+        v = jnp.asarray(np.random.RandomState(0).randn(5, 3)
+                        .astype(np.float32))
+        y = sb.forward(v)
+        np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
+        assert np.all(np.asarray(y) > 0)
+        np.testing.assert_allclose(np.asarray(sb.inverse(y)),
+                                   np.asarray(v), atol=1e-4)
+        jac = jax.vmap(jax.jacfwd(lambda t: sb.forward(t)[:-1]))(v)
+        ref = np.log(np.abs(np.linalg.det(np.asarray(jac))))
+        np.testing.assert_allclose(
+            np.asarray(sb.forward_log_det_jacobian(v)), ref, rtol=1e-4)
+
+    def test_independent_stack_softmax(self):
+        from paddle_tpu.distribution import (AffineTransform, ExpTransform,
+                                             IndependentTransform,
+                                             SoftmaxTransform,
+                                             StackTransform)
+        it = IndependentTransform(ExpTransform(), 1)
+        assert it.forward_log_det_jacobian(jnp.ones((3, 4))).shape == (3,)
+        st = StackTransform([ExpTransform(), AffineTransform(0.0, 2.0)])
+        out = st.forward(jnp.stack([jnp.zeros(3), jnp.ones(3)]))
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+        np.testing.assert_allclose(np.asarray(out[1]), 2.0)
+        sm = SoftmaxTransform()
+        np.testing.assert_allclose(
+            float(sm.forward(jnp.ones(4)).sum()), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sm.inverse(sm.forward(jnp.zeros(3)))),
+            np.asarray(jnp.full(3, np.log(1 / 3))), rtol=1e-5)

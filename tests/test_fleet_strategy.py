@@ -292,3 +292,41 @@ class TestPortedImportPaths:
             broadcast_dp_parameters, fused_allreduce_gradients)
         assert fused_allreduce_gradients([], None) is None
         assert broadcast_dp_parameters(None, None) is None
+
+
+class TestFleetFacadeCompat:
+    """Reference fleet __all__ tail: Fleet class, UtilBase, role makers,
+    data generators (round 5)."""
+
+    def test_fleet_class_delegates_to_module(self):
+        from paddle_tpu.distributed import fleet
+        f = fleet.Fleet()
+        assert f.init is fleet.init
+        assert isinstance(f.util, fleet.UtilBase)
+
+    def test_role_maker_identity(self):
+        from paddle_tpu.distributed import fleet
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm.worker_index() == 0 and rm.worker_num() == 1
+        assert rm.is_first_worker() and rm._server_num() == 0
+        assert fleet.Role.WORKER == 1
+
+    def test_util_base_single_process(self):
+        from paddle_tpu.distributed import fleet
+        u = fleet.UtilBase()
+        np.testing.assert_allclose(u.all_reduce(np.ones(3)), np.ones(3))
+        assert u.all_gather(1)[0] == 1
+        assert u.get_file_shard(["a", "b"]) == ["a", "b"]
+        u.barrier()
+
+    def test_multi_slot_data_generator_line_protocol(self):
+        from paddle_tpu.distributed import fleet
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def g():
+                    ws = line.split()
+                    yield [("len", [len(w) for w in ws]), ("label", [1])]
+                return g
+
+        assert Gen().run_from_memory(["ab cde"]) == ["2 2 3 1 1"]

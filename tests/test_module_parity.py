@@ -44,6 +44,13 @@ PAIRS = [
     ("inference", R + "inference/__init__.py", "paddle_tpu.inference"),
     ("profiler", R + "profiler/__init__.py", "paddle_tpu.profiler"),
     ("onnx", R + "onnx/__init__.py", "paddle_tpu.onnx"),
+    ("fleet", R + "distributed/fleet/__init__.py",
+     "paddle_tpu.distributed.fleet"),
+    ("incubate.nn", R + "incubate/nn/__init__.py",
+     "paddle_tpu.incubate.nn"),
+    ("distribution.transform", R + "distribution/transform.py",
+     "paddle_tpu.distribution"),
+    ("nn.utils", R + "nn/utils/__init__.py", "paddle_tpu.nn.utils"),
 ]
 
 
