@@ -43,6 +43,10 @@ from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
 from . import version  # noqa: F401
+from .framework.tensor_methods import install_tensor_methods
+
+install_tensor_methods()      # paddle.Tensor method surface on jax arrays
+
 from .framework import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
                         NPUPlace, TPUPlace, get_device, load, save, seed,
                         set_device)
@@ -338,7 +342,16 @@ def dot(x, y):
 
 
 def t(x):
-    return jnp.swapaxes(_arr(x), -1, -2)
+    """Reference paddle.t: identity for 0/1-D, transpose for 2-D; higher
+    ranks are an error (use transpose)."""
+    x = _arr(x)
+    if x.ndim < 2:
+        return x
+    if x.ndim == 2:
+        return jnp.swapaxes(x, -1, -2)
+    raise ValueError(
+        f"paddle.t expects a tensor of rank <= 2, got rank {x.ndim}; "
+        "use transpose for higher-rank permutations")
 
 
 def einsum(eq, *xs):
@@ -446,7 +459,8 @@ def flatten(x, start_axis=0, stop_axis=-1):
 
 
 def gather(x, index, axis=0):
-    return jnp.take(_arr(x), _arr(index), axis=axis)
+    # jnp.take no longer coerces python lists — asarray the indices
+    return jnp.take(_arr(x), jnp.asarray(_arr(index)), axis=axis)
 
 
 def gather_nd(x, index):

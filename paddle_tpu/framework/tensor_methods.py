@@ -1,0 +1,150 @@
+"""paddle.Tensor method surface on jax arrays (reference:
+python/paddle/tensor/__init__.py monkey_patch_* — the reference installs
+~200 methods onto its Tensor; here the paddle-shaped methods are
+installed onto ``jaxlib ArrayImpl`` AND ``jax.core.Tracer`` so the same
+idioms work eagerly and inside jit traces).
+
+Rules:
+- NEVER overrides an attribute the jax types already have (reshape,
+  astype, sum, mean, item, ... stay jax's own);
+- methods are thin jnp delegates, so tracing semantics are untouched;
+- host-only methods (``numpy``, ``cpu``) raise jax's natural
+  concretization error under jit, which is the correct failure mode.
+
+``x.stop_gradient = True`` (instance attribute mutation) cannot exist on
+immutable arrays — use ``x.detach()`` / ``paddle.no_grad`` instead
+(docs/MIGRATION.md: in-place ops).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .dtype import convert_dtype
+
+__all__ = ["install_tensor_methods", "INSTALLED_METHODS"]
+
+
+def _numpy(self):
+    return np.asarray(self)
+
+
+def _detach(self):
+    return jax.lax.stop_gradient(self)
+
+
+def _cpu(self):
+    return jax.device_put(self, jax.devices("cpu")[0])
+
+
+def _cuda(self, device_id: int = 0):
+    return jax.device_put(self, jax.devices()[device_id])
+
+
+def _delegate(name):
+    """Bind the PACKAGE-LEVEL paddle_tpu function of the same name as a
+    method (single source of truth — the functional op; the reference's
+    monkey_patch does exactly this with its op lambdas)."""
+    def method(self, *args, **kwargs):
+        import paddle_tpu as pt
+        return getattr(pt, name)(self, *args, **kwargs)
+    method.__name__ = name
+    return method
+
+
+def _dim(self):
+    return self.ndim
+
+
+def _binary(fn):
+    return lambda self, y: fn(self, y)
+
+
+def _unary(fn):
+    return lambda self: fn(self)
+
+
+_METHODS = {
+    "numpy": _numpy,
+    "detach": _detach,
+    "cpu": _cpu,
+    "cuda": _cuda,
+    "cast": _delegate("cast"),
+    "unsqueeze": _delegate("unsqueeze"),
+    "norm": _delegate("norm"),
+    "numel": _delegate("numel"),
+    "dim": _dim,
+    "ndimension": _dim,
+    "t": _delegate("t"),
+    "expand": _delegate("expand"),
+    "tile": _delegate("tile"),
+    "gather": _delegate("gather"),
+    "allclose": _delegate("allclose"),
+    # binary ops (paddle spelling)
+    "add": _binary(jnp.add),
+    "subtract": _binary(jnp.subtract),
+    "multiply": _binary(jnp.multiply),
+    "divide": _binary(jnp.divide),
+    "matmul": _binary(jnp.matmul),
+    "mm": _binary(jnp.matmul),
+    "mod": _binary(jnp.mod),
+    "pow": _binary(jnp.power),
+    "maximum": _binary(jnp.maximum),
+    "minimum": _binary(jnp.minimum),
+    "equal": _binary(jnp.equal),
+    "not_equal": _binary(jnp.not_equal),
+    "greater_than": _binary(jnp.greater),
+    "greater_equal": _binary(jnp.greater_equal),
+    "less_than": _binary(jnp.less),
+    "less_equal": _binary(jnp.less_equal),
+    "logical_and": _binary(jnp.logical_and),
+    "logical_or": _binary(jnp.logical_or),
+    # unary math (paddle spelling)
+    "abs": _unary(jnp.abs),
+    "exp": _unary(jnp.exp),
+    "log": _unary(jnp.log),
+    "sqrt": _unary(jnp.sqrt),
+    "rsqrt": _unary(jax.lax.rsqrt),
+    "square": _unary(jnp.square),
+    "tanh": _unary(jnp.tanh),
+    "sigmoid": _unary(jax.nn.sigmoid),
+    "floor": _unary(jnp.floor),
+    "ceil": _unary(jnp.ceil),
+    "sign": _unary(jnp.sign),
+    "erf": _unary(jax.scipy.special.erf),
+    "isnan": _unary(jnp.isnan),
+    "isinf": _unary(jnp.isinf),
+    "isfinite": _unary(jnp.isfinite),
+}
+
+INSTALLED_METHODS: list = []
+
+
+def install_tensor_methods() -> None:
+    """Install the method table onto the concrete array class and the
+    tracer base; existing attributes are never touched.  The class is
+    imported, NOT derived from a live array — materializing one here
+    would initialize the backend at package-import time (and hang when
+    the TPU tunnel is down)."""
+    from jax._src.array import ArrayImpl
+    targets = [ArrayImpl, jax.core.Tracer]
+    failed = []
+    for name, fn in _METHODS.items():
+        for t in targets:
+            if not hasattr(t, name):
+                try:
+                    setattr(t, name, fn)
+                except (AttributeError, TypeError):
+                    failed.append((t.__name__, name))
+                    continue
+                if name not in INSTALLED_METHODS:
+                    INSTALLED_METHODS.append(name)
+    if failed:
+        # a sealed type in a future jaxlib must be loud, not a silent
+        # removal of the whole eager method surface
+        import warnings
+        warnings.warn(
+            f"tensor-method install skipped {len(failed)} bindings "
+            f"(sealed type?): {failed[:5]}...", RuntimeWarning)

@@ -1,0 +1,95 @@
+"""paddle.Tensor method-surface tests: the reference monkey-patches its
+method corpus onto Tensor (python/paddle/tensor/__init__.py); here the
+same idioms are installed on jax arrays AND tracers — both paths pinned."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+
+
+@pytest.mark.quick
+class TestTensorMethods:
+    def test_host_methods(self):
+        x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert isinstance(x.numpy(), np.ndarray)
+        assert x.cpu().shape == (2, 2)
+        assert x.numel() == 4
+        assert x.dim() == 2 and x.ndimension() == 2
+
+    def test_math_methods_match_functions(self):
+        x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(np.asarray(x.add(x)),
+                                   np.asarray(pt.add(x, x)))
+        np.testing.assert_allclose(np.asarray(x.multiply(x)),
+                                   np.asarray(x) ** 2)
+        np.testing.assert_allclose(np.asarray(x.matmul(x)),
+                                   np.asarray(x) @ np.asarray(x))
+        np.testing.assert_allclose(np.asarray(x.sigmoid()),
+                                   1 / (1 + np.exp(-np.asarray(x))),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(x.rsqrt()),
+                                   1 / np.sqrt(np.asarray(x)), rtol=1e-6)
+        assert bool(x.greater_than(pt.zeros([2, 2])).all())
+
+    def test_t_reference_contract(self):
+        v = pt.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(v.t()), np.asarray(v))
+        m = pt.to_tensor(np.arange(6.0).reshape(2, 3))
+        assert m.t().shape == (3, 2)
+        with pytest.raises(ValueError, match="rank"):
+            pt.to_tensor(np.zeros((2, 2, 2))).t()
+
+    def test_norm_delegates_to_functional(self):
+        x = pt.to_tensor(np.arange(24.0).reshape(2, 3, 4))
+        np.testing.assert_allclose(np.asarray(x.norm()),
+                                   np.asarray(pt.norm(x)), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(x.norm(p=2, axis=1, keepdim=True)),
+            np.asarray(pt.norm(x, p=2, axis=1, keepdim=True)), rtol=1e-6)
+
+    def test_shape_methods(self):
+        x = pt.to_tensor(np.arange(6.0).reshape(2, 3))
+        assert x.unsqueeze(0).shape == (1, 2, 3)
+        assert x.t().shape == (3, 2)
+        assert x.expand([4, 2, 3]).shape == (4, 2, 3)
+        assert x.tile([2, 1]).shape == (4, 3)
+        np.testing.assert_allclose(
+            np.asarray(x.gather([1], axis=1)).ravel(), [1.0, 4.0])
+        assert str(x.cast("int64").dtype) in ("int64", "int32")
+
+    def test_detach_stops_gradient(self):
+        g = jax.grad(lambda t: jnp.sum(t.detach() * t))(
+            pt.to_tensor([2.0, 3.0]))
+        np.testing.assert_allclose(np.asarray(g), [2.0, 3.0])
+
+    def test_methods_work_under_jit(self):
+        x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+
+        @jax.jit
+        def f(t):
+            return t.add(t).tanh().matmul(t.t()).unsqueeze(0).norm()
+
+        assert float(f(x)) > 0
+
+    def test_jax_native_methods_not_overridden(self):
+        from paddle_tpu.framework.tensor_methods import _METHODS
+        x = pt.to_tensor([1.0, 2.0])
+        # native jax methods keep native semantics
+        assert x.reshape(2, 1).shape == (2, 1)      # jax-style varargs OK
+        assert float(x.sum()) == 3.0
+        # nothing in our table shadows something jax already had
+        assert "reshape" not in _METHODS and "sum" not in _METHODS
+
+    def test_numpy_raises_under_jit(self):
+        x = pt.to_tensor([1.0])
+
+        @jax.jit
+        def f(t):
+            return t.numpy()
+
+        with pytest.raises((jax.errors.TracerArrayConversionError,
+                            jax.errors.ConcretizationTypeError)):
+            f(x)
