@@ -729,3 +729,14 @@ class DataParallel(nn.Layer):
 # extended op corpus (reference tensor/{math,manipulation,search,random}.py
 # long tail) — see tensor_ops.py
 from .tensor_ops import *  # noqa: F401,F403,E402
+
+def inverse(x):
+    """Matrix inverse (reference paddle.inverse == linalg.inv)."""
+    return linalg.inv(x)
+
+
+# second method-install pass: the full reference tensor_method_func
+# contract, now that every functional op is importable
+from .framework.tensor_methods import install_reference_method_contract
+
+install_reference_method_contract()

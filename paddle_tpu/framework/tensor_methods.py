@@ -24,7 +24,9 @@ import jax.numpy as jnp
 
 from .dtype import convert_dtype
 
-__all__ = ["install_tensor_methods", "INSTALLED_METHODS"]
+__all__ = ["install_tensor_methods",
+           "install_reference_method_contract",
+           "INSTALLED_METHODS"]
 
 
 def _numpy(self):
@@ -43,15 +45,40 @@ def _cuda(self, device_id: int = 0):
     return jax.device_put(self, jax.devices()[device_id])
 
 
-def _delegate(name):
-    """Bind the PACKAGE-LEVEL paddle_tpu function of the same name as a
-    method (single source of truth — the functional op; the reference's
+def _delegate(name, kind: str = "pt"):
+    """Bind a PACKAGE-LEVEL paddle_tpu (or paddle_tpu.linalg) function as
+    a method (single source of truth — the functional op; the reference's
     monkey_patch does exactly this with its op lambdas)."""
     def method(self, *args, **kwargs):
         import paddle_tpu as pt
-        return getattr(pt, name)(self, *args, **kwargs)
+        mod = pt.linalg if kind == "linalg" else pt
+        return getattr(mod, name)(self, *args, **kwargs)
     method.__name__ = name
     return method
+
+
+def _install(table) -> None:
+    """Shared install loop: bind onto the concrete array class and the
+    tracer base, never touching existing attributes; sealed-type
+    failures are LOUD (a silent skip would vanish the whole surface)."""
+    from jax._src.array import ArrayImpl
+    targets = [ArrayImpl, jax.core.Tracer]
+    failed = []
+    for name, fn in table.items():
+        for t in targets:
+            if not hasattr(t, name):
+                try:
+                    setattr(t, name, fn)
+                except (AttributeError, TypeError):
+                    failed.append((t.__name__, name))
+                    continue
+                if name not in INSTALLED_METHODS:
+                    INSTALLED_METHODS.append(name)
+    if failed:
+        import warnings
+        warnings.warn(
+            f"tensor-method install skipped {len(failed)} bindings "
+            f"(sealed type?): {failed[:5]}...", RuntimeWarning)
 
 
 def _dim(self):
@@ -128,23 +155,275 @@ def install_tensor_methods() -> None:
     imported, NOT derived from a live array — materializing one here
     would initialize the backend at package-import time (and hang when
     the TPU tunnel is down)."""
-    from jax._src.array import ArrayImpl
-    targets = [ArrayImpl, jax.core.Tracer]
-    failed = []
-    for name, fn in _METHODS.items():
-        for t in targets:
-            if not hasattr(t, name):
-                try:
-                    setattr(t, name, fn)
-                except (AttributeError, TypeError):
-                    failed.append((t.__name__, name))
-                    continue
-                if name not in INSTALLED_METHODS:
-                    INSTALLED_METHODS.append(name)
-    if failed:
-        # a sealed type in a future jaxlib must be loud, not a silent
-        # removal of the whole eager method surface
-        import warnings
-        warnings.warn(
-            f"tensor-method install skipped {len(failed)} bindings "
-            f"(sealed type?): {failed[:5]}...", RuntimeWarning)
+    _install(_METHODS)
+
+
+# The reference Tensor method contract (python/paddle/tensor/__init__.py
+# ``tensor_method_func`` — the exact list the reference monkey-patches
+# onto its Tensor).  Everything here that has a package-level
+# counterpart (paddle_tpu.<name>, paddle_tpu.linalg.<name>, or the
+# non-inplace base of a ``name_``) is auto-delegated as a method, with
+# ``self`` as the first argument — byte-for-byte the reference's own
+# binding rule.
+_REF_TENSOR_METHODS = [
+    "matmul",
+    "dot",
+    "cov",
+    "norm",
+    "cond",
+    "transpose",
+    "lstsq",
+    "dist",
+    "t",
+    "cross",
+    "cholesky",
+    "bmm",
+    "histogram",
+    "bincount",
+    "mv",
+    "matrix_power",
+    "qr",
+    "eigvals",
+    "eigvalsh",
+    "abs",
+    "acos",
+    "all",
+    "any",
+    "asin",
+    "atan",
+    "ceil",
+    "ceil_",
+    "cos",
+    "cosh",
+    "cumsum",
+    "cumprod",
+    "logit",
+    "exp",
+    "exp_",
+    "floor",
+    "floor_",
+    "increment",
+    "log",
+    "log2",
+    "log10",
+    "logsumexp",
+    "multiplex",
+    "pow",
+    "prod",
+    "reciprocal",
+    "reciprocal_",
+    "round",
+    "round_",
+    "rsqrt",
+    "rsqrt_",
+    "scale",
+    "scale_",
+    "sign",
+    "sin",
+    "sinh",
+    "sqrt",
+    "sqrt_",
+    "square",
+    "stanh",
+    "sum",
+    "nansum",
+    "nanmean",
+    "tanh",
+    "tanh_",
+    "add_n",
+    "max",
+    "amax",
+    "maximum",
+    "min",
+    "amin",
+    "minimum",
+    "fmax",
+    "fmin",
+    "mm",
+    "inner",
+    "outer",
+    "divide",
+    "floor_divide",
+    "remainder",
+    "mod",
+    "floor_mod",
+    "multiply",
+    "add",
+    "add_",
+    "subtract",
+    "subtract_",
+    "atan",
+    "logsumexp",
+    "inverse",
+    "log1p",
+    "erf",
+    "addmm",
+    "clip",
+    "clip_",
+    "trace",
+    "kron",
+    "kthvalue",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "broadcast_shape",
+    "conj",
+    "neg",
+    "lgamma",
+    "equal",
+    "equal_all",
+    "greater_equal",
+    "greater_than",
+    "is_empty",
+    "less_equal",
+    "less_than",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "not_equal",
+    "allclose",
+    "isclose",
+    "is_tensor",
+    "cast",
+    "concat",
+    "expand",
+    "broadcast_to",
+    "expand_as",
+    "flatten",
+    "flatten_",
+    "gather",
+    "gather_nd",
+    "reshape",
+    "reshape_",
+    "reverse",
+    "scatter",
+    "scatter_",
+    "scatter_nd_add",
+    "scatter_nd",
+    "shard_index",
+    "slice",
+    "split",
+    "chunk",
+    "tensordot",
+    "squeeze",
+    "squeeze_",
+    "stack",
+    "strided_slice",
+    "transpose",
+    "unique",
+    "unique_consecutive",
+    "unsqueeze",
+    "unsqueeze_",
+    "unstack",
+    "flip",
+    "rot90",
+    "unbind",
+    "roll",
+    "tile",
+    "argmax",
+    "argmin",
+    "argsort",
+    "masked_select",
+    "topk",
+    "where",
+    "index_select",
+    "nonzero",
+    "sort",
+    "index_sample",
+    "mean",
+    "std",
+    "var",
+    "numel",
+    "median",
+    "quantile",
+    "is_complex",
+    "is_integer",
+    "rank",
+    "shape",
+    "real",
+    "imag",
+    "is_floating_point",
+    "digamma",
+    "diagonal",
+    "trunc",
+    "bitwise_and",
+    "bitwise_or",
+    "bitwise_xor",
+    "bitwise_not",
+    "broadcast_tensors",
+    "eig",
+    "uniform_",
+    "multi_dot",
+    "solve",
+    "cholesky_solve",
+    "triangular_solve",
+    "asinh",
+    "atanh",
+    "acosh",
+    "lu",
+    "lu_unpack",
+    "as_complex",
+    "as_real",
+    "rad2deg",
+    "deg2rad",
+    "gcd",
+    "lcm",
+    "diff",
+    "mode",
+    "lerp",
+    "lerp_",
+    "erfinv",
+    "erfinv_",
+    "angle",
+    "moveaxis",
+    "repeat_interleave",
+    "take_along_axis",
+    "put_along_axis",
+    "put_along_axis_",
+    "exponential_",
+]
+
+
+def _resolve_ref_method(name):
+    import paddle_tpu as pt
+    fn = getattr(pt, name, None)
+    if callable(fn):
+        return name, "pt"
+    fn = getattr(pt.linalg, name, None)
+    if callable(fn):
+        return name, "linalg"
+    if name.endswith("_"):
+        base = name[:-1]
+        if callable(getattr(pt, base, None)):
+            return base, "pt"
+        if callable(getattr(pt.linalg, base, None)):
+            return base, "linalg"
+    return None, None
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
+    """Reference Tensor.uniform_(min, max): a uniform fill of SELF's
+    shape/dtype — must NOT fall through to the creation op
+    paddle.uniform(shape, ...), whose first argument is a shape."""
+    import paddle_tpu as pt
+    return pt.uniform(self.shape, str(self.dtype), min, max)
+
+
+# in-place names whose BASE is a creation/op with a non-tensor first
+# argument: auto-delegation would be semantically wrong
+_REF_METHOD_OVERRIDES = {"uniform_": _uniform_}
+
+
+def install_reference_method_contract() -> None:
+    """Second install pass: the full reference tensor_method_func list,
+    auto-delegated.  Runs AFTER the package namespace is fully built
+    (end of paddle_tpu/__init__), so every functional op is resolvable."""
+    table = dict(_REF_METHOD_OVERRIDES)
+    for name in _REF_TENSOR_METHODS:
+        if name in table:
+            continue
+        resolved, kind = _resolve_ref_method(name)
+        if resolved is not None:
+            table[name] = _delegate(resolved, kind)
+    _install(table)
