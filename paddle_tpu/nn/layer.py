@@ -132,6 +132,9 @@ _scope = threading.local()
 # Monotonic hook-handle ids (removal must never free an id for reuse).
 _hook_ids = itertools.count()
 
+# full_name() uniquifier per lowercased class name (reference semantics)
+_full_name_counts: Dict[str, int] = {}
+
 
 def _mutation_sink() -> Optional[Dict[str, Any]]:
     return getattr(_scope, "sink", None)
@@ -148,6 +151,7 @@ class Layer:
         object.__setattr__(self, "training", True)
         object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
         object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        object.__setattr__(self, "_state_dict_hooks", OrderedDict())
 
     # -- attribute interception ------------------------------------------
     def __setattr__(self, name: str, value: Any) -> None:
@@ -207,6 +211,12 @@ class Layer:
 
     def register_buffer(self, name: str, tensor, persistable: bool = True):
         self._buffers[name] = jnp.asarray(tensor)
+        if not persistable:
+            # excluded from state_dict/checkpoints (reference semantics);
+            # still visible via named_buffers
+            self.__dict__.setdefault("_non_persistable", set()).add(name)
+        else:
+            self.__dict__.get("_non_persistable", set()).discard(name)
         self.__dict__.pop(name, None)
 
     def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
@@ -254,8 +264,77 @@ class Layer:
             sp = f"{prefix}.{name}" if prefix else name
             yield from sub.named_buffers(prefix=sp)
 
+    def _named_persistable_buffers(self, prefix: str = ""):
+        skip = self.__dict__.get("_non_persistable", set())
+        for name, b in self._buffers.items():
+            if name in skip:
+                continue
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for name, sub in self._sub_layers.items():
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub._named_persistable_buffers(prefix=sp)
+
     def buffers(self):
         return [b for _, b in self.named_buffers()]
+
+    def children(self):
+        """Immediate sublayers (reference Layer.children)."""
+        yield from self._sub_layers.values()
+
+    def named_children(self):
+        yield from self._sub_layers.items()
+
+    def full_name(self) -> str:
+        """Reference Layer.full_name: a unique class-derived name."""
+        if not hasattr(self, "_full_name"):
+            cls = type(self).__name__.lower()
+            n = _full_name_counts.get(cls, 0)
+            _full_name_counts[cls] = n + 1
+            self._full_name = f"{cls}_{n}"
+        return self._full_name
+
+    def extra_repr(self) -> str:
+        """Override to add info to repr (reference Layer.extra_repr)."""
+        return ""
+
+    def create_variable(self, name=None, persistable=None, dtype="float32"):
+        """A non-parameter variable attached to the layer (reference
+        Layer.create_variable) — a zero scalar buffer here."""
+        import jax.numpy as _jnp
+        from ..framework.dtype import convert_dtype
+        var = _jnp.zeros((), convert_dtype(dtype))
+        key = name or f"_var_{len(self._buffers)}"
+        self.register_buffer(key, var, persistable=bool(persistable))
+        return self._buffers[key]
+
+    create_tensor = create_variable
+
+    def clear_gradients(self):
+        """No-op for API parity: gradients are function outputs here, not
+        accumulated state on parameters (docs/MIGRATION.md: autograd)."""
+
+    def backward(self, *args, **kwargs):
+        raise RuntimeError(
+            "Layer.backward walks a mutable autograd tape, which does not "
+            "exist in this functional runtime; use jax.value_and_grad "
+            "over a loss function (docs/MIGRATION.md: autograd).")
+
+    def register_state_dict_hook(self, hook):
+        """Hook(state_dict) -> state_dict run at every state_dict() call
+        on this layer OR any ancestor (reference semantics: sublayer
+        hooks fire during the parent's recursion).  Returns a removable
+        handle (reference HookRemoveHelper)."""
+        hid = next(_hook_ids)
+        self._state_dict_hooks[hid] = hook
+
+        class _Handle:
+            def remove(_self):
+                self._state_dict_hooks.pop(hid, None)
+
+        return _Handle()
+
+    def to_static_state_dict(self, include_buffers: bool = True):
+        return self.state_dict(include_buffers=include_buffers)
 
     # -- state dict -------------------------------------------------------
     def state_dict(self, include_buffers: bool = True) -> Dict[str, Any]:
@@ -263,8 +342,16 @@ class Layer:
         for name, p in self.named_parameters():
             out[name] = p.value
         if include_buffers:
-            for name, b in self.named_buffers():
+            for name, b in self._named_persistable_buffers():
                 out[name] = b
+        # run this layer's hooks AND every sublayer's (the reference
+        # runs each sublayer's hooks during its recursion)
+        for _, sub in self.named_sublayers(include_self=True):
+            for hook in getattr(sub, "_state_dict_hooks",
+                                OrderedDict()).values():
+                result = hook(out)
+                if result is not None:
+                    out = result
         return out
 
     def trainable_variables(self) -> Dict[str, Any]:

@@ -248,3 +248,59 @@ def test_conv_initializer_fans():
     from paddle_tpu.nn.initializer import _fans
     fan_in, fan_out = _fans((64, 3, 3, 3))  # OIHW
     assert fan_in == 27 and fan_out == 576
+
+
+class TestLayerMethodParity:
+    """Reference Layer public-method contract (dygraph layers.py:Layer),
+    round-5 completion: children/full_name/state-dict hooks/etc."""
+
+    def test_reference_layer_methods_all_present(self):
+        import ast
+        import os
+        ref = "/root/reference/python/paddle/fluid/dygraph/layers.py"
+        if not os.path.exists(ref):
+            pytest.skip("reference not present")
+        tree = ast.parse(open(ref).read())
+        names = [n.name for node in ast.walk(tree)
+                 if isinstance(node, ast.ClassDef) and node.name == "Layer"
+                 for n in node.body if isinstance(n, ast.FunctionDef)
+                 and not n.name.startswith("_")]
+        missing = [x for x in names if not hasattr(nn.Layer, x)]
+        assert not missing, missing
+
+    def test_children_and_full_name(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.ReLU())
+        kids = list(m.children())
+        assert len(kids) == 2 and isinstance(kids[0], nn.Linear)
+        assert dict(m.named_children())
+        a, b = nn.Linear(2, 2), nn.Linear(2, 2)
+        assert a.full_name() != b.full_name()
+        assert a.full_name() == a.full_name()     # stable per instance
+
+    def test_state_dict_hook_runs(self):
+        m = nn.Linear(2, 3)
+        calls = []
+        m.register_state_dict_hook(lambda sd: calls.append(len(sd)) or sd)
+        sd = m.state_dict()
+        assert calls == [len(sd)]
+
+    def test_sublayer_state_dict_hook_fires_and_is_removable(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.ReLU())
+        calls = []
+        handle = list(m.children())[0].register_state_dict_hook(
+            lambda sd: calls.append(1) or sd)
+        m.state_dict()
+        assert calls == [1]
+        handle.remove()
+        m.state_dict()
+        assert calls == [1]
+
+    def test_non_persistable_variable_excluded_from_state_dict(self):
+        l = nn.Linear(2, 2)
+        l.create_variable(persistable=False)
+        assert not any(k.startswith("_var") for k in l.state_dict())
+        assert any(k.startswith("_var") for k, _ in l.named_buffers())
+
+    def test_backward_raises_with_recipe(self):
+        with pytest.raises(RuntimeError, match="value_and_grad"):
+            nn.Linear(2, 2).backward()
