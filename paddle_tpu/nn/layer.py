@@ -300,9 +300,7 @@ class Layer:
     def create_variable(self, name=None, persistable=None, dtype="float32"):
         """A non-parameter variable attached to the layer (reference
         Layer.create_variable) — a zero scalar buffer here."""
-        import jax.numpy as _jnp
-        from ..framework.dtype import convert_dtype
-        var = _jnp.zeros((), convert_dtype(dtype))
+        var = jnp.zeros((), convert_dtype(dtype))
         key = name or f"_var_{len(self._buffers)}"
         self.register_buffer(key, var, persistable=bool(persistable))
         return self._buffers[key]
@@ -361,10 +359,13 @@ class Layer:
     def set_state_dict(self, state: Dict[str, Any], strict: bool = True):
         own_params = dict(self.named_parameters())
         buf_owners = {}
+        persistable = {}
         for path, sub in self.named_sublayers(include_self=True):
+            skip = sub.__dict__.get("_non_persistable", set())
             for bname in sub._buffers:
                 full = f"{path}.{bname}" if path else bname
                 buf_owners[full] = (sub, bname)
+                persistable[full] = bname not in skip
         unexpected = []
         for name, value in state.items():
             if name in own_params:
@@ -378,7 +379,10 @@ class Layer:
             else:
                 unexpected.append(name)
         if strict:
-            missing = [k for k in list(own_params) + list(buf_owners)
+            # non-persistable buffers are excluded from state_dict, so a
+            # strict round-trip must not demand them back
+            missing = [k for k in list(own_params)
+                       + [b for b in buf_owners if persistable[b]]
                        if k not in state]
             if unexpected or missing:
                 raise KeyError(
@@ -466,10 +470,13 @@ class Layer:
         """Temporarily substitute parameter/buffer values from a flat dict."""
         own_params = dict(self.named_parameters())
         buf_owners = {}
+        persistable = {}
         for path, sub in self.named_sublayers(include_self=True):
+            skip = sub.__dict__.get("_non_persistable", set())
             for bname in sub._buffers:
                 full = f"{path}.{bname}" if path else bname
                 buf_owners[full] = (sub, bname)
+                persistable[full] = bname not in skip
         saved_p, saved_b = {}, {}
         try:
             for name, value in variables.items():

@@ -268,6 +268,68 @@ class Optimizer:
             for p in self._parameters:
                 p._grad = None
 
+    def backward(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, callbacks=None):
+        """Reference Optimizer.backward: compute (param, grad) pairs for
+        ``minimize``.  Functionally: grads of ``loss`` — when ``loss`` is
+        a CALLABLE of the parameter values it is differentiated directly;
+        a plain tensor cannot be walked backward (no tape) and raises
+        with the recipe.  Grads are computed for (and later applied to)
+        the CONSTRUCTOR-bound parameters; a ``parameters`` argument must
+        match that binding — rebinding per call is not supported in the
+        stateful path."""
+        enforce(self._parameters,
+                "optimizer has no bound parameters; construct with "
+                "parameters=... (the stateful step/minimize path is "
+                "bound at construction)")
+        if parameters is not None:
+            enforce(list(parameters) == list(self._parameters),
+                    "minimize/backward(parameters=...) must match the "
+                    "constructor-bound parameter list — per-call "
+                    "rebinding is not supported")
+        if not callable(loss):
+            raise RuntimeError(
+                "Optimizer.backward(loss_tensor) needs an autograd tape, "
+                "which does not exist here; pass a CALLABLE "
+                "loss_fn(values_dict) (or use jax.value_and_grad "
+                "directly — docs/MIGRATION.md: autograd).")
+        keys = self._param_keys()
+        values = dict(zip(keys, (p.value for p in self._parameters)))
+        grads = jax.grad(loss)(values)
+        return [(p, grads[k]) for p, k in zip(self._parameters, keys)]
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Reference Optimizer.minimize: backward + apply.  ``loss`` is a
+        callable of the parameter-values dict (see backward)."""
+        pg = self.backward(loss, parameters=parameters)
+        self.step([g for _, g in pg])
+        return None, pg
+
+    def append_regularization_ops(self, params_grads, regularization=None):
+        """Reference append_regularization_ops: add the regularizer's
+        gradient term to each grad (decay is otherwise folded into
+        _update at apply time)."""
+        coeff = getattr(regularization, "coeff", None)
+        if coeff is None:
+            return params_grads
+        from ..regularizer import L1Decay
+        if isinstance(regularization, L1Decay):
+            return [(p, g + coeff * jnp.sign(jnp.asarray(p)))
+                    for p, g in params_grads]
+        return [(p, g + coeff * jnp.asarray(p)) for p, g in params_grads]
+
+    def get_opti_var_name_list(self):
+        """Slot-variable names (reference get_opti_var_name_list)."""
+        self._ensure_state()
+        names = []
+        slots = self._state.get("slots", self._state)
+        if isinstance(slots, dict):
+            for pname, slot in slots.items():
+                if isinstance(slot, dict):
+                    names += [f"{pname}.{s}" for s in slot]
+        return names
+
     def state_dict(self):
         self._ensure_state()
         sd = {"state": self._state}

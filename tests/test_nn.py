@@ -304,3 +304,11 @@ class TestLayerMethodParity:
     def test_backward_raises_with_recipe(self):
         with pytest.raises(RuntimeError, match="value_and_grad"):
             nn.Linear(2, 2).backward()
+
+
+def test_strict_roundtrip_with_non_persistable_buffer():
+    """Regression: strict set_state_dict demanded back buffers that
+    state_dict (correctly) no longer saves."""
+    l = nn.Linear(2, 2)
+    l.create_variable(persistable=False)
+    l.set_state_dict(l.state_dict())

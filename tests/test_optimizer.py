@@ -303,3 +303,64 @@ class TestLars:
         step_big = float(jnp.abs(nb["w"] - big["w"])[0])
         step_small = float(jnp.abs(ns["w"] - small["w"])[0])
         assert step_big > 100 * step_small
+
+
+class TestOptimizerMethodParity:
+    """Reference Optimizer public-method contract, round-5 completion:
+    backward/minimize (callable-loss form), append_regularization_ops,
+    get_opti_var_name_list."""
+
+    def test_reference_optimizer_methods_all_present(self):
+        import ast
+        import os
+        ref = "/root/reference/python/paddle/optimizer/optimizer.py"
+        if not os.path.exists(ref):
+            pytest.skip("reference not present")
+        tree = ast.parse(open(ref).read())
+        names = [n.name for node in ast.walk(tree)
+                 if isinstance(node, ast.ClassDef)
+                 and node.name == "Optimizer"
+                 for n in node.body if isinstance(n, ast.FunctionDef)
+                 and not n.name.startswith("_")]
+        from paddle_tpu.optimizer import Optimizer
+        missing = [m for m in names if not hasattr(Optimizer, m)]
+        assert not missing, missing
+
+    def test_minimize_trains_callable_loss(self):
+        pt.seed(0)
+        lin = nn.Linear(3, 1)
+        params = [p for _, p in lin.named_parameters()]
+        o = pt.optimizer.SGD(learning_rate=0.3, parameters=params)
+        x = jnp.asarray(np.random.RandomState(0).randn(32, 3),
+                        jnp.float32)
+        y = x @ jnp.asarray([1.0, -2.0, 0.5])
+
+        def loss_fn(values):
+            return jnp.mean(
+                (x @ values["weight"] + values["bias"] - y[:, None]) ** 2)
+
+        first = float(loss_fn({"weight": lin.weight.value,
+                               "bias": lin.bias.value}))
+        for _ in range(80):
+            _, pg = o.minimize(loss_fn)
+        assert len(pg) == 2
+        last = float(loss_fn({"weight": lin.weight.value,
+                              "bias": lin.bias.value}))
+        assert last < first * 0.01
+
+    def test_backward_tensor_raises_with_recipe(self):
+        o = pt.optimizer.SGD(parameters=[nn.Linear(2, 2).weight])
+        with pytest.raises(RuntimeError, match="tape"):
+            o.backward(jnp.asarray(1.0))
+
+    def test_append_regularization_ops(self):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+        p = pt.create_parameter([3], "float32",
+                                default_initializer=nn.initializer.Constant(2.0))
+        g = jnp.zeros(3)
+        (_, g2), = pt.optimizer.SGD(parameters=[p]).append_regularization_ops(
+            [(p, g)], L2Decay(0.5))
+        np.testing.assert_allclose(np.asarray(g2), 1.0)  # 0.5 * 2.0
+        (_, g1), = pt.optimizer.SGD(parameters=[p]).append_regularization_ops(
+            [(p, g)], L1Decay(0.5))
+        np.testing.assert_allclose(np.asarray(g1), 0.5)  # 0.5 * sign(2)
