@@ -50,13 +50,16 @@ def main():
     test = MNIST(mode="test", transform=plain, synthetic_size=512)
 
     model = Model(SmallNet())
-    sched = pt.optimizer.lr.CosineAnnealingDecay(3e-3, T_max=5)
+    # T_max is in SCHEDULER STEPS; fit's LRScheduler callback steps
+    # per BATCH (reference default by_step=True): 16 batches/epoch
+    sched = pt.optimizer.lr.CosineAnnealingDecay(3e-3, T_max=160)
     model.prepare(pt.optimizer.Adam(learning_rate=sched),
                   nn.CrossEntropyLoss(), Accuracy())
     model.fit(DataLoader(train, batch_size=128, shuffle=True),
-              epochs=3, verbose=1)
+              epochs=10, verbose=1)
     metrics = model.evaluate(DataLoader(test, batch_size=256), verbose=0)
     print("eval:", metrics)
+    assert metrics["acc"] > 0.9, "the synthetic-MNIST convnet must learn"
 
 
 if __name__ == "__main__":

@@ -359,13 +359,12 @@ class Layer:
     def set_state_dict(self, state: Dict[str, Any], strict: bool = True):
         own_params = dict(self.named_parameters())
         buf_owners = {}
-        persistable = {}
         for path, sub in self.named_sublayers(include_self=True):
-            skip = sub.__dict__.get("_non_persistable", set())
             for bname in sub._buffers:
                 full = f"{path}.{bname}" if path else bname
                 buf_owners[full] = (sub, bname)
-                persistable[full] = bname not in skip
+        # the state_dict exclusion rule, from its single source of truth
+        persistable_names = {n for n, _ in self._named_persistable_buffers()}
         unexpected = []
         for name, value in state.items():
             if name in own_params:
@@ -382,7 +381,7 @@ class Layer:
             # non-persistable buffers are excluded from state_dict, so a
             # strict round-trip must not demand them back
             missing = [k for k in list(own_params)
-                       + [b for b in buf_owners if persistable[b]]
+                       + [b for b in buf_owners if b in persistable_names]
                        if k not in state]
             if unexpected or missing:
                 raise KeyError(
@@ -470,13 +469,10 @@ class Layer:
         """Temporarily substitute parameter/buffer values from a flat dict."""
         own_params = dict(self.named_parameters())
         buf_owners = {}
-        persistable = {}
         for path, sub in self.named_sublayers(include_self=True):
-            skip = sub.__dict__.get("_non_persistable", set())
             for bname in sub._buffers:
                 full = f"{path}.{bname}" if path else bname
                 buf_owners[full] = (sub, bname)
-                persistable[full] = bname not in skip
         saved_p, saved_b = {}, {}
         try:
             for name, value in variables.items():

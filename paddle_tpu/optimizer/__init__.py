@@ -323,11 +323,9 @@ class Optimizer:
         """Slot-variable names (reference get_opti_var_name_list)."""
         self._ensure_state()
         names = []
-        slots = self._state.get("slots", self._state)
-        if isinstance(slots, dict):
-            for pname, slot in slots.items():
-                if isinstance(slot, dict):
-                    names += [f"{pname}.{s}" for s in slot]
+        for pname, slot in self._state["slots"].items():
+            if isinstance(slot, dict):   # slotless optimizers (SGD): None
+                names += [f"{pname}.{s}" for s in slot]
         return names
 
     def state_dict(self):
