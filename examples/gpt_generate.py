@@ -1,0 +1,97 @@
+"""Train-then-generate: a tiny GPT learns a formal language, then decodes
+it back with the kv-cache generate() path (the reference's CacheKV decode,
+fused_attention_op.cc:235, here one jitted step with preallocated caches —
+and the flash decode kernel when running on the TPU).
+
+The language: sequences  BOS a^n b^n EOS  (n in 1..6).  A correct model
+must COUNT — after the a-run it has to emit exactly as many b's — so
+greedy generation proves real sequence modeling, not bigram statistics.
+
+Run: python examples/gpt_generate.py    (~1 min on CPU)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+BOS, A, B, EOS, PAD = 0, 1, 2, 3, 4
+L = 16
+
+
+def make_corpus(n_samples: int, rng):
+    seqs = np.full((n_samples, L), PAD, np.int32)
+    for i in range(n_samples):
+        n = rng.randint(1, 7)
+        s = [BOS] + [A] * n + [B] * n + [EOS]
+        seqs[i, : len(s)] = s
+    return seqs
+
+
+def main():
+    pt.seed(11)
+    cfg = GPTConfig(vocab_size=8, hidden_size=64, num_layers=2,
+                    num_heads=4, ffn_hidden_size=128,
+                    max_position_embeddings=L, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    params = model.trainable_variables()
+    opt = pt.optimizer.AdamW(learning_rate=3e-3)
+    state = opt.init(params)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(make_corpus(256, rng))
+
+    @jax.jit
+    def step(p, s, batch):
+        def loss_fn(p_):
+            # labels == inputs; the model applies the causal shift and
+            # ignores PAD via ignore_index
+            masked = jnp.where(batch == PAD, -100, batch)
+            loss, _ = model.apply(p_, batch, labels=masked)
+            return loss
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        new_p, new_s = opt.apply_gradients(g, p, s)
+        return l, new_p, new_s
+
+    first = last = None
+    for i in range(300):
+        l, params, state = step(params, state, data)
+        first = first if first is not None else float(l)
+        last = float(l)
+    print(f"a^n b^n LM loss: {first:.3f} -> {last:.4f}")
+    # the language has IRREDUCIBLE entropy (n is unpredictable: every
+    # a→{a,b} branch carries information), so loss cannot approach 0;
+    # the deterministic part — counting out the b-run — is what the
+    # decode check below pins exactly
+    assert last < first * 0.3, (first, last)
+
+    # ---- kv-cache greedy decode: the model must COUNT ------------------
+    model.set_state_dict({**model.state_dict(), **params})
+    model.eval()
+    correct = 0
+    for n in range(1, 7):
+        prompt = jnp.asarray([[BOS] + [A] * n + [B]], jnp.int32)
+        out = model.generate(prompt, max_new_tokens=L - prompt.shape[1],
+                             temperature=0.0, eos_token_id=EOS)
+        tail = np.asarray(out)[0, prompt.shape[1]:]
+        want = [B] * (n - 1) + [EOS]
+        got = tail[: len(want)].tolist()
+        ok = got == want
+        correct += ok
+        print(f"  n={n}: continue a^{n} b -> {got} "
+              f"{'OK' if ok else f'(want {want})'}")
+    print(f"counting accuracy: {correct}/6")
+    assert correct >= 5, "the LM must have learned to count"
+    print("gpt_generate example OK")
+
+
+if __name__ == "__main__":
+    main()

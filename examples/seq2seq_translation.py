@@ -9,9 +9,10 @@ beam search must then reproduce held-out translations exactly.
 
 Run: python examples/seq2seq_translation.py  (CPU or TPU; ~1 min on CPU)
 """
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
