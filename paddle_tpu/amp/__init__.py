@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.dtype import convert_dtype
+from ..framework.errors import enforce
 from . import state as _state
 from .state import BLACK_OPS, WHITE_OPS  # noqa: F401
 
@@ -89,6 +90,7 @@ class GradScaler:
         self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
         self.use_dynamic = use_dynamic_loss_scaling
         self._st = self.init_state()
+        self._already_unscaled = False
 
     def is_enable(self) -> bool:
         return self._enable
@@ -142,7 +144,10 @@ class GradScaler:
         return self.scale_value(value, self._st)
 
     def step(self, optimizer, grads=None):
-        """Unscale, check, conditionally step, update the scale."""
+        """Unscale, check, conditionally step, update the scale.  If
+        ``unscale_(optimizer)`` already ran this iteration (the
+        grad-clipping idiom), grads are NOT unscaled a second time —
+        the reference tracks the same per-iteration state."""
         if not self._enable:
             optimizer.step(grads)
             return
@@ -150,12 +155,69 @@ class GradScaler:
             # paddle-canonical scaler.step(optimizer): pull the grads the
             # user attached to the bound parameters so they get unscaled too
             grads = [p._grad for p in optimizer._parameters]
-        unscaled, found_inf = self.unscale_and_check(grads, self._st)
+        if self._already_unscaled:
+            found_inf = jnp.asarray(not all(
+                bool(jnp.all(jnp.isfinite(g))) for g in grads
+                if g is not None))
+            unscaled = grads
+        else:
+            unscaled, found_inf = self.unscale_and_check(grads, self._st)
         if not bool(found_inf):
             optimizer.step(unscaled)
         else:
             optimizer.clear_grad()
         self._st = self.update_state(self._st, found_inf)
+        self._already_unscaled = False
+
+    def unscale_(self, optimizer=None):
+        """Eager-path unscale of the bound optimizer's param grads
+        (reference GradScaler.unscale_, the grad-clip idiom); the
+        following step() will not unscale again.  The jit path uses
+        unscale_and_check."""
+        params = getattr(optimizer, "_parameters", None) or []
+        inv = 1.0 / float(self._st["scale"])
+        for p in params:
+            if getattr(p, "_grad", None) is not None:
+                p._grad = p._grad * inv
+        self._already_unscaled = True
+        return optimizer
+
+    # -- accessor tail (reference amp/grad_scaler.py) ---------------------
+    def is_use_dynamic_loss_scaling(self):
+        return self.use_dynamic
+
+    def get_init_loss_scaling(self):
+        return float(self.init_loss_scaling)
+
+    def set_init_loss_scaling(self, v):
+        self.init_loss_scaling = float(v)
+        self._st = self.init_state()
+
+    def get_incr_ratio(self):
+        return self.incr_ratio
+
+    def set_incr_ratio(self, v):
+        enforce(v > 1.0, "incr_ratio must be > 1")
+        self.incr_ratio = float(v)
+
+    def get_decr_ratio(self):
+        return self.decr_ratio
+
+    def set_decr_ratio(self, v):
+        enforce(0.0 < v < 1.0, "decr_ratio must be in (0, 1)")
+        self.decr_ratio = float(v)
+
+    def get_incr_every_n_steps(self):
+        return self.incr_every_n_steps
+
+    def set_incr_every_n_steps(self, v):
+        self.incr_every_n_steps = int(v)
+
+    def get_decr_every_n_nan_or_inf(self):
+        return self.decr_every_n_nan_or_inf
+
+    def set_decr_every_n_nan_or_inf(self, v):
+        self.decr_every_n_nan_or_inf = int(v)
 
     def minimize(self, optimizer, scaled_loss=None, grads=None):
         self.step(optimizer, grads)

@@ -331,6 +331,35 @@ class DataLoader:
     A device-prefetch thread overlaps jax.device_put with consumption.
     """
 
+    @staticmethod
+    def from_generator(feed_list=None, capacity: int = 10,
+                       use_double_buffer: bool = True, iterable: bool = True,
+                       return_list: bool = True, use_multiprocess: bool = False,
+                       drop_last: bool = True):
+        """Pre-2.0 generator-fed loader (reference
+        DataLoader.from_generator).  The feed-queue knobs (capacity,
+        double buffering, places) have no role in the one-codepath
+        design and are accepted for signature parity only."""
+        return _GeneratorLoader()
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last: bool = True):
+        """Re-iterable loader over a fleet dataset's in-memory records,
+        batched by the dataset's configured batch_size (reference
+        DataLoader.from_dataset)."""
+        recs = getattr(dataset, "_records", None)
+        enforce(recs is not None,
+                "from_dataset expects an InMemoryDataset with "
+                "load_into_memory() called (docs/MIGRATION.md: "
+                "'parameter server')")
+        bs = max(int(getattr(dataset, "_batch_size", 1)), 1)
+
+        def gen():
+            for i in range(0, len(recs) - (bs - 1 if drop_last else 0), bs):
+                yield recs[i:i + bs]
+
+        return _GeneratorLoader().set_batch_generator(gen)
+
     def __init__(self, dataset, feed_list=None, places=None,
                  batch_size: int = 1, shuffle: bool = False,
                  drop_last: bool = False, batch_sampler=None,
@@ -521,6 +550,58 @@ class _DevicePrefetcher:
         if isinstance(item, Exception):
             raise item
         return item
+
+
+def _collate_slots(rows):
+    """[(a0, b0), (a1, b1), ...] → [stack(a), stack(b)] — the reference
+    loader's per-slot batch arrays."""
+    if not rows:
+        return rows
+    first = rows[0]
+    if not isinstance(first, (tuple, list)):
+        return np.stack([np.asarray(r) for r in rows])
+    return [np.stack([np.asarray(r[i]) for r in rows])
+            for i in range(len(first))]
+
+
+class _GeneratorLoader:
+    """Pre-2.0 DataLoader.from_generator facade: set_batch_generator/
+    set_sample_generator feed a python generator; iteration yields its
+    batches (the reference's feed-queue machinery collapses into plain
+    iteration in the one-codepath design).  Re-iterable: the generator
+    function is called afresh per epoch."""
+
+    def __init__(self):
+        self._fn = None
+
+    def set_batch_generator(self, fn, places=None):
+        self._fn = fn
+        return self
+
+    def set_sample_generator(self, fn, batch_size: int = 1, places=None,
+                             drop_last: bool = True):
+        from ..reader import batch as _batch
+        batched = _batch(fn, batch_size, drop_last=drop_last)
+
+        def gen():
+            for rows in batched():
+                yield _collate_slots(list(rows))   # per-slot arrays
+
+        self._fn = gen
+        return self
+
+    def set_sample_list_generator(self, fn, places=None):
+        def gen():
+            for rows in fn():
+                yield _collate_slots(list(rows))
+
+        self._fn = gen
+        return self
+
+    def __iter__(self):
+        enforce(self._fn is not None,
+                "call set_batch_generator/set_sample_generator first")
+        return iter(self._fn())
 
 
 class ChainDataset(IterableDataset):

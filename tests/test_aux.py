@@ -237,3 +237,95 @@ class TestNativeDataLoader:
                             to_device=False)
         with pytest.raises(RuntimeError, match="boom-5"):
             list(loader)
+
+
+class TestScalerAndLoaderCompat:
+    """Round-5: GradScaler accessor tail + pre-2.0 generator loaders."""
+
+    def test_grad_scaler_accessors(self):
+        from paddle_tpu import amp
+        sc = amp.GradScaler()
+        sc.set_incr_ratio(3.0)
+        assert sc.get_incr_ratio() == 3.0
+        with pytest.raises(Exception):
+            sc.set_incr_ratio(0.5)
+        sc.set_decr_ratio(0.25)
+        assert sc.get_decr_ratio() == 0.25
+        sc.set_init_loss_scaling(1024.0)
+        assert sc.get_init_loss_scaling() == 1024.0
+        assert float(sc._st["scale"]) == 1024.0     # state reseeded
+        sc.set_incr_every_n_steps(7)
+        assert sc.get_incr_every_n_steps() == 7
+        sc.set_decr_every_n_nan_or_inf(5)
+        assert sc.get_decr_every_n_nan_or_inf() == 5
+        assert sc.is_use_dynamic_loss_scaling()
+
+    def test_scaler_unscale_(self):
+        from paddle_tpu import amp, nn, optimizer
+        import paddle_tpu as pt
+        pt.seed(0)
+        lin = nn.Linear(2, 2)
+        o = optimizer.SGD(parameters=[p for _, p in lin.named_parameters()])
+        sc = amp.GradScaler(init_loss_scaling=8.0)
+        for p in o._parameters:
+            p._grad = jnp.ones_like(jnp.asarray(p)) * 8.0
+        sc.unscale_(o)
+        for p in o._parameters:
+            np.testing.assert_allclose(np.asarray(p._grad), 1.0)
+
+    def test_unscale_then_step_no_double_unscale(self):
+        """Regression: the grad-clip idiom unscale_ -> step must apply
+        the TRUE gradient, not grad/scale^2."""
+        from paddle_tpu import amp, nn, optimizer
+        import paddle_tpu as pt
+        pt.seed(0)
+        lin = nn.Linear(2, 1)
+        o = optimizer.SGD(learning_rate=1.0,
+                          parameters=[p for _, p in lin.named_parameters()])
+        sc = amp.GradScaler(init_loss_scaling=8.0)
+        w0 = np.asarray(lin.weight.value).copy()
+        for p in o._parameters:
+            p._grad = jnp.ones_like(jnp.asarray(p)) * 8.0
+        sc.unscale_(o)
+        sc.step(o)
+        np.testing.assert_allclose(w0 - np.asarray(lin.weight.value),
+                                   1.0, rtol=1e-6)
+
+    def test_from_generator_batch_and_sample(self):
+        from paddle_tpu.io import DataLoader
+        loader = DataLoader.from_generator(capacity=4)
+        loader.set_batch_generator(lambda: iter([np.ones(2), np.zeros(2)]))
+        assert len(list(loader)) == 2
+
+        def samples():
+            for i in range(5):
+                yield (np.float32(i),)
+
+        loader2 = DataLoader.from_generator().set_sample_generator(
+            samples, batch_size=2)
+        for _ in range(2):                        # re-iterable
+            out = list(loader2)
+            assert len(out) == 2                  # drop_last on 5/2
+            slot0 = out[0][0]                     # per-slot batch arrays
+            assert np.asarray(slot0).shape == (2,)
+
+    def test_from_dataset_requires_loaded_memory(self):
+        from paddle_tpu.io import DataLoader
+        import paddle_tpu.distributed as dist
+        ds = dist.InMemoryDataset()
+        with pytest.raises(Exception, match="load_into_memory"):
+            DataLoader.from_dataset(ds)
+
+    def test_from_dataset_batches_and_reiterates(self, tmp_path):
+        from paddle_tpu.io import DataLoader
+        import paddle_tpu.distributed as dist
+        p = tmp_path / "recs.txt"
+        p.write_text("a\nb\nc\nd\ne\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        loader = DataLoader.from_dataset(ds)
+        for _ in range(2):                        # re-iterable
+            batches = list(loader)
+            assert batches[0] == ["a", "b"] and len(batches) == 2
