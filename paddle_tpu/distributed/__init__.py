@@ -65,13 +65,14 @@ __all__ = [
 ]
 
 from . import launch  # noqa: F401,E402  (reference paddle.distributed.launch)
+from . import utils  # noqa: F401,E402  (launcher plumbing compat)
 from .compat import (ParallelMode, Group, new_group, get_group,  # noqa: F401,E402
                      alltoall, send, recv, wait, gloo_init_parallel_env,
                      gloo_barrier, gloo_release, QueueDataset,
                      InMemoryDataset, CountFilterEntry, ShowClickEntry,
                      ProbabilityEntry)
 
-__all__ += ["launch", "ParallelMode", "Group", "new_group", "get_group",
+__all__ += ["launch", "utils", "ParallelMode", "Group", "new_group", "get_group",
             "alltoall", "send", "recv", "wait", "gloo_init_parallel_env",
             "gloo_barrier", "gloo_release", "QueueDataset",
             "InMemoryDataset", "CountFilterEntry", "ShowClickEntry",

@@ -144,3 +144,20 @@ def group_sharded_parallel(model, optimizer, level: str = "os",
 
     optimizer.init = sharded_init
     return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Persist a group-sharded model (reference sharding
+    save_group_sharded_model: gathers shards and writes whole weights —
+    GSPMD arrays gather on host readback, so plain save does the job)."""
+    import os
+
+    from ..framework.io import save as _save
+    os.makedirs(output, exist_ok=True)           # output is a directory
+    base = os.path.join(output, "model")
+    _save(model.state_dict(), base + ".pdparams")
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        _save(optimizer.state_dict(), base + ".pdopt")
+
+
+__all__.append("save_group_sharded_model")

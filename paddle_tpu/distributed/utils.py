@@ -1,0 +1,342 @@
+"""paddle.distributed.utils compat (reference distributed/utils.py): the
+launcher's cluster model (Cluster/Pod/Trainer), host/port discovery, and
+local-process management — the plumbing custom launch scripts import.
+
+The real bring-up rides jax.distributed (launch/__init__.py); these
+classes model the same topology so ported orchestration code (building a
+Cluster from endpoints, watching trainer procs) runs unchanged."""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["get_host_name_ip", "Trainer", "get_cluster",
+           "start_local_trainers", "watch_local_trainers",
+           "find_free_ports", "JobServer", "Cluster", "Pod", "Hdfs",
+           "add_arguments", "terminate_local_procs", "TrainerProc",
+           "get_logger", "pull_worker_log", "global_scatter",
+           "global_gather"]
+
+
+def get_logger(log_level=20,
+               name: str = "paddle_tpu.distributed") -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(levelname)s %(asctime)s %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+logger = get_logger()
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return None
+
+
+def find_free_ports(num: int) -> Optional[set]:
+    """num locally-free TCP ports (reference find_free_ports)."""
+    out: set = set()
+    attempts = 0
+    while len(out) < num and attempts < 100 * num:
+        attempts += 1
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            out.add(s.getsockname()[1])
+    return out if len(out) == num else None
+
+
+class Hdfs:
+    """HDFS connection descriptor (reference utils.Hdfs) — config only."""
+
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return (self.hdfs_ugi is not None and self.hdfs_name is not None
+                and self.hdfs_path is not None)
+
+    def __eq__(self, other):
+        return (self.hdfs_ugi == other.hdfs_ugi
+                and self.hdfs_name == other.hdfs_name
+                and self.hdfs_path == other.hdfs_path)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __str__(self):
+        return f"hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} " \
+               f"hdfs_path:{self.hdfs_path}"
+
+
+class Trainer:
+    """One trainer endpoint (reference utils.Trainer)."""
+
+    def __init__(self):
+        self.gpus: List[int] = []
+        self.endpoint: Optional[str] = None
+        self.rank: Optional[int] = None
+
+    def __str__(self):
+        return f"gpu:{self.gpus} endpoint:{self.endpoint} rank:{self.rank}"
+
+    def __eq__(self, other):
+        return (self.gpus == other.gpus and self.endpoint == other.endpoint
+                and self.rank == other.rank)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+
+class Pod:
+    """One host's worth of trainers (reference utils.Pod)."""
+
+    def __init__(self):
+        self.rank: Optional[int] = None
+        self.id: Optional[str] = None
+        self.addr: Optional[str] = None
+        self.port: Optional[int] = None
+        self.trainers: List[Trainer] = []
+        self.gpus: List[int] = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} "
+                f"port:{self.port} trainers:{[str(t) for t in self.trainers]}")
+
+    def __eq__(self, other):
+        if (self.rank != other.rank or self.id != other.id
+                or self.addr != other.addr or self.port != other.port
+                or len(self.trainers) != len(other.trainers)):
+            return False
+        return all(a == b for a, b in zip(self.trainers, other.trainers))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def parse_response(self, res_pods):
+        pass
+
+    def rank_str(self):
+        return str(self.rank)
+
+    def get_visible_gpus(self):
+        return ",".join(str(g) for g in self.gpus)
+
+
+class Cluster:
+    """The whole job (reference utils.Cluster)."""
+
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods: List[Pod] = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return f"pods:{[str(p) for p in self.pods]} " \
+               f"job_stage_flag:{self.job_stage_flag}"
+
+    def __eq__(self, other):
+        if len(self.pods) != len(other.pods):
+            return False
+        return all(a == b for a, b in zip(self.pods, other.pods))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def update_pods(self, cluster):
+        self.pods = list(cluster.pods)
+
+    def trainers_nranks(self) -> int:
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self) -> int:
+        return len(self.pods)
+
+    def trainers_endpoints(self) -> List[str]:
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self) -> List[str]:
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def get_pod_by_id(self, pod_id):
+        for p in self.pods:
+            if str(p.id) == str(pod_id):
+                return p
+        return None
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint: Optional[str] = None
+
+    def __str__(self):
+        return str(self.endpoint)
+
+    def __eq__(self, other):
+        return self.endpoint == other.endpoint
+
+    def __ne__(self, other):
+        return not self == other
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode=None,
+                devices_per_proc=None) -> tuple:
+    """Build (Cluster, current Pod) from endpoint lists (reference
+    get_cluster); ``devices_per_proc`` defaults to one device per
+    trainer."""
+    if isinstance(trainer_endpoints[0], str):
+        trainer_endpoints = [[e] for e in trainer_endpoints]
+    cluster = Cluster(hdfs=None)
+    cur_pod = None
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        for i, endpoint in enumerate(trainer_endpoints[node_rank]):
+            trainer = Trainer()
+            trainer.endpoint = endpoint
+            trainer.rank = sum(len(p.trainers) for p in cluster.pods) + i
+            if devices_per_proc is not None and i < len(devices_per_proc):
+                d = devices_per_proc[i]
+                trainer.gpus = list(d) if isinstance(d, (list, tuple)) \
+                    else [d]
+            pod.trainers.append(trainer)
+        cluster.pods.append(pod)
+        if ip == node_ip:
+            cur_pod = pod
+    return cluster, cur_pod
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
+                         training_script_args, log_dir=None,
+                         envs=None) -> List[TrainerProc]:
+    """Spawn one python process per trainer in ``pod`` with the PADDLE_*
+    env contract (reference start_local_trainers)."""
+    procs = []
+    current_env = {k: v for k, v in os.environ.items()
+                   if k not in ("http_proxy", "https_proxy")}
+    if envs:
+        current_env.update(envs)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for idx, t in enumerate(pod.trainers):
+        proc_env = {
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_CURRENT_ENDPOINT": str(t.endpoint),
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                cluster.trainers_endpoints()),
+        }
+        env = dict(current_env)
+        env.update(proc_env)
+        cmd = [sys.executable, "-u", training_script] + list(
+            training_script_args)
+        fn = None
+        if log_dir:
+            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+        proc = subprocess.Popen(cmd, env=env, stdout=fn or None,
+                                stderr=fn or None)
+        tp = TrainerProc()
+        tp.proc = proc
+        tp.rank = t.rank
+        tp.local_rank = idx
+        tp.log_fn = fn
+        tp.log_offset = fn.tell() if fn else None
+        tp.cmd = cmd
+        procs.append(tp)
+    return procs
+
+
+def pull_worker_log(tp: TrainerProc):
+    if tp.log_fn is None:
+        return
+    with open(tp.log_fn.name) as fin:
+        fin.seek(tp.log_offset, 0)
+        for line in fin:
+            try:
+                sys.stdout.write(line)
+            except UnicodeEncodeError:
+                pass
+        tp.log_offset = fin.tell()
+
+
+def watch_local_trainers(procs: List[TrainerProc],
+                         nranks: int) -> List[TrainerProc]:
+    """Poll trainer procs; a failed proc terminates the rest (reference
+    watch_local_trainers fail-fast doctrine)."""
+    alive = []
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            logger.error(f"trainer rank {tp.rank} exited with {ret}; "
+                         "aborting the pod")
+            terminate_local_procs(procs)
+            raise subprocess.SubprocessError(
+                f"trainer {tp.rank} failed (exit {ret})")
+    return alive
+
+
+def terminate_local_procs(procs: List[TrainerProc]) -> None:
+    for tp in procs:
+        if tp.proc is not None and tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + 10
+    for tp in procs:
+        if tp.proc is not None:
+            try:
+                tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                tp.proc.kill()
+        if tp.log_fn:
+            tp.log_fn.close()
+
+
+def add_arguments(argname: str, type, default, help, argparser):  # noqa: A002
+    """argparse helper (reference utils.add_arguments)."""
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + f" Default: {default}.")
+
+
+# MoE all-to-all dispatch entry points (the reference exports them from
+# distributed.utils as well as incubate; same shard_map collectives)
+def global_scatter(*args, **kwargs):
+    from .moe import global_scatter as _gs
+    return _gs(*args, **kwargs)
+
+
+def global_gather(*args, **kwargs):
+    from .moe import global_gather as _gg
+    return _gg(*args, **kwargs)

@@ -230,6 +230,660 @@ class nn:
         out = layer(input)
         return getattr(F, act)(out) if act else out
 
+    # -- conv / norm family (reference static/nn/common.py), all program-
+    # -- cached like fc/embedding/batch_norm above -------------------------
+    @staticmethod
+    def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,  # noqa: A002
+               dilation=1, groups: int = 1, param_attr=None, bias_attr=None,
+               act=None, data_format: str = "NCHW", name=None):
+        from ..nn import functional as F
+        from ..nn.layers import Conv2D
+
+        cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+        k = filter_size if isinstance(filter_size, int) else tuple(filter_size)
+        layer = nn._layer("conv2d", name, lambda: Conv2D(
+            cin, num_filters, k, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format))
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def conv3d(input, num_filters: int, filter_size, stride=1, padding=0,  # noqa: A002
+               dilation=1, groups: int = 1, param_attr=None, bias_attr=None,
+               act=None, data_format: str = "NCDHW", name=None):
+        from ..nn import functional as F
+        from ..nn.layers import Conv3D
+
+        cin = input.shape[1]
+        layer = nn._layer("conv3d", name, lambda: Conv3D(
+            cin, num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr))
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def _transpose_kernel(in_sp, output_size, stride, padding, dilation,
+                          nd):
+        """Derive the kernel from output_size (reference semantics when
+        filter_size is omitted): out = (in-1)*s - 2*p + d*(k-1) + 1."""
+        def tup(v):
+            return (v,) * nd if isinstance(v, int) else tuple(v)
+        out = tup(output_size)
+        s_, p_, d_ = tup(stride), tup(padding), tup(dilation)
+        k = []
+        for i in range(nd):
+            num = out[i] - (in_sp[i] - 1) * s_[i] + 2 * p_[i] - 1
+            enforce(num % d_[i] == 0 and num // d_[i] + 1 >= 1,
+                    f"output_size {out[i]} unreachable from input "
+                    f"{in_sp[i]} with stride {s_[i]} padding {p_[i]}")
+            k.append(num // d_[i] + 1)
+        return tuple(k)
+
+    @staticmethod
+    def conv2d_transpose(input, num_filters: int, filter_size=None,  # noqa: A002
+                         output_size=None, stride=1, padding=0, dilation=1,
+                         groups: int = 1, param_attr=None, bias_attr=None,
+                         act=None, data_format: str = "NCHW", name=None):
+        from ..nn import functional as F
+        from ..nn.layers import Conv2DTranspose
+
+        cin = input.shape[1]
+        if filter_size is None:
+            enforce(output_size is not None,
+                    "conv2d_transpose needs filter_size or output_size")
+            filter_size = nn._transpose_kernel(
+                input.shape[2:], output_size, stride, padding, dilation, 2)
+        layer = nn._layer("conv2d_transpose", name, lambda: Conv2DTranspose(
+            cin, num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr))
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def conv3d_transpose(input, num_filters: int, filter_size=None,  # noqa: A002
+                         output_size=None, stride=1, padding=0, dilation=1,
+                         groups: int = 1, param_attr=None, bias_attr=None,
+                         act=None, data_format: str = "NCDHW", name=None):
+        from ..nn import functional as F
+        from ..nn.layers_ext import Conv3DTranspose
+
+        cin = input.shape[1]
+        if filter_size is None:
+            enforce(output_size is not None,
+                    "conv3d_transpose needs filter_size or output_size")
+            filter_size = nn._transpose_kernel(
+                input.shape[2:], output_size, stride, padding, dilation, 3)
+        layer = nn._layer("conv3d_transpose", name, lambda: Conv3DTranspose(
+            cin, num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr))
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def deform_conv2d(input, offset, mask, num_filters: int, filter_size,  # noqa: A002
+                      stride=1, padding=0, dilation=1, groups: int = 1,
+                      deformable_groups: int = 1, im2col_step: int = 1,
+                      param_attr=None, bias_attr=None, name=None):
+        from .. import create_parameter
+        from ..vision.ops import deform_conv2d as _dc
+
+        cin = input.shape[1]
+        k = (filter_size, filter_size) if isinstance(filter_size, int) \
+            else tuple(filter_size)
+
+        def build():
+            w = create_parameter([num_filters, cin // groups, *k],
+                                 "float32", attr=param_attr)
+            b = None if bias_attr is False else create_parameter(
+                [num_filters], "float32", attr=bias_attr, is_bias=True)
+            return (w, b)
+
+        w, b = nn._layer("deform_conv2d", name, build)
+        return _dc(input, offset, w.value, bias=(None if b is None
+                                                 else b.value),
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, deformable_groups=deformable_groups,
+                   mask=mask)
+
+    @staticmethod
+    def layer_norm(input, scale: bool = True, shift: bool = True,  # noqa: A002
+                   begin_norm_axis: int = 1, epsilon: float = 1e-5,
+                   param_attr=None, bias_attr=None, act=None, name=None):
+        from ..nn import functional as F
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)
+        shape = x.shape[begin_norm_axis:]
+
+        def build():
+            from .. import create_parameter
+            from ..nn.initializer import Constant
+            w = create_parameter(list(shape), "float32", attr=param_attr,
+                                 default_initializer=Constant(1.0)) \
+                if scale else None
+            b = create_parameter(list(shape), "float32", attr=bias_attr,
+                                 is_bias=True) if shift else None
+            return (w, b)
+
+        w, b = nn._layer("layer_norm", name, build)
+        out = F.layer_norm(x, shape, None if w is None else w.value,
+                           None if b is None else b.value, epsilon)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def group_norm(input, groups: int, epsilon: float = 1e-5,  # noqa: A002
+                   param_attr=None, bias_attr=None, act=None,
+                   data_layout: str = "NCHW", name=None):
+        from ..nn import functional as F
+        from ..nn.layers import GroupNorm
+
+        enforce(data_layout == "NCHW",
+                "static.nn.group_norm supports NCHW (the functional "
+                "group_norm is channel-first)")
+        c = input.shape[1]
+        layer = nn._layer("group_norm", name, lambda: GroupNorm(
+            groups, c, epsilon=epsilon, weight_attr=param_attr,
+            bias_attr=bias_attr))
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def instance_norm(input, epsilon: float = 1e-5, param_attr=None,  # noqa: A002
+                      bias_attr=None, name=None):
+        from ..nn.layers import InstanceNorm2D
+
+        c = input.shape[1]
+        layer = nn._layer("instance_norm", name, lambda: InstanceNorm2D(
+            c, epsilon=epsilon, weight_attr=param_attr,
+            bias_attr=bias_attr))
+        return layer(input)
+
+    @staticmethod
+    def data_norm(input, act=None, epsilon: float = 1e-5, param_attr=None,  # noqa: A002
+                  name=None, **kw):
+        """Reference data_norm: normalize by GLOBAL running statistics
+        (batch_sum/batch_square_sum/batch_size accumulators updated per
+        call — never the current batch's own stats)."""
+        import jax.numpy as jnp
+        from ..nn import functional as F
+
+        x = jnp.asarray(input)
+        c = x.shape[1]
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+
+        class _DataNorm:
+            def __init__(self):
+                self.size = jnp.full((c,), 1e4)         # reference init
+                self.sum = jnp.zeros((c,))
+                self.square_sum = jnp.full((c,), 1e4)
+
+        st_ = nn._layer("data_norm", name, _DataNorm)
+        n_new = x.size // c
+        st_.size = st_.size + n_new
+        st_.sum = st_.sum + jnp.sum(x, axis=axes)
+        st_.square_sum = st_.square_sum + jnp.sum(jnp.square(x), axis=axes)
+        mean = st_.sum / st_.size
+        var = st_.square_sum / st_.size - jnp.square(mean)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = (x - mean.reshape(shape)) / jnp.sqrt(
+            var.reshape(shape) + epsilon)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def prelu(x, mode: str = "all", param_attr=None, name=None):
+        from ..nn.layers import PReLU
+
+        num = 1 if mode == "all" else x.shape[1]
+        layer = nn._layer("prelu", name, lambda: PReLU(
+            num_parameters=num, weight_attr=param_attr))
+        return layer(x)
+
+    @staticmethod
+    def spectral_norm(weight, dim: int = 0, power_iters: int = 1,
+                      eps: float = 1e-12, name=None):
+        from ..nn.layers import SpectralNorm
+
+        layer = nn._layer("spectral_norm", name, lambda: SpectralNorm(
+            list(weight.shape), dim=dim, power_iters=power_iters,
+            epsilon=eps))
+        return layer(weight)
+
+    @staticmethod
+    def bilinear_tensor_product(x, y, size: int, act=None, name=None,
+                                param_attr=None, bias_attr=None):
+        from ..nn import functional as F
+        from ..nn.layers_ext import Bilinear
+
+        layer = nn._layer("bilinear_tensor_product", name, lambda: Bilinear(
+            x.shape[-1], y.shape[-1], size, weight_attr=param_attr,
+            bias_attr=bias_attr))
+        out = layer(x, y)
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def row_conv(input, future_context_size: int, param_attr=None,  # noqa: A002
+                 act=None):
+        """Lookahead row convolution (reference row_conv_op): each step
+        mixes the next ``future_context_size`` steps per feature."""
+        from .. import create_parameter
+        from ..nn import functional as F
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)                    # (B, T, D)
+        d = x.shape[-1]
+        k = future_context_size + 1
+        w = nn._layer("row_conv", None, lambda: create_parameter(
+            [k, d], "float32", attr=param_attr))
+        pad = jnp.pad(x, ((0, 0), (0, future_context_size), (0, 0)))
+        out = sum(pad[:, i:i + x.shape[1], :] * w.value[i][None, None, :]
+                  for i in range(k))
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def nce(input, label, num_total_classes: int, num_neg_samples: int = 10,  # noqa: A002
+            param_attr=None, bias_attr=None, name=None, sample_weight=None,
+            sampler: str = "uniform", custom_dist=None, seed: int = 0,
+            is_sparse: bool = False):
+        """Noise-contrastive estimation loss (reference nce_op): one
+        positive + k uniform negatives per row, logistic losses."""
+        from .. import create_parameter
+        import jax
+        import jax.numpy as jnp
+        from ..framework import random as fw_random
+
+        x = jnp.asarray(input)                    # (B, D)
+        y = jnp.asarray(label).reshape(-1)        # (B,)
+        d = x.shape[-1]
+
+        def build():
+            w = create_parameter([num_total_classes, d], "float32",
+                                 attr=param_attr)
+            b = create_parameter([num_total_classes], "float32",
+                                 is_bias=True, attr=bias_attr)
+            return (w, b)
+
+        w, b = nn._layer("nce", name, build)
+        key = fw_random.op_key()
+        neg = jax.random.randint(key, (x.shape[0], num_neg_samples), 0,
+                                 num_total_classes)
+        pos_logit = jnp.einsum("bd,bd->b", x, w.value[y]) + b.value[y]
+        neg_logit = jnp.einsum("bd,bkd->bk", x, w.value[neg]) \
+            + b.value[neg]
+        loss = -jax.nn.log_sigmoid(pos_logit) \
+            - jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=1)
+        return loss[:, None]
+
+    @staticmethod
+    def sparse_embedding(input, size, padding_idx=None, param_attr=None,  # noqa: A002
+                         is_test: bool = False, name=None, **kw):
+        """Reference sparse_embedding: the PS distributed lookup table —
+        here a plain embedding (SURVEY A11: no parameter server; the
+        lookup semantics are identical)."""
+        return nn.embedding(input, size, padding_idx=padding_idx,
+                            param_attr=param_attr, name=name)
+
+    @staticmethod
+    def crf_decoding(input, param_attr=None, label=None, length=None,  # noqa: A002
+                     name=None):
+        """Viterbi decode with a program-owned transition matrix
+        (reference crf_decoding op; the text.viterbi_decode engine)."""
+        from .. import create_parameter
+        from ..text import viterbi_decode
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)
+        n = x.shape[-1]
+        trans = nn._layer("crf_decoding", name, lambda: create_parameter(
+            [n + 2, n], "float32", attr=param_attr))
+        # reference layout: rows 0/1 of the (n+2, n) matrix are start/stop
+        # scores; here map onto the BOS/EOS convention of viterbi_decode
+        full = jnp.zeros((n + 2, n + 2), jnp.float32)
+        full = full.at[:n, :n].set(trans.value[2:])
+        full = full.at[n, :n].set(trans.value[0])      # BOS row
+        full = full.at[:n, n + 1].set(trans.value[1])  # EOS column
+        scores, path = viterbi_decode(
+            jnp.pad(x, ((0, 0), (0, 0), (0, 2)), constant_values=-1e4),
+            full, lengths=length, include_bos_eos_tag=True)
+        return path
+
+    # -- control flow (reference static/nn/control_flow.py): direct lax --
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None):
+        import jax
+
+        return jax.lax.cond(pred, true_fn or (lambda: None),
+                            false_fn or (lambda: None))
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test: bool = False, name=None):
+        import jax
+
+        out = jax.lax.while_loop(lambda vs: cond(*vs),
+                                 lambda vs: tuple(body(*vs)),
+                                 tuple(loop_vars))
+        return list(out)
+
+    @staticmethod
+    def case(pred_fn_pairs, default=None, name=None):
+        """First-true-wins dispatch (reference control_flow.case).
+        Predicates may be traced; all branches must return matching
+        structures (the lax.cond contract)."""
+        import jax
+
+        out = default() if default is not None else None
+        for pred, fn in reversed(list(pred_fn_pairs)):
+            prev = out
+            if prev is None:
+                out = fn()
+            else:
+                out = jax.lax.cond(pred, fn, lambda p=prev: p)
+        return out
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        import jax
+
+        import jax.numpy as jnp
+
+        if isinstance(branch_fns, dict):
+            keys = sorted(branch_fns)
+            fns = [branch_fns[k] for k in keys]
+            bi = jnp.asarray(branch_index)
+            karr = jnp.asarray(keys)
+            hit = bi == karr
+            # EXACT key match; anything else runs the default (reference
+            # semantics) — or the last branch if none was given
+            match = jnp.sum(jnp.where(hit, jnp.arange(len(keys)), 0))
+            if default is not None:
+                fns = fns + [default]
+                idx = jnp.where(jnp.any(hit), match, len(keys))
+            else:
+                idx = jnp.where(jnp.any(hit), match, len(keys) - 1)
+        else:
+            fns = list(branch_fns)
+            if default is not None:
+                fns = fns + [default]
+            idx = jnp.clip(jnp.asarray(branch_index), 0, len(fns) - 1)
+        return jax.lax.switch(idx, fns)
+
+    @staticmethod
+    def py_func(func, x, out, backward_func=None,
+                skip_vars_in_backward_input=None):
+        return py_func(func, x, out, backward_func,
+                       skip_vars_in_backward_input)
+
+    # -- LoD sequence family (reference static/nn/sequence_lod.py).  The
+    # -- TPU rendering of LoD: a PADDED batch plus a lengths vector (the
+    # -- sequence_mask convention); every op documents that contract. ----
+    @staticmethod
+    def _mask(x, length):
+        import jax.numpy as jnp
+
+        T = x.shape[1]
+        return (jnp.arange(T)[None, :] < jnp.asarray(length)[:, None])
+
+    @staticmethod
+    def sequence_softmax(input, length=None, use_cudnn=False, name=None):  # noqa: A002
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)                    # (B, T)
+        if length is None:
+            return jax.nn.softmax(x, axis=1)
+        m = nn._mask(x, length)
+        return jax.nn.softmax(jnp.where(m, x, -1e30), axis=1) * m
+
+    @staticmethod
+    def sequence_pool(input, pool_type: str, length=None, is_test=False,  # noqa: A002
+                      pad_value: float = 0.0):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)                    # (B, T, D) or (B, T)
+        if length is None:
+            length = jnp.full((x.shape[0],), x.shape[1])
+        m = nn._mask(x, length)
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        cnt = jnp.maximum(jnp.asarray(length), 1).astype(x.dtype)
+        shaped = cnt.reshape((-1,) + (1,) * (x.ndim - 2))
+        pt = pool_type.lower()
+        if pt == "sum":
+            out = jnp.sum(jnp.where(m, x, 0), axis=1)
+        elif pt == "average":
+            out = jnp.sum(jnp.where(m, x, 0), axis=1) / shaped
+        elif pt == "sqrt":
+            out = jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(shaped)
+        elif pt == "max":
+            out = jnp.max(jnp.where(m, x, -jnp.inf), axis=1)
+        elif pt == "last":
+            idx = (jnp.asarray(length) - 1).astype(jnp.int32)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            ).squeeze(1)
+        elif pt == "first":
+            out = x[:, 0]
+        else:
+            enforce(False, f"unknown pool_type {pool_type!r}")
+        empty = (jnp.asarray(length) == 0).reshape(
+            (-1,) + (1,) * (out.ndim - 1))
+        return jnp.where(empty, pad_value, out)
+
+    @staticmethod
+    def sequence_first_step(input, length=None):  # noqa: A002
+        return nn.sequence_pool(input, "first", length)
+
+    @staticmethod
+    def sequence_last_step(input, length=None):  # noqa: A002
+        return nn.sequence_pool(input, "last", length)
+
+    @staticmethod
+    def sequence_conv(input, num_filters: int, filter_size: int = 3,  # noqa: A002
+                      filter_stride: int = 1, padding: bool = True,
+                      padding_start=None, param_attr=None, bias_attr=None,
+                      act=None, name=None):
+        """Context-window convolution over the time axis (reference
+        sequence_conv_op): ``filter_size`` steps starting at
+        ``padding_start`` (default -(size-1)//2) feed one projection."""
+        from .. import create_parameter
+        from ..nn import functional as F
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)                    # (B, T, D)
+        d = x.shape[-1]
+        start = padding_start if padding_start is not None \
+            else -((filter_size - 1) // 2)
+
+        def build():
+            w = create_parameter([filter_size * d, num_filters], "float32",
+                                 attr=param_attr)
+            b = None if bias_attr is False else create_parameter(
+                [num_filters], "float32", is_bias=True, attr=bias_attr)
+            return (w, b)
+
+        w, b = nn._layer("sequence_conv", name, build)
+        ctx = []
+        T = x.shape[1]
+        for i in range(filter_size):
+            off = start + i
+            sl = jnp.roll(x, -off, axis=1)
+            idx = jnp.arange(T) + off
+            valid = ((idx >= 0) & (idx < T))[None, :, None]
+            ctx.append(jnp.where(valid, sl, 0))
+        ctx = jnp.concatenate(ctx, axis=-1)       # (B, T, k*D)
+        out = ctx @ w.value
+        if b is not None:
+            out = out + b.value
+        return getattr(F, act)(out) if act else out
+
+    @staticmethod
+    def sequence_concat(input, name=None):  # noqa: A002
+        import jax.numpy as jnp
+
+        return jnp.concatenate([jnp.asarray(x) for x in input], axis=1)
+
+    @staticmethod
+    def sequence_slice(input, offset, length, name=None):  # noqa: A002
+        """Per-row slice [offset, offset+length) along time (reference
+        sequence_slice_op); ``length`` must be uniform (static shapes)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)
+        off = jnp.asarray(offset).reshape(-1)
+        ln = jnp.asarray(length).reshape(-1)
+        L = int(ln[0])
+        idx = off[:, None] + jnp.arange(L)[None, :]
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(
+                jnp.int32), axis=1)
+
+    @staticmethod
+    def sequence_expand(x, y, ref_level: int = -1, name=None):
+        """Tile each row of x ``n`` times where n comes from y's lengths
+        (reference sequence_expand; uniform repeat under static shapes)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        n = jnp.asarray(y).shape[1] if hasattr(y, "shape") else int(y)
+        return jnp.repeat(x, n, axis=0)
+
+    @staticmethod
+    def sequence_expand_as(x, y, name=None):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        reps = jnp.asarray(y).shape[0] // x.shape[0]
+        return jnp.repeat(x, reps, axis=0)
+
+    @staticmethod
+    def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+        """Pad a (B, T, ...) batch out to ``maxlen`` steps; returns
+        (padded, lengths) like the reference."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        T = x.shape[1]
+        if length is None:
+            length = jnp.full((x.shape[0],), T, jnp.int32)
+        tgt = maxlen or T
+        pad = [(0, 0), (0, max(0, tgt - T))] + [(0, 0)] * (x.ndim - 2)
+        out = jnp.pad(x, pad, constant_values=pad_value)[:, :tgt]
+        m = nn._mask(out, length)
+        while m.ndim < out.ndim:
+            m = m[..., None]
+        out = jnp.where(m, out, pad_value)
+        return out, jnp.asarray(length)
+
+    @staticmethod
+    def sequence_unpad(x, length, name=None):
+        """Trim to the max real length and zero the padding (the padded-
+        batch rendering of unpad; per-row ragged output needs host
+        lists)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        m = nn._mask(x, length)
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        return jnp.where(m, x, 0)
+
+    @staticmethod
+    def sequence_reshape(input, new_dim: int, name=None):  # noqa: A002
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)
+        return x.reshape(x.shape[0], -1, new_dim)
+
+    @staticmethod
+    def sequence_reverse(x, length=None, name=None):
+        """Reverse each row's REAL prefix, keeping padding in place
+        (reference sequence_reverse_op)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        T = x.shape[1]
+        if length is None:
+            return jnp.flip(x, axis=1)
+        ln = jnp.asarray(length).reshape(-1, 1)
+        t = jnp.arange(T)[None, :]
+        src = jnp.where(t < ln, ln - 1 - t, t).astype(jnp.int32)
+        return jnp.take_along_axis(
+            x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+    @staticmethod
+    def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)
+        idx = jnp.asarray(index)
+        upd = jnp.asarray(updates)
+        b = jnp.arange(x.shape[0])[:, None] * jnp.ones_like(idx)
+        return x.at[b, idx].add(upd)
+
+    @staticmethod
+    def sequence_enumerate(input, win_size: int, pad_value: int = 0,  # noqa: A002
+                           name=None):
+        """Sliding windows of ids (reference sequence_enumerate_op):
+        (B, T) → (B, T, win_size), tail windows padded."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(input)
+        T = x.shape[1]
+        cols = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+        valid = cols < T
+        g = jnp.take(x, jnp.minimum(cols, T - 1), axis=1)
+        return jnp.where(valid[None], g, pad_value)
+
+    @staticmethod
+    def multi_box_head(inputs, image, num_classes: int, base_size=None,
+                       aspect_ratios=None, min_ratio=None, max_ratio=None,
+                       min_sizes=None, max_sizes=None, **kw):
+        """SSD multi-box head (reference multi_box_head): per-feature-map
+        conv heads predicting box deltas + class scores over generated
+        prior boxes.  Minimal faithful rendering: one 3x3 conv pair per
+        input map; priors on the map's grid."""
+        from ..nn.layers import Conv2D
+        import jax.numpy as jnp
+
+        aspect_ratios = aspect_ratios or [[1.0]] * len(inputs)
+        locs, confs, boxes = [], [], []
+        for i, feat in enumerate(inputs):
+            pr = len(aspect_ratios[i]) + 1
+            c = feat.shape[1]
+            loc_l = nn._layer(f"mbox_loc_{i}", None, lambda c=c, pr=pr:
+                              Conv2D(c, pr * 4, 3, padding=1))
+            conf_l = nn._layer(f"mbox_conf_{i}", None,
+                               lambda c=c, pr=pr: Conv2D(
+                                   c, pr * num_classes, 3, padding=1))
+            n, _, h, w = feat.shape
+            locs.append(jnp.transpose(loc_l(feat), (0, 2, 3, 1)
+                                      ).reshape(n, -1, 4))
+            confs.append(jnp.transpose(conf_l(feat), (0, 2, 3, 1)
+                                       ).reshape(n, -1, num_classes))
+            ys, xs = jnp.meshgrid(
+                (jnp.arange(h) + 0.5) / h, (jnp.arange(w) + 0.5) / w,
+                indexing="ij")
+            s = 1.0 / (2 ** i * 2)
+            # LOCATION-major, prior-minor — the same (cell, prior) order
+            # the NHWC-reshaped conv heads emit, so locs[i] pairs with
+            # prior[i]
+            per_cell = []
+            for r in [1.0] + list(aspect_ratios[i]):
+                bw, bh = s * (r ** 0.5), s / (r ** 0.5)
+                per_cell.append(jnp.stack(
+                    [xs - bw / 2, ys - bh / 2, xs + bw / 2, ys + bh / 2],
+                    axis=-1))                      # (h, w, 4)
+            boxes.append(jnp.stack(per_cell, axis=2).reshape(-1, 4))
+        prior = jnp.concatenate(boxes, axis=0)
+        var = jnp.broadcast_to(jnp.asarray([0.1, 0.1, 0.2, 0.2]),
+                               prior.shape)
+        return (jnp.concatenate(locs, axis=1),
+                jnp.concatenate(confs, axis=1), prior, var)
+
+
 
 # ---------------------------------------------------------------------------
 # Static long-tail surface (reference static/__init__.py __all__ parity).

@@ -9,10 +9,11 @@ import importlib
 import warnings
 
 from . import cpp_extension  # noqa: F401
+from . import download  # noqa: F401
 from . import unique_name  # noqa: F401
 
 __all__ = ["deprecated", "try_import", "run_check", "cpp_extension",
-           "unique_name"]
+           "unique_name", "download"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = ""):

@@ -107,3 +107,37 @@ def custom_op(lib: ctypes.CDLL, symbol: str, dtype=np.float32) -> Callable:
 
     op.__name__ = symbol
     return op
+
+
+def CppExtension(sources, **kwargs):
+    """setuptools Extension factory (reference cpp_extension.CppExtension):
+    the ahead-of-time build path next to the JIT ``load``.  Extension
+    options go by keyword (include_dirs=..., extra_compile_args=...)."""
+    from setuptools import Extension
+    name = kwargs.pop("name", "paddle_tpu_ext")
+    kwargs.setdefault("language", "c++")
+    return Extension(name, sources=list(sources), **kwargs)
+
+
+def CUDAExtension(sources, **kwargs):
+    """Reference CUDAExtension: CUDA does not exist on this stack — the
+    host-side C++ parts still build (CppExtension); .cu sources raise
+    with the Pallas recipe (docs/MIGRATION.md: custom ops)."""
+    cu = [s for s in sources if str(s).endswith((".cu", ".cuh"))]
+    if cu:
+        raise RuntimeError(
+            f"CUDA sources {cu} cannot build here: device kernels are "
+            "Pallas on TPU (docs/MIGRATION.md 'custom ops'); host-side "
+            "C++ goes through CppExtension/load")
+    return CppExtension(sources, **kwargs)
+
+
+def setup(**attrs):
+    """Reference cpp_extension.setup: setuptools.setup preconfigured for
+    the extension build (the AOT twin of ``load``)."""
+    import setuptools
+    attrs.setdefault("ext_modules", [])
+    return setuptools.setup(**attrs)
+
+
+__all__ += ["CppExtension", "CUDAExtension", "setup"]

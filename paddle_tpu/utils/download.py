@@ -1,0 +1,41 @@
+"""paddle.utils.download analog (reference utils/download.py).
+
+Zero-egress environment: URLs are served from the local cache only
+(the paddle_tpu.hub gating pattern) — a cached file is returned, a
+missing one raises with the provenance recipe instead of silently
+fetching."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def _md5check(path: str, md5sum: str) -> bool:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    """Resolve a weights URL to a local path (reference
+    get_weights_path_from_url).  Looks up the basename under
+    WEIGHTS_HOME; this environment has no egress, so an uncached file is
+    an error pointing at the cache location rather than a download."""
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        if md5sum and not _md5check(path, md5sum):
+            raise RuntimeError(
+                f"cached weights {path} fail the md5 check ({md5sum}); "
+                "remove the file and re-provision it")
+        return path
+    raise RuntimeError(
+        f"no network egress in this environment: provision {fname} "
+        f"under {WEIGHTS_HOME} (from {url}) before calling "
+        "get_weights_path_from_url")

@@ -51,7 +51,31 @@ PAIRS = [
     ("distribution.transform", R + "distribution/transform.py",
      "paddle_tpu.distribution"),
     ("nn.utils", R + "nn/utils/__init__.py", "paddle_tpu.nn.utils"),
+    ("distributed.sharding", R + "distributed/sharding/__init__.py",
+     "paddle_tpu.distributed.sharding"),
+    ("distributed.utils", R + "distributed/utils.py",
+     "paddle_tpu.distributed.utils"),
+    ("utils.cpp_extension", R + "utils/cpp_extension/__init__.py",
+     "paddle_tpu.utils.cpp_extension"),
+    ("utils.unique_name", R + "utils/unique_name.py",
+     "paddle_tpu.utils.unique_name"),
+    ("utils.download", R + "utils/download.py",
+     "paddle_tpu.utils.download"),
 ]
+
+
+STATIC_NN_REF = R + "static/nn/__init__.py"
+
+
+@pytest.mark.quick
+@pytest.mark.skipif(not os.path.exists(R), reason="reference not present")
+def test_static_nn_namespace_parity():
+    """static.nn is a class namespace, not a module — checked apart."""
+    import paddle_tpu.static as st
+    names = _ref_all(STATIC_NN_REF)
+    assert names
+    missing = [n for n in names if not hasattr(st.nn, n)]
+    assert not missing, missing
 
 
 def _ref_all(path):
