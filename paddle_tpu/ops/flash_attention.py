@@ -10,9 +10,10 @@ online softmax over KV blocks keeps everything in VMEM (the whole point of a
 TPU-native rewrite: HBM bandwidth is the bottleneck, SURVEY §7 hard-part 2).
 
 Layout: q, k, v are (batch, heads, seq, head_dim), flattened to
-(batch*heads, seq, head_dim) for the kernel; grid = (batch*heads, q blocks);
-each program streams this head's KV blocks with `fori_loop`, carrying the
-running max/denominator (m, l) in fp32 — the standard flash recurrence.
+(batch*heads, seq, head_dim) for the kernel; grid = (batch*heads, q block,
+kv block) with kv innermost — the flash (m, l, acc) recurrence lives in
+VMEM scratch across the kv steps, in fp32, so per-step residency is
+O(block) and sequence length is HBM-bound (S=65536 runs single-chip).
 Backward is recompute-based (no probability tensor saved): a dkdv kernel on a
 (bh, kv block, q block) grid accumulating into revisited f32 output blocks,
 and a dq kernel over Q blocks, both replaying p = exp(qk - lse).  Backward
