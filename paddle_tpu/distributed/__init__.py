@@ -29,7 +29,8 @@ from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         VocabParallelEmbedding, shard_constraint,
                         param_sharding, variables_sharding)
 from .checkpoint import (save_sharded, load_sharded,  # noqa: F401
-                         AsyncSaveHandle)
+                         verify_sharded, AsyncSaveHandle,
+                         CheckpointCorruption)
 from .moe import (MoELayer, ExpertFFN, global_scatter,  # noqa: F401
                   global_gather, limit_by_capacity, switch_gating,
                   gshard_gating, collect_aux_losses)
@@ -51,7 +52,8 @@ __all__ = [
     "broadcast", "p2p_push", "reduce", "reduce_scatter", "scatter",
     "send_recv_permute", "split", "ColumnParallelLinear", "RowParallelLinear",
     "VocabParallelEmbedding", "shard_constraint", "param_sharding",
-    "variables_sharding", "save_sharded", "load_sharded", "AsyncSaveHandle",
+    "variables_sharding", "save_sharded", "load_sharded", "verify_sharded",
+    "AsyncSaveHandle", "CheckpointCorruption",
     "MoELayer", "ExpertFFN", "global_scatter",
     "global_gather", "limit_by_capacity", "switch_gating", "gshard_gating",
     "collect_aux_losses", "parallel_cross_entropy", "parallel_log_softmax",

@@ -23,12 +23,23 @@ TPU-native design (tensorstore/orbax-style, self-contained):
 - ``save_sharded(..., use_async=True)`` returns immediately and flushes
   device-to-host copies + file writes on a background thread (async
   checkpointing for the elastic/preemption path).
+
+Resilience (manifest **v2**, ISSUE 1): every shard entry additionally
+records the CRC32 and byte size of its ``.npy`` file; all durable writes go
+through the retry-wrapped ``utils.fsio`` seam (fsync'd, fault-injectable);
+``load_sharded`` verifies existence/size/CRC of every referenced shard
+before materializing anything and raises :class:`CheckpointCorruption`
+(``strict=False`` demotes that to a warning).  v1 manifests (no checksums)
+still load — the verification pass is skipped with a warning.
 """
 from __future__ import annotations
 
+import io as _io
 import json
 import os
 import threading
+import warnings
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -37,10 +48,25 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.errors import enforce
+from ..framework.log import vlog
+from ..utils import fsio
+from ..utils.retry import RetryPolicy, retry_call
 
-__all__ = ["save_sharded", "load_sharded", "AsyncSaveHandle"]
+__all__ = ["save_sharded", "load_sharded", "verify_sharded",
+           "AsyncSaveHandle", "CheckpointCorruption"]
 
 _MANIFEST = "manifest.json"          # single-host name (kept for reading)
+MANIFEST_VERSION = 2                 # v2 = per-shard crc32 + byte sizes
+
+#: Retry schedule for checkpoint file I/O (module-level so the fault
+#: harness / tests can swap in a sleepless policy).
+IO_RETRY_POLICY = RetryPolicy(max_attempts=4, base_delay=0.05)
+
+
+class CheckpointCorruption(OSError):
+    """A checkpoint failed integrity verification (missing shard file,
+    size mismatch, or CRC32 mismatch).  Deliberately NOT retryable: the
+    bytes on disk are wrong and will stay wrong."""
 
 
 def _manifest_name() -> str:
@@ -102,12 +128,19 @@ def save_sharded(state, path: str, *, use_async: bool = False
 
     Each process writes only its addressable replica-0 shards, so the
     aggregate across hosts is exactly one copy of every element.
+
+    Durability contract: every shard file and the manifest are written via
+    the fsync'd + retry-wrapped ``fsio`` seam, the manifest is written
+    LAST, and each shard's CRC32/size is recorded in it — so a reader that
+    sees a manifest sees (and can verify) every byte it references.  The
+    device→host copy happens synchronously before this returns even with
+    ``use_async=True``; only serialization + file I/O runs on the thread.
     """
     os.makedirs(path, exist_ok=True)
     leaves = _flatten(state)
     # world count recorded so load merges EXACTLY p0..p{world-1} and never
     # picks up stale manifests from an earlier save with more processes
-    manifest: Dict[str, Any] = {"version": 1,
+    manifest: Dict[str, Any] = {"version": MANIFEST_VERSION,
                                 "world": jax.process_count(), "leaves": {}}
     work: List[Tuple[str, List[Dict[str, Any]]]] = []
     proc = jax.process_index()
@@ -128,14 +161,15 @@ def save_sharded(state, path: str, *, use_async: bool = False
             # never collide on shard files
             fname = f"shard-p{proc}-{i}.npy"
             idx = _index_to_json(shard.index, arr.shape)
-            entry["shards"].append({"file": fname, "index": idx})
+            meta = {"file": fname, "index": idx}
+            entry["shards"].append(meta)
             # device→host copy happens NOW, synchronously: the caller may
             # donate these buffers to the next jitted step the moment we
             # return, so only file I/O may be deferred to the thread
             data = np.asarray(shard.data)
             if data.dtype == jnp.bfloat16:
                 data = data.view(np.uint16)  # npy has no bf16: raw bits
-            shard_specs.append({"file": fname, "data": data})
+            shard_specs.append({"data": data, "meta": meta})
         manifest["leaves"][name] = entry
         work.append((name, shard_specs))
 
@@ -144,9 +178,21 @@ def save_sharded(state, path: str, *, use_async: bool = False
             d = _leaf_dir(path, name)
             os.makedirs(d, exist_ok=True)
             for spec in shard_specs:
-                np.save(os.path.join(d, spec["file"]), spec["data"])
-        with open(os.path.join(path, _manifest_name()), "w") as f:
-            json.dump(manifest, f, indent=1)
+                buf = _io.BytesIO()
+                np.save(buf, spec["data"])
+                payload = buf.getvalue()
+                # checksum the exact on-disk bytes (header included) so
+                # verification is a pure file read, no npy parsing
+                spec["meta"]["crc32"] = zlib.crc32(payload) & 0xFFFFFFFF
+                spec["meta"]["bytes"] = len(payload)
+                retry_call(fsio.write_bytes,
+                           os.path.join(d, spec["meta"]["file"]), payload,
+                           policy=IO_RETRY_POLICY)
+            fsio.fsync_dir(d)
+        retry_call(fsio.write_bytes, os.path.join(path, _manifest_name()),
+                   json.dumps(manifest, indent=1).encode("utf-8"),
+                   policy=IO_RETRY_POLICY)
+        fsio.fsync_dir(path)
 
     if not use_async:
         _write()
@@ -162,6 +208,82 @@ def save_sharded(state, path: str, *, use_async: bool = False
     t = threading.Thread(target=_run, daemon=True)
     t.start()
     return AsyncSaveHandle(t, errors)
+
+
+def _read_manifests(path: str) -> Tuple[int, Dict[str, Any]]:
+    """Merge every process's manifest; returns (version, leaves)."""
+    p0 = os.path.join(path, "manifest-p0.json")
+    if not os.path.exists(p0) and os.path.exists(
+            os.path.join(path, _MANIFEST)):
+        p0 = os.path.join(path, _MANIFEST)  # legacy single-host name
+    enforce(os.path.exists(p0), f"no manifest found under {path!r}")
+
+    def _load_json(mpath):
+        return json.loads(retry_call(fsio.read_bytes, mpath,
+                                     policy=IO_RETRY_POLICY))
+
+    try:
+        head = _load_json(p0)
+    except json.JSONDecodeError as e:
+        # a truncated/garbled manifest is corruption, not a usage error —
+        # restore_or quarantines on this
+        raise CheckpointCorruption(f"manifest {p0} unreadable: {e}") from e
+    version = int(head.get("version", 1))
+    world = int(head.get("world", 1))
+    names = [p0] + [os.path.join(path, f"manifest-p{i}.json")
+                    for i in range(1, world)]
+    missing_m = [n for n in names if not os.path.exists(n)]
+    if missing_m:
+        raise CheckpointCorruption(
+            f"checkpoint written by {world} processes but manifests missing:"
+            f" {missing_m}")
+    leaves: Dict[str, Any] = {}
+    for mpath in names:  # union of exactly this save's shard lists
+        try:
+            part = _load_json(mpath)["leaves"]
+        except json.JSONDecodeError as e:
+            raise CheckpointCorruption(
+                f"manifest {mpath} unreadable: {e}") from e
+        for lname, entry in part.items():
+            if lname in leaves:
+                leaves[lname]["shards"].extend(entry["shards"])
+            else:
+                leaves[lname] = entry
+    return version, leaves
+
+
+def verify_sharded(path: str) -> List[str]:
+    """Integrity-check every shard file a checkpoint's manifests reference.
+
+    Returns a list of problem strings (empty = clean).  v2 manifests get
+    existence + byte-size + CRC32 checks; v1 manifests (no checksums) get
+    existence checks only.
+    """
+    version, leaves = _read_manifests(path)
+    problems: List[str] = []
+    for name, entry in leaves.items():
+        d = _leaf_dir(path, name)
+        for shard in entry["shards"]:
+            fpath = os.path.join(d, shard["file"])
+            rel = os.path.join(os.path.basename(d), shard["file"])
+            if not os.path.exists(fpath):
+                problems.append(f"{rel}: missing")
+                continue
+            if "bytes" in shard:
+                size = os.path.getsize(fpath)
+                if size != int(shard["bytes"]):
+                    problems.append(
+                        f"{rel}: size {size} != recorded {shard['bytes']}")
+                    continue  # CRC would fail too; report the root cause
+            if "crc32" in shard:
+                crc = zlib.crc32(retry_call(
+                    fsio.read_bytes, fpath,
+                    policy=IO_RETRY_POLICY)) & 0xFFFFFFFF
+                if crc != int(shard["crc32"]):
+                    problems.append(
+                        f"{rel}: crc32 {crc:#010x} != recorded "
+                        f"{int(shard['crc32']):#010x}")
+    return problems
 
 
 def _read_window(leaf_dir: str, entry: Dict[str, Any], window) -> np.ndarray:
@@ -198,7 +320,7 @@ def _read_window(leaf_dir: str, entry: Dict[str, Any], window) -> np.ndarray:
     return out
 
 
-def load_sharded(path: str, template=None):
+def load_sharded(path: str, template=None, *, strict: bool = True):
     """Load a sharded checkpoint.
 
     ``template``: a pytree matching the saved structure whose leaves carry
@@ -207,30 +329,31 @@ def load_sharded(path: str, template=None):
     sharding, reading only the slices every device needs (resharding-on-load;
     ≙ auto_parallel converter).  With ``template=None`` returns a nested
     dict of host numpy arrays (names split on '/').
+
+    Integrity: with a v2 manifest every referenced shard file is verified
+    (existence, byte size, CRC32) BEFORE any array is materialized; a
+    failure raises :class:`CheckpointCorruption`.  ``strict=False`` demotes
+    verification failures to warnings and loads whatever it can (forensics
+    / partial-recovery mode).  v1 manifests skip the checksum pass with a
+    warning — pre-checksum checkpoints stay loadable.
     """
-    p0 = os.path.join(path, "manifest-p0.json")
-    if not os.path.exists(p0) and os.path.exists(
-            os.path.join(path, _MANIFEST)):
-        p0 = os.path.join(path, _MANIFEST)  # legacy single-host name
-    enforce(os.path.exists(p0), f"no manifest found under {path!r}")
-    with open(p0) as f:
-        head = json.load(f)
-    world = int(head.get("world", 1))
-    names = [p0] + [os.path.join(path, f"manifest-p{i}.json")
-                    for i in range(1, world)]
-    missing_m = [n for n in names if not os.path.exists(n)]
-    enforce(not missing_m,
-            f"checkpoint written by {world} processes but manifests missing:"
-            f" {missing_m}")
-    leaves: Dict[str, Any] = {}
-    for mpath in names:  # union of exactly this save's shard lists
-        with open(mpath) as f:
-            part = json.load(f)["leaves"]
-        for lname, entry in part.items():
-            if lname in leaves:
-                leaves[lname]["shards"].extend(entry["shards"])
-            else:
-                leaves[lname] = entry
+    version, leaves = _read_manifests(path)
+    if version < 2:
+        warnings.warn(
+            f"checkpoint {path!r} has a v{version} manifest (no checksums); "
+            "integrity verification skipped", RuntimeWarning, stacklevel=2)
+    else:
+        problems = verify_sharded(path)
+        if problems:
+            msg = (f"checkpoint {path!r} failed verification "
+                   f"({len(problems)} problem(s)): "
+                   + "; ".join(problems[:5])
+                   + (" …" if len(problems) > 5 else ""))
+            if strict:
+                raise CheckpointCorruption(msg)
+            warnings.warn(msg + " — loading anyway (strict=False)",
+                          RuntimeWarning, stacklevel=2)
+            vlog(0, "checkpoint: %s", msg)
 
     if template is None:
         out: Dict[str, Any] = {}

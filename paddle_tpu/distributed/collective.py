@@ -40,13 +40,21 @@ class ReduceOp:
     AVG = "avg"
 
 
+def bound_axis_size(name: str):
+    """Size of a bound (shard_map/pmap) axis — ``lax.axis_size`` on
+    jax>=0.5, the constant-folded ``psum(1, axis)`` idiom before that."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    return lax.psum(1, name)
+
+
 def _in_axis(group: Optional[str]) -> bool:
     """True when ``group`` names an axis bound in the current trace
     (inside shard_map over that axis)."""
     if group is None:
         return False
     try:
-        lax.axis_size(group)
+        bound_axis_size(group)
         return True
     except (NameError, KeyError, ValueError):
         return False
@@ -125,7 +133,7 @@ def scatter(x, src: int = 0, group: Optional[str] = "dp", axis: int = 0):
     x = _arr(x)
     if not _in_axis(group):
         return x
-    n = lax.axis_size(group)
+    n = bound_axis_size(group)
     if x.shape[axis] % n:
         raise ValueError(
             f"scatter axis {axis} size {x.shape[axis]} not divisible by "
@@ -163,7 +171,7 @@ def p2p_push(x, offset: int = 1, group: str = "pp"):
     x = _arr(x)
     if not _in_axis(group):
         return x
-    n = lax.axis_size(group)
+    n = bound_axis_size(group)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, group, perm=perm)
 
@@ -173,7 +181,7 @@ def split(x, group: str = "mp", axis: int = -1):
     x = _arr(x)
     if not _in_axis(group):
         return x
-    n = lax.axis_size(group)
+    n = bound_axis_size(group)
     idx = lax.axis_index(group)
     ax = axis % x.ndim
     if x.shape[ax] % n:
@@ -226,7 +234,7 @@ def all_reduce_quantized(x, group: str = "dp", bits: int = 8,
     scale = lax.pmax(jnp.max(jnp.abs(blocks), axis=1), group)
     scale = jnp.maximum(scale, 1e-30)
     q = jnp.clip(jnp.round(blocks / scale[:, None] * qmax), -qmax, qmax)
-    n_dev = lax.axis_size(group)
+    n_dev = bound_axis_size(group)
     acc_dtype = jnp.int16 if n_dev * qmax < 2 ** 15 else jnp.int32
     total = lax.psum(q.astype(acc_dtype), group)
     out = total.astype(jnp.float32) * (scale[:, None] / qmax)

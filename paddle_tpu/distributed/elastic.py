@@ -14,31 +14,63 @@ restore-on-restart that reshards into whatever topology the job came back
 with (which checkpoint.load_sharded already does).  That is the whole
 teardown/relaunch loop of the reference with the etcd machinery replaced
 by the platform's own scheduler.
+
+Atomic commit protocol (ISSUE 1): every save is staged into
+``step-N.tmp/`` (shards + manifest fsync'd there by ``save_sharded``),
+then ``os.replace``d to ``step-N/``, then the COMMITTED marker is written
+and the parent directory fsync'd.  A crash at ANY point leaves either a
+``.tmp`` staging dir (never eligible for restore) or a fully durable
+committed step — restore can never observe a torn checkpoint.  On
+restore, ``restore_or`` walks committed steps newest→oldest, quarantining
+(``step-N/`` → ``step-N.corrupt/``) any that fail manifest/checksum
+validation, and only falls back to a fresh init when none survive.
 """
 from __future__ import annotations
 
 import os
+import shutil
 import signal
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 
 from ..framework.log import vlog
-from .checkpoint import AsyncSaveHandle, load_sharded, save_sharded
+from ..utils import fsio
+from .checkpoint import (AsyncSaveHandle, CheckpointCorruption, load_sharded,
+                         save_sharded)
 
-__all__ = ["ElasticTrainState", "latest_checkpoint"]
+__all__ = ["ElasticTrainState", "latest_checkpoint", "committed_checkpoints"]
 
 _STEP_PREFIX = "step-"
+_TMP_SUFFIX = ".tmp"
+_CORRUPT_SUFFIX = ".corrupt"
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
-    """Newest complete checkpoint path under ``directory`` (or None)."""
-    if not os.path.isdir(directory):
+def _step_of(name: str) -> Optional[int]:
+    """Step number of a ``step-N[.seq][.tmp|.corrupt]`` entry name (else
+    None)."""
+    if not name.startswith(_STEP_PREFIX):
         return None
-    best, best_step = None, -1
+    stem = name[len(_STEP_PREFIX):]
+    for suffix in (_TMP_SUFFIX, _CORRUPT_SUFFIX):
+        if stem.endswith(suffix):
+            stem = stem[: -len(suffix)]
+    stem = stem.split(".")[0]  # drop the per-save staging token
+    try:
+        return int(stem)
+    except ValueError:
+        return None
+
+
+def committed_checkpoints(directory: str) -> List[str]:
+    """Every committed checkpoint path under ``directory``, newest first."""
+    if not os.path.isdir(directory):
+        return []
+    found = []
     for name in os.listdir(directory):
-        if not name.startswith(_STEP_PREFIX):
+        if not name.startswith(_STEP_PREFIX) or name.endswith(
+                (_TMP_SUFFIX, _CORRUPT_SUFFIX)):
             continue
         full = os.path.join(directory, name)
         if not os.path.exists(os.path.join(full, "COMMITTED")):
@@ -47,9 +79,14 @@ def latest_checkpoint(directory: str) -> Optional[str]:
             step = int(name[len(_STEP_PREFIX):])
         except ValueError:
             continue
-        if step > best_step:
-            best, best_step = full, step
-    return best
+        found.append((step, full))
+    return [path for _, path in sorted(found, reverse=True)]
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest complete checkpoint path under ``directory`` (or None)."""
+    done = committed_checkpoints(directory)
+    return done[0] if done else None
 
 
 class ElasticTrainState:
@@ -75,6 +112,7 @@ class ElasticTrainState:
         self.save_interval_steps = int(save_interval_steps)
         self.keep = keep
         self._pending: Optional[AsyncSaveHandle] = None
+        self._save_seq = 0
         self._latest_state: Any = None
         self._latest_step: int = -1
         self._lock = threading.Lock()
@@ -90,23 +128,49 @@ class ElasticTrainState:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step}")
 
-    def _commit(self, step: int) -> None:
-        open(os.path.join(self._path(step), "COMMITTED"), "w").close()
+    def _commit(self, step: int, stage: str) -> None:
+        """Promote the staging dir to a durable committed ``step-N/``."""
+        final = self._path(step)
+        if stage != final:
+            if os.path.isdir(final):
+                # leftover from an earlier crashed/uncommitted save of the
+                # same step — the fresh staging dir supersedes it
+                shutil.rmtree(final)
+            os.replace(stage, final)
+        # multi-host: every process wrote its own shards straight into
+        # ``final`` (no per-process rename possible over a shared dir);
+        # the COMMITTED marker below is still the only eligibility gate
+        fsio.write_bytes(os.path.join(final, "COMMITTED"), b"")
+        fsio.fsync_dir(self.directory)
         self._gc()
+
+    def _stage_path(self, step: int) -> str:
+        # single-host saves stage into step-N.<seq>.tmp then os.replace
+        # into place; the per-manager sequence number makes the staging dir
+        # unique per save attempt, so a SIGTERM handler re-entering save()
+        # mid-write can never clobber the interrupted save's staging area.
+        # Multi-host processes share one directory and rely on the
+        # COMMITTED marker alone.
+        if jax.process_count() == 1:
+            self._save_seq += 1
+            return f"{self._path(step)}.{self._save_seq}{_TMP_SUFFIX}"
+        return self._path(step)
 
     def save(self, step: int, state, *, use_async: bool = True) -> None:
         self.wait()
-        path = self._path(step)
-        vlog(1, "elastic: saving checkpoint %s", path)
+        stage = self._stage_path(step)
+        if stage.endswith(_TMP_SUFFIX) and os.path.isdir(stage):
+            shutil.rmtree(stage)  # stale staging dir from a crashed save
+        vlog(1, "elastic: saving checkpoint %s", self._path(step))
         if use_async:
-            handle = save_sharded(state, path, use_async=True)
+            handle = save_sharded(state, stage, use_async=True)
             mgr = self
             errors: list = []
 
-            def _finish(h=handle, s=step):
+            def _finish(h=handle, s=step, st=stage):
                 try:
                     h.wait()
-                    mgr._commit(s)
+                    mgr._commit(s, st)
                 except Exception as e:  # surfaced by self.wait()
                     errors.append(e)
 
@@ -114,8 +178,8 @@ class ElasticTrainState:
             t.start()
             self._pending = AsyncSaveHandle(t, errors)
         else:
-            save_sharded(state, path)
-            self._commit(step)
+            save_sharded(state, stage)
+            self._commit(step, stage)
 
     def maybe_save(self, step: int, state) -> bool:
         """Track the live state; checkpoint every save_interval_steps."""
@@ -139,14 +203,36 @@ class ElasticTrainState:
     # -- restore -----------------------------------------------------------
     def restore_or(self, init_fn: Callable[[], Any],
                    template_fn: Callable[[], Any]):
-        """(state, start_step): restore the newest committed checkpoint into
-        ``template_fn()``'s placement, else ``(init_fn(), 0)``."""
-        path = latest_checkpoint(self.directory)
-        if path is None:
-            return init_fn(), 0
-        step = int(os.path.basename(path)[len(_STEP_PREFIX):])
-        vlog(1, "elastic: restoring %s", path)
-        return load_sharded(path, template_fn()), step + 1
+        """(state, start_step): restore the newest VALID committed
+        checkpoint into ``template_fn()``'s placement, else
+        ``(init_fn(), 0)``.
+
+        Fallback chain: committed steps are tried newest→oldest; any that
+        fail manifest/checksum validation (or raise during load) are
+        quarantined to ``step-N.corrupt/`` and the next one is tried.  A
+        single flipped bit therefore costs one checkpoint interval, not
+        the run.
+        """
+        for path in committed_checkpoints(self.directory):
+            step = int(os.path.basename(path)[len(_STEP_PREFIX):])
+            vlog(1, "elastic: restoring %s", path)
+            try:
+                return load_sharded(path, template_fn()), step + 1
+            except Exception as e:
+                kind = ("corruption" if isinstance(e, CheckpointCorruption)
+                        else "load failure")
+                vlog(0, "elastic: %s restoring %s (%s) — quarantining and "
+                     "falling back to the previous committed step",
+                     kind, path, e)
+                self._quarantine(path)
+        return init_fn(), 0
+
+    def _quarantine(self, path: str) -> None:
+        dst = path + _CORRUPT_SUFFIX
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.replace(path, dst)
+        fsio.fsync_dir(self.directory)
 
     # -- preemption --------------------------------------------------------
     def _on_sigterm(self, signum, frame) -> None:
@@ -154,7 +240,21 @@ class ElasticTrainState:
             state, step = self._latest_state, self._latest_step
         if state is not None:
             vlog(0, "elastic: SIGTERM — flushing checkpoint at step %d", step)
-            self.save(step, state, use_async=False)
+            # a pending async save may be mid-flight (or mid-failure): its
+            # _finish thread can surface an exception out of save()'s
+            # wait() INSIDE this signal handler — absorb it and still
+            # write the final synchronous checkpoint, which is the one
+            # restart depends on
+            try:
+                self.wait()
+            except Exception as e:
+                vlog(0, "elastic: pending async save failed during SIGTERM "
+                     "(%s) — writing final checkpoint anyway", e)
+                self._pending = None
+            try:
+                self.save(step, state, use_async=False)
+            except Exception as e:
+                vlog(0, "elastic: final checkpoint flush failed: %s", e)
         if callable(self._prev_handler):
             self._prev_handler(signum, frame)
         else:
@@ -162,13 +262,37 @@ class ElasticTrainState:
             os.kill(os.getpid(), signal.SIGTERM)
 
     def _gc(self) -> None:
-        if not self.keep:
+        """Prune old committed steps (keep newest ``self.keep``) and sweep
+        stale debris — uncommitted ``step-*`` dirs, ``.tmp`` staging dirs
+        and ``.corrupt`` quarantines STRICTLY OLDER than the newest
+        committed step (crashed async saves must not leak disk forever;
+        newer-or-equal debris is left alone: it may be another process's
+        in-flight save or evidence worth keeping)."""
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
             return
-        done = sorted(
-            (int(n[len(_STEP_PREFIX):]) for n in os.listdir(self.directory)
-             if n.startswith(_STEP_PREFIX) and os.path.exists(
+        committed = sorted(
+            (int(n[len(_STEP_PREFIX):]) for n in entries
+             if n.startswith(_STEP_PREFIX)
+             and not n.endswith((_TMP_SUFFIX, _CORRUPT_SUFFIX))
+             and os.path.exists(
                  os.path.join(self.directory, n, "COMMITTED"))),
             reverse=True)
-        import shutil
-        for step in done[self.keep:]:
-            shutil.rmtree(self._path(step), ignore_errors=True)
+        if not committed:
+            return
+        if self.keep:
+            for step in committed[self.keep:]:
+                shutil.rmtree(self._path(step), ignore_errors=True)
+        newest = committed[0]
+        for name in entries:
+            step = _step_of(name)
+            if step is None or step >= newest:
+                continue
+            full = os.path.join(self.directory, name)
+            is_stale = (name.endswith((_TMP_SUFFIX, _CORRUPT_SUFFIX))
+                        or not os.path.exists(
+                            os.path.join(full, "COMMITTED")))
+            if is_stale:
+                vlog(1, "elastic: gc removing stale %s", full)
+                shutil.rmtree(full, ignore_errors=True)

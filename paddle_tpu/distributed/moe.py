@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..framework.errors import enforce
+from .collective import bound_axis_size
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer import Layer
@@ -167,7 +168,7 @@ def global_scatter(x, group: str = "ep"):
     concretely (E_local, world·C, ...) — every token now sits on the rank
     owning its expert, grouped by source rank.
     """
-    world = lax.axis_size(group)
+    world = bound_axis_size(group)
     e = x.shape[0]
     enforce(e % world == 0, f"experts {e} not divisible by ep world {world}")
     y = all_to_all(x, group, split_axis=0, concat_axis=0)
@@ -181,7 +182,7 @@ def global_scatter(x, group: str = "ep"):
 def global_gather(x, group: str = "ep"):
     """Inverse of global_scatter (≙ global_gather_op.cc): return expert
     outputs to the token's source rank.  Call INSIDE shard_map."""
-    world = lax.axis_size(group)
+    world = bound_axis_size(group)
     e_local = x.shape[0]
     c = x.shape[1] // world
     y = x.reshape(e_local, world, c, *x.shape[2:])

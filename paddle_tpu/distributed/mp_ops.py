@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.errors import enforce
+from .collective import bound_axis_size
 from .collective import _arr, _in_axis
 from .mp_layers import shard_constraint
 
@@ -45,7 +46,7 @@ def parallel_cross_entropy(logits, label, mp_axis: str = "mp",
     lf = logits.astype(jnp.float32)
 
     if _in_axis(mp_axis):
-        n = lax.axis_size(mp_axis)
+        n = bound_axis_size(mp_axis)
         idx = lax.axis_index(mp_axis)
         vocab_local = logits.shape[-1]
         start = idx * vocab_local

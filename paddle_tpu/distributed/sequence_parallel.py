@@ -34,8 +34,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.errors import enforce
+from .collective import bound_axis_size
 
-__all__ = ["ring_attention", "ring_attention_sharded"]
+__all__ = ["ring_attention", "ring_attention_sharded", "shard_map"]
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax<0.6 only exposes the experimental spelling
+    from jax.experimental.shard_map import shard_map
 
 _NEG_INF = -1e30
 
@@ -75,7 +81,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
     long-context property).  Communication is ``ppermute`` over ICI,
     overlappable with the chunk compute by XLA's scheduler.
     """
-    n = lax.axis_size(axis_name)
+    n = bound_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     if scale is None:
@@ -128,5 +134,5 @@ def ring_attention_sharded(q, k, v, mesh=None, *, sp_axis: str = "sp",
     spec = _clean_spec(mesh, (dp_axis, mp_axis, sp_axis, None))
     fn = functools.partial(ring_attention, axis_name=sp_axis,
                            causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

@@ -16,6 +16,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import fsio
+from ..utils.retry import RetryPolicy, retry_call
+
+#: Retry schedule for pickle checkpoint I/O (module-level so tests / the
+#: fault harness can swap in a sleepless policy).
+IO_RETRY_POLICY = RetryPolicy(max_attempts=4, base_delay=0.05)
+
 
 def _to_host(obj):
     def conv(x):
@@ -47,18 +54,24 @@ def _from_host(obj):
 
 
 def save(obj: Any, path: str, protocol: int = 4) -> None:
-    """paddle.save analog: pickles a (nested) state_dict to path."""
+    """paddle.save analog: pickles a (nested) state_dict to path.
+
+    The pickle is staged into ``path + ".tmp"`` (fsync'd) and
+    ``os.replace``d into place, so a crash mid-save never leaves a
+    torn/unloadable file at ``path``; transient I/O errors are absorbed by
+    retry with backoff."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_host(obj), f, protocol=protocol)
+    payload = pickle.dumps(_to_host(obj), protocol=protocol)
+    retry_call(fsio.atomic_write_bytes, path, payload,
+               policy=IO_RETRY_POLICY)
 
 
 def load(path: str, return_numpy: bool = False) -> Any:
     """paddle.load analog."""
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    obj = pickle.loads(retry_call(fsio.read_bytes, path,
+                                  policy=IO_RETRY_POLICY))
     if return_numpy:
         return jax.tree_util.tree_map(
             lambda x: x.arr if isinstance(x, _BF16) else x, obj,

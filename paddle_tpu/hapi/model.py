@@ -7,6 +7,7 @@ dygraph/static execution paths.
 """
 from __future__ import annotations
 
+import math
 from typing import List, Optional
 
 import jax
@@ -17,6 +18,7 @@ from .. import framework
 from ..framework import debug
 from ..framework import random as fw_random
 from ..framework.errors import enforce
+from ..framework.log import vlog
 from ..io import DataLoader
 from ..metric import Metric
 
@@ -37,12 +39,24 @@ class Model:
         self._eval_fn = None
         self._opt_state = None
         self._amp_level = None
+        self._nonfinite_budget: Optional[int] = None
+        self._nonfinite_skipped = 0
 
     # -- setup ------------------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, nonfinite_skip_budget: Optional[int] = None):
+        """``nonfinite_skip_budget``: when set, a train batch whose loss
+        comes back nan/inf is SKIPPED (no parameter/optimizer update)
+        instead of poisoning the run — up to that many times, counted in
+        ``nonfinite_skipped`` (surfaced in fit() batch logs); one more
+        raises ``FloatingPointError``.  ``None`` (default) keeps the
+        historical behavior: the update applies whatever the loss."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _tuplify(metrics) if metrics is not None else []
+        self._nonfinite_budget = (None if nonfinite_skip_budget is None
+                                  else int(nonfinite_skip_budget))
+        self._nonfinite_skipped = 0
         self._amp_level = (amp_configs or {}).get("level") if isinstance(
             amp_configs, dict) else amp_configs
 
@@ -113,17 +127,32 @@ class Model:
             # (the LRScheduler callback, or the user) calls .step()
             lr_override = jnp.asarray(self._optimizer._lr.get_lr(),
                                       jnp.float32)
-        loss, out, new_params, self._opt_state, finite = self._train_step(
+        loss, out, new_params, new_opt_state, finite = self._train_step(
             trainable, rest, self._opt_state, key, lr_override, *data)
         if debug.check_nan_inf_enabled():
             debug.assert_all_finite(finite, context="train_batch")
+        loss_v = float(loss)
+        if self._nonfinite_budget is not None and not math.isfinite(loss_v):
+            # skip-step: drop this batch's update entirely (params AND
+            # optimizer state) so one bad batch degrades gracefully;
+            # exhausting the budget fails loudly — a persistent nan is a
+            # bug, not noise
+            self._nonfinite_skipped += 1
+            if self._nonfinite_skipped > self._nonfinite_budget:
+                raise FloatingPointError(
+                    f"non-finite loss ({loss_v}) exceeded the skip budget "
+                    f"of {self._nonfinite_budget}")
+            vlog(0, "hapi: non-finite loss (%s) — skipping update (%d/%d)",
+                 loss_v, self._nonfinite_skipped, self._nonfinite_budget)
+            return loss_v, [m.accumulate() for m in self._metrics]
+        self._opt_state = new_opt_state
         self.network.set_state_dict(new_params, strict=False)
         metrics = []
         for m in self._metrics:
             r = m.compute(np.asarray(out), np.asarray(data[-1]))
             m.update(*(r if isinstance(r, tuple) else (r,)))
             metrics.append(m.accumulate())
-        return float(loss), metrics
+        return loss_v, metrics
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -184,12 +213,18 @@ class Model:
                 history["loss"].append(loss)
                 epoch_losses.append(loss)
                 logs = {"loss": loss}
+                if self._nonfinite_budget is not None:
+                    logs["nonfinite_skipped"] = self._nonfinite_skipped
                 for m, v in zip(self._metrics, metrics):
                     logs[m.name()] = v[0] if isinstance(v, list) else v
                 cbs.on_train_batch_end(step, logs)
                 if self.stop_training:
                     break
-            epoch_logs = {"loss": float(np.mean(epoch_losses))
+            # with the skip-step guard on, skipped batches' nan losses are
+            # excluded from the epoch mean (they applied no update)
+            _mean = (np.nanmean if self._nonfinite_budget is not None
+                     else np.mean)
+            epoch_logs = {"loss": float(_mean(epoch_losses))
                           if epoch_losses else float("nan")}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 cbs.on_eval_begin()

@@ -16,11 +16,60 @@ import random as _random
 from typing import Callable
 
 __all__ = ["batch", "cache", "map_readers", "shuffle", "chain", "compose",
-           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "retry_reader"]
 
 
-def batch(reader: Callable, batch_size: int, drop_last: bool = False):
-    """paddle.batch (reference batch.py:18): group samples into lists."""
+def retry_reader(reader: Callable, max_attempts: int = 3,
+                 retryable=(OSError,), base_delay: float = 0.05,
+                 sleep=None):
+    """Absorb transient errors from a flaky reader (resilience layer).
+
+    Remote/filesystem-backed readers raise transient ``OSError``s under
+    the fleet-style workload.  A generator is dead the moment it raises,
+    so a plain retry loses the epoch; this combinator re-creates the
+    underlying iterator and fast-forwards past the samples already
+    delivered, with exponential backoff between attempts.  The error
+    budget resets after each successfully delivered sample, so one flaky
+    sample can't starve a long epoch.  Non-retryable exceptions propagate
+    immediately."""
+    from .utils.retry import RetryPolicy
+
+    policy = RetryPolicy(max_attempts=max_attempts, base_delay=base_delay,
+                         retryable=tuple(retryable),
+                         **({"sleep": sleep} if sleep is not None else {}))
+
+    def robust():
+        delivered = 0
+        failures = 0
+        while True:
+            it = reader()
+            try:
+                for i, sample in enumerate(it):
+                    if i < delivered:
+                        continue  # replayed prefix after a retry
+                    yield sample
+                    delivered += 1
+                    failures = 0
+                return
+            except policy.retryable:
+                failures += 1
+                if failures >= policy.max_attempts:
+                    raise
+                policy.sleep(policy.delay(failures))
+    return robust
+
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False,
+          retries: int = 0):
+    """paddle.batch (reference batch.py:18): group samples into lists.
+
+    ``retries > 0`` wraps the sample fetch in :func:`retry_reader` so up
+    to ``retries`` consecutive transient ``OSError``s per sample are
+    absorbed instead of killing the epoch."""
+    if retries:
+        reader = retry_reader(reader, max_attempts=retries + 1)
+
     def batched():
         buf = []
         for sample in reader():

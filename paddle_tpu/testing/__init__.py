@@ -1,0 +1,10 @@
+"""Testing utilities (resilience layer, ISSUE 1).
+
+``paddle_tpu.testing.faults`` is the fault-injection harness used by
+``tests/test_fault_tolerance.py`` to prove the checkpoint/elastic stack
+survives torn writes, bit flips, transient I/O errors and preemption
+signals.
+"""
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -1,0 +1,178 @@
+"""Fault-injection harness for the resilience layer (ISSUE 1).
+
+Every durable checkpoint byte in this codebase flows through ONE seam —
+``paddle_tpu.utils.fsio.write_bytes`` (shards, manifests, pickles, the
+elastic COMMITTED marker).  :class:`FaultInjector` monkeypatches that
+seam inside a ``with`` block and injects faults on selected writes:
+
+>>> with FaultInjector() as fi:
+...     fi.fail_writes(first=1, times=3)      # 3 transient OSErrors
+...     save_sharded(state, path)             # retry absorbs them
+>>> fi.write_count                            # observed attempts
+6
+
+Injectable faults: raise a (transient) ``OSError`` on the Nth write,
+truncate the Nth write, flip a byte of the Nth write, deliver SIGTERM to
+this process right after the Nth write completes (preemption mid-save).
+Writes are numbered 1-based across the whole ``with`` block; each retry
+attempt counts as a fresh write, which is exactly what lets a test prove
+"3 consecutive transient errors then success".
+
+Offline corruption helpers (:func:`flip_byte`, :func:`truncate_file`,
+:func:`corrupt_shard`, :func:`corrupt_manifest`) damage an
+already-committed checkpoint on disk — the "flipped bit in cold storage"
+scenario that the checksum verification + restore fallback chain must
+catch.  They bypass the seam on purpose (corruption is not a write).
+
+:func:`fast_retries` swaps every module-level retry policy for a
+sleepless one so fault tests measure behavior, not backoff time.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import signal as _signal
+from typing import Callable, List, Optional, Tuple
+
+from ..utils import fsio
+from ..utils.retry import RetryPolicy
+
+__all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
+           "corrupt_manifest", "fast_retries"]
+
+
+def _default_transient() -> OSError:
+    return OSError("injected transient I/O error")
+
+
+class FaultInjector:
+    """Context manager that intercepts ``fsio.write_bytes`` and injects
+    configured faults; all writes it does not target pass through to the
+    real (fsync'd) implementation."""
+
+    def __init__(self):
+        self.write_count = 0
+        self.injected: List[Tuple[int, str, str]] = []  # (n, kind, path)
+        self._rules: List[tuple] = []
+        self._orig: Optional[Callable] = None
+
+    # -- rule builders (chainable) ----------------------------------------
+    def fail_writes(self, first: int, times: int = 1,
+                    exc_factory: Callable[[], BaseException] =
+                    _default_transient) -> "FaultInjector":
+        """Raise ``exc_factory()`` on writes ``first .. first+times-1``."""
+        self._rules.append(("fail", first, times, exc_factory))
+        return self
+
+    def truncate_write(self, nth: int, keep_bytes: int = 8
+                       ) -> "FaultInjector":
+        """Write only the first ``keep_bytes`` of the Nth write (torn
+        write: the file exists but is short)."""
+        self._rules.append(("truncate", nth, keep_bytes))
+        return self
+
+    def flip_byte_on_write(self, nth: int, offset: int = -1
+                           ) -> "FaultInjector":
+        """Flip one byte of the Nth write's payload (silent bit rot at
+        write time; size stays right, CRC must catch it)."""
+        self._rules.append(("flip", nth, offset))
+        return self
+
+    def sigterm_on_write(self, nth: int) -> "FaultInjector":
+        """Deliver SIGTERM to this process right after the Nth write
+        lands (preemption notice arriving mid-save)."""
+        self._rules.append(("sigterm", nth))
+        return self
+
+    # -- interception ------------------------------------------------------
+    def __enter__(self) -> "FaultInjector":
+        self._orig = fsio.write_bytes
+        fsio.write_bytes = self._intercept
+        return self
+
+    def __exit__(self, *exc) -> None:
+        fsio.write_bytes = self._orig
+        self._orig = None
+
+    def _intercept(self, path: str, payload: bytes) -> None:
+        self.write_count += 1
+        n = self.write_count
+        for rule in self._rules:
+            kind = rule[0]
+            if kind == "fail" and rule[1] <= n < rule[1] + rule[2]:
+                self.injected.append((n, kind, path))
+                raise rule[3]()
+            if kind == "truncate" and n == rule[1]:
+                self.injected.append((n, kind, path))
+                return self._orig(path, payload[: rule[2]])
+            if kind == "flip" and n == rule[1]:
+                self.injected.append((n, kind, path))
+                mutated = bytearray(payload)
+                mutated[rule[2]] ^= 0xFF
+                return self._orig(path, bytes(mutated))
+            if kind == "sigterm" and n == rule[1]:
+                self.injected.append((n, kind, path))
+                self._orig(path, payload)
+                os.kill(os.getpid(), _signal.SIGTERM)
+                return None
+        return self._orig(path, payload)
+
+
+# -- offline corruption (damage committed bytes on disk) -------------------
+def flip_byte(path: str, offset: Optional[int] = None) -> None:
+    """XOR one byte of ``path`` in place (default: the middle byte, which
+    for .npy files lands in array data, not the header)."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            raise ValueError(f"{path} is empty, nothing to flip")
+        pos = size // 2 if offset is None else offset % size
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def truncate_file(path: str, keep_bytes: int = 8) -> None:
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+
+
+def corrupt_shard(ckpt_dir: str, index: int = 0,
+                  offset: Optional[int] = None) -> str:
+    """Flip a byte in the ``index``-th shard file (sorted order) of a
+    saved checkpoint; returns the damaged file's path."""
+    shards = sorted(glob.glob(os.path.join(ckpt_dir, "*", "shard-*.npy")))
+    if not shards:
+        raise FileNotFoundError(f"no shard files under {ckpt_dir}")
+    flip_byte(shards[index], offset)
+    return shards[index]
+
+
+def corrupt_manifest(ckpt_dir: str, keep_bytes: int = 16) -> str:
+    """Truncate the checkpoint's manifest (torn manifest write on a
+    pre-atomic-commit writer); returns the damaged file's path."""
+    names = (sorted(glob.glob(os.path.join(ckpt_dir, "manifest-p*.json")))
+             or [os.path.join(ckpt_dir, "manifest.json")])
+    truncate_file(names[0], keep_bytes)
+    return names[0]
+
+
+@contextlib.contextmanager
+def fast_retries(max_attempts: int = 4):
+    """Swap every module-level IO retry policy for a sleepless one for the
+    duration of the block (fault tests shouldn't pay real backoff)."""
+    from ..distributed import checkpoint as ckpt_mod
+    from ..framework import io as io_mod
+
+    policy = RetryPolicy(max_attempts=max_attempts, base_delay=0.0,
+                         jitter=0.0, sleep=lambda _t: None)
+    saved = (ckpt_mod.IO_RETRY_POLICY, io_mod.IO_RETRY_POLICY)
+    ckpt_mod.IO_RETRY_POLICY = policy
+    io_mod.IO_RETRY_POLICY = policy
+    try:
+        yield policy
+    finally:
+        ckpt_mod.IO_RETRY_POLICY, io_mod.IO_RETRY_POLICY = saved

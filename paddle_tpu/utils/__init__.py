@@ -10,10 +10,12 @@ import warnings
 
 from . import cpp_extension  # noqa: F401
 from . import download  # noqa: F401
+from . import fsio  # noqa: F401
+from . import retry  # noqa: F401
 from . import unique_name  # noqa: F401
 
 __all__ = ["deprecated", "try_import", "run_check", "cpp_extension",
-           "unique_name", "download"]
+           "unique_name", "download", "retry", "fsio"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = ""):

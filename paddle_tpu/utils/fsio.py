@@ -1,0 +1,60 @@
+"""Durable small-file I/O — the single seam every checkpoint byte passes
+through.
+
+All checkpoint writers (``distributed/checkpoint.py`` shards + manifests,
+``framework/io.py`` pickles, the elastic COMMITTED marker) call
+:func:`write_bytes` / :func:`atomic_write_bytes` instead of opening files
+directly.  That buys three things at once:
+
+- **durability**: every write is flushed AND fsync'd before it counts —
+  an ``os.replace`` over a non-fsync'd file can still surface as a torn
+  file after power loss;
+- **atomicity**: ``atomic_write_bytes`` stages through ``path + ".tmp"``
+  and ``os.replace``s into place, so readers only ever see absent or
+  complete files;
+- **injectability**: the fault harness (``paddle_tpu.testing.faults``)
+  monkeypatches ``fsio.write_bytes`` to deliver truncations, bit flips,
+  transient ``OSError``s and SIGTERM mid-save to EVERY durable write in
+  the stack from one place.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["write_bytes", "atomic_write_bytes", "read_bytes", "fsync_dir"]
+
+
+def write_bytes(path: str, payload: bytes) -> None:
+    """Write ``payload`` to ``path`` and fsync it (durable, NOT atomic)."""
+    with open(path, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def atomic_write_bytes(path: str, payload: bytes) -> None:
+    """Durably write ``payload`` so ``path`` is only ever absent or
+    complete: stage into ``path + ".tmp"``, fsync, ``os.replace``."""
+    tmp = path + ".tmp"
+    write_bytes(tmp, payload)
+    os.replace(tmp, path)
+
+
+def read_bytes(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates inside it are durable (no-op
+    on platforms whose dirfds reject fsync)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems (and non-POSIX hosts) reject dirfd fsync
+    finally:
+        os.close(fd)

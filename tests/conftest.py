@@ -41,6 +41,9 @@ def pytest_configure(config):
         "markers", "quick: fast cross-section tier (<90s; see README.md)")
     config.addinivalue_line(
         "markers", "slow: heavyweight tests, deselect with -m 'not slow'")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection / resilience tests "
+        "(tier-1 runs these; budget ~30s on JAX_PLATFORMS=cpu)")
 
 
 def pytest_collection_modifyitems(config, items):

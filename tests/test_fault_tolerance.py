@@ -1,0 +1,521 @@
+"""Fault-tolerance proof for the resilience layer (ISSUE 1).
+
+Uses ``paddle_tpu.testing.faults`` to deliver torn writes, bit flips,
+transient ``OSError``s and SIGTERM into the checkpoint/elastic/training
+stack, and asserts the documented recovery behavior:
+
+- a byte-flipped shard in the newest checkpoint is caught by CRC32,
+  quarantined, and training resumes from the previous committed step;
+- SIGTERM mid-run flushes a checkpoint that restores bit-exact;
+- up to 3 consecutive transient I/O errors are absorbed by retry with no
+  caller-visible failure;
+- v1 (pre-checksum) checkpoints stay loadable.
+"""
+import os
+import signal
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import framework
+from paddle_tpu.distributed.checkpoint import (CheckpointCorruption,
+                                               load_sharded, save_sharded,
+                                               verify_sharded)
+from paddle_tpu.distributed.elastic import (ElasticTrainState,
+                                            committed_checkpoints,
+                                            latest_checkpoint)
+from paddle_tpu.testing import faults
+from paddle_tpu.utils.retry import (RetriesExhausted, RetryPolicy,
+                                    retry_call)
+
+pytestmark = pytest.mark.faults
+
+
+def _mgr(tmp_path, **kw):
+    kw.setdefault("install_sigterm_handler", False)
+    return ElasticTrainState(str(tmp_path), **kw)
+
+
+def _state(seed=0, n=16):
+    return {"w": jnp.asarray(np.random.RandomState(seed).randn(n)
+                             .astype(np.float32)),
+            "step": jnp.asarray(seed, jnp.int32)}
+
+
+def _template(n=16):
+    return {"w": jax.ShapeDtypeStruct((n,), np.float32),
+            "step": jax.ShapeDtypeStruct((), np.int32)}
+
+
+# -- retry primitive -------------------------------------------------------
+class TestRetry:
+    def test_absorbs_transient_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 4:
+                raise OSError("transient")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=4, sleep=lambda _t: None)
+        assert retry_call(flaky, policy=policy) == "ok"
+        assert len(calls) == 4
+
+    def test_exhaustion_raises_with_cause(self):
+        policy = RetryPolicy(max_attempts=2, sleep=lambda _t: None)
+        with pytest.raises(RetriesExhausted) as ei:
+            retry_call(lambda: (_ for _ in ()).throw(OSError("boom")),
+                       policy=policy)
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            retry_call(bad, policy=RetryPolicy(sleep=lambda _t: None))
+        assert len(calls) == 1
+
+    def test_deadline_cuts_retries_short(self):
+        slept = []
+        policy = RetryPolicy(max_attempts=10, base_delay=5.0, jitter=0.0,
+                             deadline=1.0, sleep=slept.append)
+        with pytest.raises(RetriesExhausted):
+            retry_call(lambda: (_ for _ in ()).throw(OSError()),
+                       policy=policy)
+        assert not slept  # first 5s backoff already exceeds the deadline
+
+
+# -- transient I/O errors on save (acceptance criterion 3) ----------------
+class TestTransientIO:
+    def test_three_transient_write_errors_absorbed(self, tmp_path):
+        state = _state(3)
+        path = str(tmp_path / "ck")
+        with faults.fast_retries(max_attempts=4):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=3)
+                save_sharded(state, path)  # no caller-visible failure
+        assert len(fi.injected) == 3
+        back = load_sharded(path)
+        np.testing.assert_array_equal(back["w"], np.asarray(state["w"]))
+
+    def test_persistent_write_errors_surface(self, tmp_path):
+        with faults.fast_retries(max_attempts=3):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=99)
+                with pytest.raises(RetriesExhausted):
+                    save_sharded(_state(), str(tmp_path / "ck"))
+        assert fi.write_count == 3
+
+    def test_async_save_error_surfaces_via_wait(self, tmp_path):
+        mgr = _mgr(tmp_path / "ck")
+        with faults.fast_retries(max_attempts=2):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=99)
+                mgr.save(1, _state(1))
+                with pytest.raises(RetriesExhausted):
+                    mgr.wait()
+        # nothing committed: the staging dir never got promoted
+        assert latest_checkpoint(str(tmp_path / "ck")) is None
+
+
+# -- checksum verification (manifest v2) ----------------------------------
+class TestChecksums:
+    def test_flipped_byte_detected(self, tmp_path):
+        path = str(tmp_path / "ck")
+        save_sharded(_state(5), path)
+        assert verify_sharded(path) == []
+        faults.corrupt_shard(path, offset=-2)  # data byte, size unchanged
+        problems = verify_sharded(path)
+        assert len(problems) == 1 and "crc32" in problems[0]
+        with pytest.raises(CheckpointCorruption):
+            load_sharded(path)
+
+    def test_truncated_shard_detected_by_size(self, tmp_path):
+        path = str(tmp_path / "ck")
+        save_sharded(_state(6), path)
+        import glob
+        shard = sorted(glob.glob(os.path.join(path, "*", "shard-*.npy")))[0]
+        faults.truncate_file(shard, keep_bytes=8)
+        problems = verify_sharded(path)
+        assert problems and "size" in problems[0]
+
+    def test_strict_false_demotes_to_warning(self, tmp_path):
+        path = str(tmp_path / "ck")
+        save_sharded(_state(7), path)
+        faults.corrupt_shard(path, offset=-2)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            back = load_sharded(path, strict=False)
+        assert any("failed verification" in str(x.message) for x in w)
+        assert back["w"].shape == (16,)  # loaded despite the damage
+
+    def test_torn_write_at_save_time_caught(self, tmp_path):
+        # the injector truncates the shard write itself: the manifest then
+        # records the INTENDED size/crc, so verification must flag it
+        path = str(tmp_path / "ck")
+        with faults.FaultInjector() as fi:
+            fi.truncate_write(1, keep_bytes=8)
+            save_sharded(_state(8), path)
+        problems = verify_sharded(path)
+        assert problems and "size" in problems[0]
+
+    def test_v1_manifest_still_loads(self, tmp_path):
+        path = str(tmp_path / "ck")
+        state = _state(9)
+        save_sharded(state, path)
+        # rewrite the manifest as a pre-checksum v1 writer would have
+        mpath = os.path.join(path, "manifest-p0.json")
+        import json
+        with open(mpath) as f:
+            m = json.load(f)
+        m["version"] = 1
+        for entry in m["leaves"].values():
+            for shard in entry["shards"]:
+                shard.pop("crc32", None)
+                shard.pop("bytes", None)
+        with open(mpath, "w") as f:
+            json.dump(m, f)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            back = load_sharded(path)
+        assert any("no checksums" in str(x.message) for x in w)
+        np.testing.assert_array_equal(back["w"], np.asarray(state["w"]))
+
+
+# -- atomic commit + restore fallback chain -------------------------------
+class TestRestoreFallback:
+    def test_corrupt_newest_falls_back_and_quarantines(self, tmp_path):
+        """Acceptance criterion 1: flipped bit → quarantine → resume from
+        the previous committed step."""
+        d = str(tmp_path / "ck")
+        mgr = _mgr(d, save_interval_steps=2, keep=4)
+        states = {s: _state(s) for s in (2, 4)}
+        for s in (2, 4):
+            mgr.save(s, states[s], use_async=False)
+        faults.corrupt_shard(os.path.join(d, "step-4"), offset=-2)
+
+        restored, start = mgr.restore_or(lambda: None, _template)
+        assert start == 3  # resumed after step 2, not 4
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(states[2]["w"]))
+        names = set(os.listdir(d))
+        assert "step-4.corrupt" in names and "step-4" not in names
+
+    def test_truncated_manifest_falls_back(self, tmp_path):
+        d = str(tmp_path / "ck")
+        mgr = _mgr(d, save_interval_steps=1, keep=4)
+        for s in (1, 2):
+            mgr.save(s, _state(s), use_async=False)
+        faults.corrupt_manifest(os.path.join(d, "step-2"))
+        restored, start = mgr.restore_or(lambda: None, _template)
+        assert start == 2
+        assert "step-2.corrupt" in os.listdir(d)
+
+    def test_every_checkpoint_corrupt_falls_to_init(self, tmp_path):
+        d = str(tmp_path / "ck")
+        mgr = _mgr(d, keep=4)
+        for s in (1, 2):
+            mgr.save(s, _state(s), use_async=False)
+        for s in (1, 2):
+            faults.corrupt_shard(os.path.join(d, f"step-{s}"), offset=-2)
+        state, start = mgr.restore_or(lambda: {"fresh": True}, _template)
+        assert start == 0 and state == {"fresh": True}
+        assert committed_checkpoints(d) == []
+
+    def test_failed_save_leaves_no_committed_step(self, tmp_path):
+        d = str(tmp_path / "ck")
+        mgr = _mgr(d)
+        with faults.fast_retries(max_attempts=2):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=99)
+                with pytest.raises(RetriesExhausted):
+                    mgr.save(3, _state(3), use_async=False)
+        assert latest_checkpoint(d) is None
+        assert any(n.startswith("step-3.") and n.endswith(".tmp")
+                   for n in os.listdir(d))  # staging dir only, never final
+
+    def test_gc_sweeps_stale_debris(self, tmp_path):
+        d = str(tmp_path / "ck")
+        os.makedirs(os.path.join(d, "step-1.tmp"))
+        os.makedirs(os.path.join(d, "step-2.corrupt"))
+        os.makedirs(os.path.join(d, "step-3"))       # uncommitted crash
+        os.makedirs(os.path.join(d, "step-9.tmp"))   # in-flight, newer
+        mgr = _mgr(d, keep=2)
+        mgr.save(5, _state(5), use_async=False)      # commit triggers gc
+        names = set(os.listdir(d))
+        assert "step-1.tmp" not in names
+        assert "step-2.corrupt" not in names
+        assert "step-3" not in names
+        assert "step-9.tmp" in names                 # never touch newer
+        assert "step-5" in names
+
+
+# -- SIGTERM / preemption --------------------------------------------------
+class TestSigterm:
+    def test_sigterm_mid_run_flushes_bitexact(self, tmp_path):
+        """Acceptance criterion 2: SIGTERM mid-run → flushed checkpoint
+        restores bit-exact."""
+        d = str(tmp_path / "ck")
+        orig = signal.getsignal(signal.SIGTERM)
+        try:
+            mgr = ElasticTrainState(d, save_interval_steps=1000,
+                                    install_sigterm_handler=True)
+            mgr._prev_handler = lambda *a: None  # don't kill pytest
+            rng = np.random.RandomState(0)
+            state = None
+            for step in range(1, 6):
+                state = {"w": jnp.asarray(rng.randn(16).astype(np.float32)),
+                         "step": jnp.asarray(step, jnp.int32)}
+                mgr.maybe_save(step, state)
+                if step == 5:
+                    os.kill(os.getpid(), signal.SIGTERM)  # preemption notice
+            path = latest_checkpoint(d)
+            assert path is not None and path.endswith("step-5")
+            restored, start = _mgr(d).restore_or(lambda: None, _template)
+            assert start == 6
+            np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                          np.asarray(state["w"]))
+        finally:
+            signal.signal(signal.SIGTERM, orig)
+
+    def test_sigterm_mid_save_still_commits(self, tmp_path):
+        """Injector delivers SIGTERM while a save is writing shards; the
+        handler re-enters save() and a committed checkpoint survives."""
+        d = str(tmp_path / "ck")
+        orig = signal.getsignal(signal.SIGTERM)
+        try:
+            mgr = ElasticTrainState(d, save_interval_steps=1000,
+                                    install_sigterm_handler=True)
+            mgr._prev_handler = lambda *a: None
+            state = _state(11)
+            mgr.maybe_save(11, state)
+            with faults.FaultInjector() as fi:
+                fi.sigterm_on_write(1)
+                mgr.save(11, state, use_async=False)
+            assert ("sigterm" in {k for _, k, _p in fi.injected})
+            path = latest_checkpoint(d)
+            assert path is not None and path.endswith("step-11")
+            back = load_sharded(path, _template())
+            np.testing.assert_array_equal(np.asarray(back["w"]),
+                                          np.asarray(state["w"]))
+        finally:
+            signal.signal(signal.SIGTERM, orig)
+
+    def test_sigterm_survives_failed_pending_async_save(self, tmp_path):
+        """Satellite: a pending async save whose background thread failed
+        must not abort the handler — the final sync flush still lands."""
+        d = str(tmp_path / "ck")
+        mgr = _mgr(d)
+        state = _state(12)
+        mgr.maybe_save(12, state)
+        with faults.fast_retries(max_attempts=2):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=99)
+                mgr.save(12, state)  # async; will fail in the background
+                mgr._pending._thread.join()  # fail while faults are active
+        mgr._prev_handler = lambda *a: None
+        mgr._on_sigterm(signal.SIGTERM, None)  # must not raise
+        path = latest_checkpoint(d)
+        assert path is not None and path.endswith("step-12")
+
+
+# -- resharded restore under injected faults ------------------------------
+@pytest.mark.skipif(jax.device_count() < 8,
+                    reason="needs the 8-device CPU mesh")
+class TestReshardedRestoreUnderFaults:
+    def test_dp4_mp2_to_dp2_mp4_with_corrupt_newest(self, tmp_path):
+        def mesh(shape, names):
+            devs = np.array(jax.devices()[: int(np.prod(shape))])
+            return Mesh(devs.reshape(shape), names)
+
+        m1, m2 = mesh((4, 2), ("dp", "mp")), mesh((2, 4), ("dp", "mp"))
+        rng = np.random.RandomState(0)
+        w = rng.randn(16, 8).astype(np.float32)
+        d = str(tmp_path / "ck")
+        mgr = _mgr(d, keep=4)
+        # step 2: the good checkpoint, saved under dp4×mp2 with 3 transient
+        # write errors injected (retry must absorb them)
+        good = {"w": jax.device_put(w, NamedSharding(m1, P("dp", "mp")))}
+        with faults.fast_retries(max_attempts=4):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=3)
+                mgr.save(2, good, use_async=False)
+        # step 4: newer but corrupted on disk
+        mgr.save(4, {"w": jax.device_put(
+            w + 1.0, NamedSharding(m1, P("dp", "mp")))}, use_async=False)
+        faults.corrupt_shard(os.path.join(d, "step-4"), offset=-2)
+
+        template = {"w": jax.ShapeDtypeStruct(
+            (16, 8), np.float32, sharding=NamedSharding(m2, P(None, "mp")))}
+        restored, start = mgr.restore_or(lambda: None, lambda: template)
+        assert start == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+        assert restored["w"].sharding.mesh.devices.shape == (2, 4)
+        assert "step-4.corrupt" in os.listdir(d)
+
+
+# -- framework.io atomic pickle save --------------------------------------
+class TestAtomicPickleSave:
+    def test_crash_mid_save_preserves_previous_file(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        framework.save({"w": jnp.ones(4)}, path)
+        with faults.fast_retries(max_attempts=2):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=99)
+                with pytest.raises(RetriesExhausted):
+                    framework.save({"w": jnp.zeros(4)}, path)
+        # the torn save never reached ``path`` — old contents intact
+        back = framework.load(path)
+        np.testing.assert_array_equal(np.asarray(back["w"]), np.ones(4))
+
+    def test_transient_errors_absorbed(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        with faults.fast_retries(max_attempts=4):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=3)
+                framework.save({"w": jnp.full((3,), 5.0)}, path)
+        np.testing.assert_array_equal(
+            np.asarray(framework.load(path)["w"]), np.full((3,), 5.0))
+
+    def test_no_torn_file_visible_at_final_path(self, tmp_path):
+        path = str(tmp_path / "fresh.pdparams")
+        with faults.fast_retries(max_attempts=2):
+            with faults.FaultInjector() as fi:
+                fi.fail_writes(first=1, times=99)
+                with pytest.raises(RetriesExhausted):
+                    framework.save({"w": jnp.ones(2)}, path)
+        assert not os.path.exists(path)  # absent beats unloadable
+
+
+# -- reader retry ----------------------------------------------------------
+class TestReaderRetry:
+    def _flaky_reader(self, fail_at=3, fails=(2,)):
+        attempts = {"n": 0}
+
+        def reader():
+            attempts["n"] += 1
+            for i in range(6):
+                if i == fail_at and attempts["n"] in fails:
+                    raise OSError("transient fetch failure")
+                yield i
+        return reader, attempts
+
+    def test_transient_fetch_absorbed_no_dup_no_loss(self):
+        from paddle_tpu.reader import retry_reader
+        reader, attempts = self._flaky_reader(fail_at=3, fails=(1, 2))
+        robust = retry_reader(reader, max_attempts=3, sleep=lambda _t: None)
+        assert list(robust()) == [0, 1, 2, 3, 4, 5]
+        assert attempts["n"] == 3
+
+    def test_budget_exhausted_raises(self):
+        from paddle_tpu.reader import retry_reader
+        reader, _ = self._flaky_reader(fail_at=3, fails=(1, 2, 3))
+        robust = retry_reader(reader, max_attempts=3, sleep=lambda _t: None)
+        with pytest.raises(OSError):
+            list(robust())
+
+    def test_batch_with_retries(self):
+        from paddle_tpu.reader import batch
+        reader, _ = self._flaky_reader(fail_at=4, fails=(1,))
+        out = list(batch(reader, 2, retries=2)())
+        assert out == [[0, 1], [2, 3], [4, 5]]
+
+    def test_non_retryable_propagates(self):
+        from paddle_tpu.reader import retry_reader
+
+        def reader():
+            yield 0
+            raise ValueError("bad sample")
+
+        with pytest.raises(ValueError):
+            list(retry_reader(reader, sleep=lambda _t: None)())
+
+
+# -- non-finite loss guard in hapi ----------------------------------------
+class TestNonFiniteGuard:
+    def _toy(self, budget):
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+        pt.seed(0)
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        model.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+                      loss=lambda out, y: jnp.mean((out - y) ** 2),
+                      nonfinite_skip_budget=budget)
+        return model
+
+    def _data(self, poison_row=2):
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 4).astype(np.float32)
+        y = rng.randn(6, 2).astype(np.float32)
+        x[poison_row] = np.nan  # one bad batch at batch_size=1
+        from paddle_tpu.io import TensorDataset
+        return TensorDataset([x, y])
+
+    def test_bad_batch_skipped_run_stays_finite(self):
+        model = self._toy(budget=2)
+        history = model.fit(self._data(), batch_size=1, epochs=1,
+                            shuffle=False, verbose=0)
+        assert model._nonfinite_skipped == 1
+        assert sum(1 for l in history["loss"] if not np.isfinite(l)) == 1
+        for _, p in model.network.named_parameters():
+            assert np.isfinite(np.asarray(p.value)).all()
+
+    def test_skip_count_reaches_batch_logs(self):
+        from paddle_tpu.hapi import Callback
+        seen = []
+
+        class Rec(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(logs.get("nonfinite_skipped"))
+
+        model = self._toy(budget=2)
+        model.fit(self._data(), batch_size=1, epochs=1, shuffle=False,
+                  verbose=0, callbacks=[Rec()])
+        assert seen[-1] == 1 and seen[0] == 0
+
+    def test_budget_exhaustion_raises(self):
+        model = self._toy(budget=0)
+        x = np.full((1, 4), np.nan, np.float32)
+        y = np.zeros((1, 2), np.float32)
+        with pytest.raises(FloatingPointError):
+            model.train_batch([x], [y])
+
+    def test_guard_off_keeps_legacy_behavior(self):
+        model = self._toy(budget=None)
+        x = np.full((1, 4), np.nan, np.float32)
+        y = np.zeros((1, 2), np.float32)
+        loss, _ = model.train_batch([x], [y])  # no raise, update applies
+        assert not np.isfinite(loss)
+
+
+# -- lint: no new bare excepts --------------------------------------------
+class TestBareExceptLint:
+    def test_package_is_clean(self):
+        out = subprocess.run(
+            [sys.executable, "tools/lint_bare_except.py"],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_linter_catches_bare_except(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+        out = subprocess.run(
+            [sys.executable, "/root/repo/tools/lint_bare_except.py",
+             str(tmp_path)],
+            capture_output=True, text=True)
+        assert out.returncode == 1
+        assert "bad.py:3" in out.stdout

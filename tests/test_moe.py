@@ -18,7 +18,7 @@ from paddle_tpu.distributed.moe import (MoELayer, global_gather,
                                         global_scatter, gshard_gating,
                                         limit_by_capacity, switch_gating)
 
-shard_map = jax.shard_map
+from paddle_tpu.distributed.sequence_parallel import shard_map
 
 pytestmark = pytest.mark.skipif(
     jax.device_count() < 8, reason="needs the 8-device CPU mesh")

@@ -344,8 +344,10 @@ class TestFusionEvidence:
                      and "parameter" not in l]
         # unfused, the chain (bias add, dropout select, residual add,
         # mean-subtract, var-normalize, scale, shift) would write the
-        # full tensor 7+ times; fused it is <= 4 kernel outputs
-        assert len(producing) <= 4, (len(producing), producing)
+        # full tensor 7+ times; fused it is a handful of kernel outputs
+        # (4 on current XLA, 5 on the 0.4.x CPU backend which splits the
+        # select+add epilogue into its own fusion)
+        assert len(producing) <= 5, (len(producing), producing)
 
 
 class TestLinearCrossEntropy:

@@ -9,15 +9,22 @@ from paddle_tpu import amp, nn, optimizer as opt
 
 
 def _quadratic_setup():
-    """Minimize ||Wx - y||^2 over W; convex, any optimizer should descend."""
+    """Minimize ||Wx - y||^2 over W; convex, any optimizer should descend.
+
+    Returns the problem's OPTIMAL loss too: with random y the optimum is
+    a large irreducible residual that depends on the draw (and therefore
+    on the jax version's key stream), so descent must be judged on the
+    excess loss above it, not on the raw value."""
     model = nn.Linear(4, 4, bias_attr=False)
     x = pt.randn((32, 4))
     y = pt.randn((32, 4))
+    w_opt, *_ = np.linalg.lstsq(np.asarray(x), np.asarray(y), rcond=None)
+    l_opt = float(np.mean((np.asarray(x) @ w_opt - np.asarray(y)) ** 2))
 
     def loss_fn(params):
         return jnp.mean((model.apply(params, x) - y) ** 2)
 
-    return model, loss_fn
+    return model, loss_fn, l_opt
 
 
 @pytest.mark.parametrize("cls,kwargs", [
@@ -31,7 +38,7 @@ def _quadratic_setup():
     (opt.AdamMax, dict(learning_rate=0.05)),
 ])
 def test_optimizer_descends(cls, kwargs):
-    model, loss_fn = _quadratic_setup()
+    model, loss_fn, l_opt = _quadratic_setup()
     o = cls(**kwargs)
     params = model.trainable_variables()
     state = o.init(params)
@@ -39,7 +46,8 @@ def test_optimizer_descends(cls, kwargs):
     for _ in range(60):
         grads = jax.grad(loss_fn)(params)
         params, state = o.apply_gradients(grads, params, state)
-    assert float(loss_fn(params)) < 0.5 * l0
+    # at least halve the excess loss over the analytic optimum
+    assert float(loss_fn(params)) - l_opt < 0.5 * (l0 - l_opt)
 
 
 def test_adam_matches_reference_formula():
@@ -105,7 +113,7 @@ def test_lr_schedules():
 
 
 def test_scheduler_inside_optimizer():
-    model, loss_fn = _quadratic_setup()
+    model, loss_fn, _ = _quadratic_setup()
     sched = opt.lr.StepDecay(0.1, step_size=5, gamma=0.5)
     o = opt.SGD(learning_rate=sched)
     params = model.trainable_variables()

@@ -12,7 +12,8 @@ import paddle_tpu as pt
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.sequence_parallel import (ring_attention,
-                                                      ring_attention_sharded)
+                                                      ring_attention_sharded,
+                                                      shard_map)
 from paddle_tpu.framework import random as fw_random
 from paddle_tpu.nn import functional as F
 
@@ -43,7 +44,7 @@ class TestRingAttention:
             q, k, v, is_causal=True, dropout_p=0.0, training=False)
         mesh = _mesh((4,), ("sp",))
 
-        out = jax.jit(lambda q, k, v: jax.shard_map(
+        out = jax.jit(lambda q, k, v: shard_map(
             lambda a, b, c: ring_attention(a, b, c, "sp"),
             mesh=mesh, in_specs=P(None, None, "sp", None),
             out_specs=P(None, None, "sp", None))(q, k, v))(q, k, v)
@@ -55,7 +56,7 @@ class TestRingAttention:
         ref = F.scaled_dot_product_attention(
             q, k, v, is_causal=False, dropout_p=0.0, training=False)
         mesh = _mesh((4,), ("sp",))
-        out = jax.jit(lambda q, k, v: jax.shard_map(
+        out = jax.jit(lambda q, k, v: shard_map(
             lambda a, b, c: ring_attention(a, b, c, "sp", causal=False),
             mesh=mesh, in_specs=P(None, None, "sp", None),
             out_specs=P(None, None, "sp", None))(q, k, v))(q, k, v)
@@ -67,7 +68,7 @@ class TestRingAttention:
         mesh = _mesh((4,), ("sp",))
 
         def ring_loss(q, k, v):
-            out = jax.shard_map(
+            out = shard_map(
                 lambda a, b, c: ring_attention(a, b, c, "sp"),
                 mesh=mesh, in_specs=P(None, None, "sp", None),
                 out_specs=P(None, None, "sp", None))(q, k, v)

@@ -28,7 +28,8 @@ fi
 
 if (( SHARD == 0 )); then
     python tools/print_signatures.py --check
+    python tools/lint_bare_except.py
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
-    echo "api-guard + bench smoke ok"
+    echo "api-guard + bare-except lint + bench smoke ok"
 fi
 echo "shard ${SHARD} green"

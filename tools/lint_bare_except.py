@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Reject bare ``except:`` clauses in paddle_tpu/ (resilience hygiene).
+
+A bare except swallows KeyboardInterrupt/SystemExit and — worse for the
+fault-tolerance layer — silently eats the SIGTERM-driven control flow and
+corruption errors the restore fallback chain depends on seeing.  Every
+handler must name what it catches (``except Exception:`` at minimum).
+
+Usage: ``python tools/lint_bare_except.py [root ...]`` (default:
+``paddle_tpu/``).  Exits 1 listing ``file:line`` for every violation.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+
+def find_bare_excepts(path: str):
+    with open(path, "rb") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(getattr(e, "lineno", 0) or 0, f"syntax error: {e.msg}")]
+    return [(node.lineno, "bare except") for node in ast.walk(tree)
+            if isinstance(node, ast.ExceptHandler) and node.type is None]
+
+
+def main(argv):
+    roots = argv or [os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "paddle_tpu")]
+    violations = []
+    checked = 0
+    for root in roots:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                checked += 1
+                for lineno, what in find_bare_excepts(full):
+                    violations.append(f"{os.path.relpath(full)}:{lineno}: "
+                                      f"{what}")
+    if violations:
+        print("\n".join(violations))
+        print(f"\n{len(violations)} bare except clause(s) found — name the "
+              "exception (at minimum `except Exception:`)")
+        return 1
+    print(f"bare-except lint: {checked} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
