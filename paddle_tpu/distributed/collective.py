@@ -192,10 +192,24 @@ def split(x, group: str = "mp", axis: int = -1):
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=ax)
 
 
-def barrier(group: Optional[str] = None):
-    """No-op under SPMD: one program, one schedule — XLA's execution model is
-    the barrier (reference collective/barrier_op.cc is an allreduce on a
-    scalar; that trick is unnecessary here)."""
+def barrier(group: Optional[str] = None, timeout: Optional[float] = None):
+    """Host-side rendezvous.  Inside a traced program this is a no-op
+    (one program, one schedule — XLA's execution model is the barrier;
+    reference collective/barrier_op.cc is an allreduce on a scalar).
+    Called from host code on a multi-process run it blocks until every
+    process arrives — and that wait is exactly where a dead or wedged
+    peer hangs the fleet, so it runs under the run supervisor's watchdog
+    when one is installed: instead of blocking forever the caller gets a
+    ``StepTimeout`` (plus an all-thread stack dump in the supervisor
+    report).  ``timeout`` overrides the watchdog's default deadline for
+    this wait only."""
+    from ..supervisor.watchdog import guarded
+    if _in_axis(group):
+        return None  # traced: SPMD already orders the program
+    with guarded("collective.barrier", timeout=timeout):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu.barrier")
     return None
 
 

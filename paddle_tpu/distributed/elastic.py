@@ -107,8 +107,10 @@ class ElasticTrainState:
     """
 
     def __init__(self, directory: str, save_interval_steps: int = 1000,
-                 keep: int = 2, install_sigterm_handler: bool = True):
+                 keep: int = 2, install_sigterm_handler: bool = True,
+                 event_sink: Optional[Callable] = None):
         self.directory = directory
+        self._event_sink = event_sink
         self.save_interval_steps = int(save_interval_steps)
         self.keep = keep
         self._pending: Optional[AsyncSaveHandle] = None
@@ -123,6 +125,28 @@ class ElasticTrainState:
                     signal.SIGTERM, self._on_sigterm)
             except ValueError:  # not the main thread
                 self._prev_handler = None
+
+    # -- supervision hookup (ISSUE 2) --------------------------------------
+    def set_event_sink(self, sink: Optional[Callable]) -> None:
+        """``sink(kind, **fields)`` — the run supervisor's report; every
+        quarantine/restore decision becomes a recorded event so rollback
+        can target (and post-mortems can explain) the right step."""
+        self._event_sink = sink
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._event_sink is not None:
+            try:
+                self._event_sink(kind, **fields)
+            except Exception as e:
+                vlog(0, "elastic: event sink failed for %s: %s", kind, e)
+
+    def last_good_step(self) -> int:
+        """Newest committed (restorable) step number, -1 when none exist —
+        the step auto-rollback will land on."""
+        done = committed_checkpoints(self.directory)
+        if not done:
+            return -1
+        return int(os.path.basename(done[0])[len(_STEP_PREFIX):])
 
     # -- save --------------------------------------------------------------
     def _path(self, step: int) -> str:
@@ -224,15 +248,19 @@ class ElasticTrainState:
                 vlog(0, "elastic: %s restoring %s (%s) — quarantining and "
                      "falling back to the previous committed step",
                      kind, path, e)
-                self._quarantine(path)
+                self._quarantine(path, reason=kind, error=str(e))
         return init_fn(), 0
 
-    def _quarantine(self, path: str) -> None:
+    def _quarantine(self, path: str, reason: str = "corruption",
+                    error: str = "") -> None:
         dst = path + _CORRUPT_SUFFIX
         if os.path.isdir(dst):
             shutil.rmtree(dst)
         os.replace(path, dst)
         fsio.fsync_dir(self.directory)
+        self._emit("checkpoint_quarantined", path=path, step=_step_of(
+            os.path.basename(path)), reason=reason, error=error,
+            next_good_step=self.last_good_step())
 
     # -- preemption --------------------------------------------------------
     def _on_sigterm(self, signum, frame) -> None:

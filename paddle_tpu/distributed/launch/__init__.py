@@ -98,6 +98,10 @@ def launch(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--node_rank", type=int, default=None,
                    help="run ONLY this rank (real multi-host: one launcher "
                         "per host); default spawns all ranks locally")
+    p.add_argument("--run_dir", default=os.environ.get("PTPU_RUN_DIR"),
+                   help="supervised run directory: the launcher monitors "
+                        "<run_dir>/heartbeats and logs/records run-state "
+                        "transitions (healthy/degraded/lost-worker)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -129,9 +133,46 @@ def launch(argv: Optional[List[str]] = None) -> int:
                "--nnodes", str(args.nnodes), "--master", args.master,
                "--node_rank", str(rank), args.script] + list(args.script_args)
         procs.append(subprocess.Popen(cmd, env=env_for(rank)))
+    stop_monitor = None
+    if args.run_dir:
+        stop_monitor = _monitor_heartbeats(args.run_dir, args.nnodes)
     rc = 0
     for rank, proc in enumerate(procs):
         code = proc.wait()
         vlog(1, "rank %d exited with %d", rank, code)
         rc = rc or code
+    if stop_monitor is not None:
+        stop_monitor()
     return rc
+
+
+def _monitor_heartbeats(run_dir: str, nnodes: int):
+    """Launcher-side health view (ISSUE 2): poll the workers' heartbeat
+    files and record every healthy/degraded/lost-worker transition in
+    ``<run_dir>/launcher_report.json`` — the acting end of the heartbeat
+    subsystem (the relaunch decision itself belongs to the cluster
+    scheduler, ≙ the reference ElasticManager's watch loop).  Returns a
+    callable that stops the monitor and does one final poll."""
+    import threading
+
+    from ...supervisor.heartbeat import HeartbeatMonitor, default_interval
+    from ...supervisor.report import SupervisorReport
+
+    report = SupervisorReport(os.path.join(run_dir, "launcher_report.json"))
+    monitor = HeartbeatMonitor(run_dir, expected=nnodes, report=report)
+    stop = threading.Event()
+
+    def poll_loop():
+        while not stop.wait(default_interval()):
+            monitor.poll()
+
+    t = threading.Thread(target=poll_loop, name="ptpu-launch-monitor",
+                         daemon=True)
+    t.start()
+
+    def stop_fn():
+        stop.set()
+        t.join(timeout=2.0)
+        monitor.poll()
+
+    return stop_fn

@@ -41,6 +41,7 @@ class Model:
         self._amp_level = None
         self._nonfinite_budget: Optional[int] = None
         self._nonfinite_skipped = 0
+        self._supervisor = None  # set by RunSupervisor.attach / fit()
 
     # -- setup ------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -94,7 +95,13 @@ class Model:
             # runtime-togglable — the host only LOOKS at these when the
             # flag is set at call time (train_batch)
             finite = debug.finite_flags({"loss": loss_v, "grads": grads})
-            return loss_v, out, merged, new_opt_state, finite
+            # grad global norm: one fused reduction, fed to the run
+            # supervisor's divergence guard (f32 accumulate so a bf16
+            # overflow can't hide inside the statistic itself)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads)) + 0.0)
+            return loss_v, out, merged, new_opt_state, finite, gnorm
 
         def eval_fn(params, *data):
             *inputs, label = data
@@ -120,6 +127,7 @@ class Model:
                 (*_tuplify(inputs), *_tuplify(labels))]
         key = fw_random.next_key()
         from ..optimizer import lr as lr_mod
+        sup = self._supervisor
         lr_override = None
         if isinstance(getattr(self._optimizer, "_lr", None),
                       lr_mod.LRScheduler):
@@ -127,11 +135,35 @@ class Model:
             # (the LRScheduler callback, or the user) calls .step()
             lr_override = jnp.asarray(self._optimizer._lr.get_lr(),
                                       jnp.float32)
-        loss, out, new_params, new_opt_state, finite = self._train_step(
-            trainable, rest, self._opt_state, key, lr_override, *data)
+        if sup is not None and sup.guard.lr_scale != 1.0:
+            # divergence guard's LOWER_LR escalation: sticky backoff on
+            # top of whatever schedule is active
+            lr_override = jnp.asarray(
+                self._optimizer.get_lr() * sup.guard.lr_scale, jnp.float32)
+        if sup is not None:
+            # the armed region covers the jitted step AND the host sync on
+            # its results — where a hung collective actually blocks
+            with sup.watchdog.armed("train_batch"):
+                loss, out, new_params, new_opt_state, finite, gnorm = \
+                    self._train_step(trainable, rest, self._opt_state, key,
+                                     lr_override, *data)
+                loss_v = sup.filter_loss(float(loss))
+                gnorm_v = float(gnorm)
+            action = sup.guard_step(loss_v, gnorm_v,
+                                    amp_active=bool(self._amp_level))
+            from ..supervisor.guard import GuardAction
+            if action != GuardAction.OK:
+                # SKIP / LOWER_LR / ROLLBACK all drop this batch's update
+                # (params AND optimizer state); ROLLBACK is latched on the
+                # supervisor for the driving loop to execute
+                return loss_v, [m.accumulate() for m in self._metrics]
+        else:
+            loss, out, new_params, new_opt_state, finite, _gnorm = \
+                self._train_step(trainable, rest, self._opt_state, key,
+                                 lr_override, *data)
+            loss_v = float(loss)
         if debug.check_nan_inf_enabled():
             debug.assert_all_finite(finite, context="train_batch")
-        loss_v = float(loss)
         if self._nonfinite_budget is not None and not math.isfinite(loss_v):
             # skip-step: drop this batch's update entirely (params AND
             # optimizer state) so one bad batch degrades gracefully;
@@ -173,7 +205,12 @@ class Model:
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir: Optional[str] = None, shuffle: bool = True,
             num_workers: int = 0, verbose: int = 1, drop_last: bool = False,
-            callbacks=None):
+            callbacks=None, supervisor=None):
+        """``supervisor``: a :class:`paddle_tpu.supervisor.RunSupervisor`
+        wrapping this run in the full health loop — watchdog around every
+        batch, heartbeats, divergence guard (skip → lower-LR → rollback),
+        and budget-bounded auto-rollback to the last committed
+        checkpoint.  See docs/ARCHITECTURE.md "Run supervision"."""
         from ..optimizer import lr as lr_mod
         from .callbacks import (CallbackList, LRScheduler as LRSchedulerCB,
                                 ModelCheckpoint, ProgBarLogger)
@@ -200,45 +237,118 @@ class Model:
                         "verbose": verbose, "save_dir": save_dir})
         self.stop_training = False
         history = {"loss": []}
+        sup = supervisor
+        if sup is not None:
+            from ..supervisor.guard import GuardAction
+            from ..supervisor.watchdog import StepTimeout
+            sup.attach(self)
+            if self._optimizer is not None and self._opt_state is None:
+                # warm the optimizer state so every supervised checkpoint
+                # (including the rollback templates) has one stable pytree
+                self._opt_state = self._optimizer.init(
+                    self.network.trainable_variables())
+            sup.begin_run(initial_state=self._supervised_state())
         cbs.on_train_begin()
-        for epoch in range(epochs):
-            for m in self._metrics:
-                m.reset()
-            cbs.on_epoch_begin(epoch)
-            epoch_losses = []
-            for step, batch in enumerate(train_loader):
-                cbs.on_train_batch_begin(step)
-                *inputs, label = batch
-                loss, metrics = self.train_batch(inputs, label)
-                history["loss"].append(loss)
-                epoch_losses.append(loss)
-                logs = {"loss": loss}
-                if self._nonfinite_budget is not None:
-                    logs["nonfinite_skipped"] = self._nonfinite_skipped
-                for m, v in zip(self._metrics, metrics):
-                    logs[m.name()] = v[0] if isinstance(v, list) else v
-                cbs.on_train_batch_end(step, logs)
+        try:
+            for epoch in range(epochs):
+                for m in self._metrics:
+                    m.reset()
+                cbs.on_epoch_begin(epoch)
+                epoch_losses = []
+                for step, batch in enumerate(train_loader):
+                    cbs.on_train_batch_begin(step)
+                    *inputs, label = batch
+                    if sup is not None:
+                        try:
+                            loss, metrics = self.train_batch(inputs, label)
+                        except StepTimeout:
+                            # watchdog fired: the step is dead, not the
+                            # run — skip it, roll back when they repeat
+                            if (sup.note_step_failure("step-timeout")
+                                    == GuardAction.ROLLBACK):
+                                self._supervised_rollback(sup)
+                            cbs.on_train_batch_end(
+                                step, {"loss": float("nan"),
+                                       "supervisor": "step-timeout"})
+                            if self.stop_training:
+                                break
+                            continue
+                        good = sup.last_action in (None, GuardAction.OK)
+                        if sup.pending_rollback:
+                            self._supervised_rollback(sup)
+                        else:
+                            # checkpoint only states a good update built
+                            sup.note_step_ok(
+                                self._supervised_state() if good else None)
+                    else:
+                        good = True
+                        loss, metrics = self.train_batch(inputs, label)
+                    history["loss"].append(loss)
+                    if good:
+                        epoch_losses.append(loss)
+                    logs = {"loss": loss}
+                    if sup is not None and not good:
+                        logs["supervisor"] = sup.last_action
+                    if self._nonfinite_budget is not None:
+                        logs["nonfinite_skipped"] = self._nonfinite_skipped
+                    for m, v in zip(self._metrics, metrics):
+                        logs[m.name()] = v[0] if isinstance(v, list) else v
+                    cbs.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
+                # with a skip guard on, skipped batches' nan losses are
+                # excluded from the epoch mean (they applied no update)
+                _mean = (np.nanmean if self._nonfinite_budget is not None
+                         else np.mean)
+                epoch_logs = {"loss": float(_mean(epoch_losses))
+                              if epoch_losses else float("nan")}
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    cbs.on_eval_begin()
+                    eval_res = self.evaluate(eval_data,
+                                             batch_size=batch_size,
+                                             verbose=verbose)
+                    cbs.on_eval_end(eval_res)
+                    # eval metrics reach on_epoch_end (EarlyStopping
+                    # monitors)
+                    epoch_logs.update({f"eval_{k}" if k == "loss" else k: v
+                                       for k, v in eval_res.items()})
+                cbs.on_epoch_end(epoch, epoch_logs)
                 if self.stop_training:
                     break
-            # with the skip-step guard on, skipped batches' nan losses are
-            # excluded from the epoch mean (they applied no update)
-            _mean = (np.nanmean if self._nonfinite_budget is not None
-                     else np.mean)
-            epoch_logs = {"loss": float(_mean(epoch_losses))
-                          if epoch_losses else float("nan")}
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                cbs.on_eval_begin()
-                eval_res = self.evaluate(eval_data, batch_size=batch_size,
-                                         verbose=verbose)
-                cbs.on_eval_end(eval_res)
-                # eval metrics reach on_epoch_end (EarlyStopping monitors)
-                epoch_logs.update({f"eval_{k}" if k == "loss" else k: v
-                                   for k, v in eval_res.items()})
-            cbs.on_epoch_end(epoch, epoch_logs)
-            if self.stop_training:
-                break
+        except BaseException:
+            if sup is not None:
+                sup.end_run("failed")
+                self._supervisor = None
+            raise
+        if sup is not None:
+            sup.end_run("completed")
+            self._supervisor = None
         cbs.on_train_end()
         return history
+
+    # -- supervision plumbing (ISSUE 2) -----------------------------------
+    def _supervised_state(self):
+        """The pytree the run supervisor checkpoints and rolls back —
+        parameters + buffers, plus optimizer state once it exists."""
+        state = {"params": dict(self.network.state_dict())}
+        if self._opt_state is not None:
+            state["opt"] = self._opt_state
+        return state
+
+    def _load_supervised_state(self, state) -> None:
+        self.network.set_state_dict(state["params"], strict=False)
+        if "opt" in state:
+            self._opt_state = state["opt"]
+
+    def _supervised_rollback(self, sup, reason: Optional[str] = None
+                             ) -> None:
+        """Restore the last committed good step into the live model (the
+        pristine t0 state when nothing has been committed yet)."""
+        state, _start = sup.perform_rollback(
+            lambda: (sup.initial_state if sup.initial_state is not None
+                     else self._supervised_state()),
+            lambda: self._supervised_state(), reason)
+        self._load_supervised_state(state)
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
                  verbose: int = 1, num_workers: int = 0):

@@ -459,7 +459,7 @@ class DataLoader:
             for _ in workers:
                 try:
                     index_queue.put(None)
-                except Exception:
+                except Exception:  # noqa: swallow — best-effort shutdown
                     pass
             for w in workers:
                 w.join(timeout=1.0)

@@ -32,8 +32,11 @@ def retry_reader(reader: Callable, max_attempts: int = 3,
     delivered, with exponential backoff between attempts.  The error
     budget resets after each successfully delivered sample, so one flaky
     sample can't starve a long epoch.  Non-retryable exceptions propagate
-    immediately."""
-    from .utils.retry import RetryPolicy
+    immediately; when the budget is exhausted a
+    :class:`~paddle_tpu.utils.retry.RetriesExhausted` (an ``OSError``)
+    carrying the attempt count is raised, chained to the final
+    underlying error."""
+    from .utils.retry import RetriesExhausted, RetryPolicy
 
     policy = RetryPolicy(max_attempts=max_attempts, base_delay=base_delay,
                          retryable=tuple(retryable),
@@ -52,10 +55,12 @@ def retry_reader(reader: Callable, max_attempts: int = 3,
                     delivered += 1
                     failures = 0
                 return
-            except policy.retryable:
+            except policy.retryable as e:
                 failures += 1
                 if failures >= policy.max_attempts:
-                    raise
+                    raise RetriesExhausted(
+                        f"reader failed after {failures} attempt(s) at "
+                        f"sample {delivered}; last error: {e!r}") from e
                 policy.sleep(policy.delay(failures))
     return robust
 

@@ -1,0 +1,220 @@
+"""Run supervisor — the run-level half of the resilience story (ISSUE 2).
+
+PR 1 made checkpoint *storage* fault-tolerant; this package supervises
+the *run* built on top of it.  Four cooperating pieces:
+
+- :mod:`watchdog` — a deadline armed around every train step / blocking
+  collective; a hang becomes a stack-dumped, reported ``StepTimeout``.
+- :mod:`heartbeat` — per-worker beat files through the fsync'd ``fsio``
+  seam + a monitor classifying the run healthy/degraded/lost-worker.
+- :mod:`guard` — rolling loss/grad-norm statistics escalating
+  skip → lower-LR → rollback (AMP-aware about loss-scale overflows).
+- :mod:`rollback` — budget-bounded restore from the newest committed
+  good checkpoint (``ElasticTrainState.restore_or``).
+
+Everything the supervisor sees and does is recorded in
+:class:`~paddle_tpu.supervisor.report.SupervisorReport` — the JSON
+post-mortem a dead run leaves behind.
+
+:class:`RunSupervisor` composes the four around ``hapi.Model.fit``:
+
+>>> sup = RunSupervisor("runs/gpt3", save_interval_steps=100)
+>>> model.fit(data, epochs=1, supervisor=sup)
+
+State machine (docs/ARCHITECTURE.md "Run supervision"):
+healthy → degraded (stale peers / skipped batches) → rollback
+(escalated divergence or repeated step failure, budget-bounded) →
+failed (budget exhausted: ``RollbackBudgetExceeded`` + report).
+
+Env knobs: ``PTPU_WATCHDOG_SECS`` (step deadline, default 300),
+``PTPU_HEARTBEAT_SECS`` (beat interval, default 10),
+``PTPU_ROLLBACK_BUDGET`` (restores before failing loudly, default 2).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..framework.log import vlog
+from .guard import DivergenceGuard, GuardAction
+from .heartbeat import (HeartbeatMonitor, HeartbeatWriter, RunState,
+                        heartbeat_dir)
+from .report import SupervisorReport
+from .rollback import RollbackBudgetExceeded, RollbackManager
+from .watchdog import (StepTimeout, Watchdog, global_watchdog, guarded,
+                       install_global)
+
+__all__ = [
+    "RunSupervisor", "SupervisorReport", "Watchdog", "StepTimeout",
+    "HeartbeatWriter", "HeartbeatMonitor", "RunState", "DivergenceGuard",
+    "GuardAction", "RollbackManager", "RollbackBudgetExceeded",
+    "install_global", "global_watchdog", "guarded", "heartbeat_dir",
+]
+
+
+class RunSupervisor:
+    """One object wrapping a training run in the full health loop.
+
+    ``elastic`` may be an existing ``ElasticTrainState``; otherwise one
+    is created under ``<run_dir>/checkpoints``.  ``reseed`` (optional)
+    is called with the restored start step after every rollback — the
+    data-pipeline reseeding hook.
+    """
+
+    def __init__(self, run_dir: str, *, elastic=None,
+                 save_interval_steps: int = 1000,
+                 watchdog_secs: Optional[float] = None,
+                 heartbeat_secs: Optional[float] = None,
+                 rollback_budget: Optional[int] = None,
+                 step_failure_budget: int = 1,
+                 guard: Optional[DivergenceGuard] = None,
+                 worker_id: Optional[int] = None,
+                 expected_workers: Optional[int] = None,
+                 reseed: Optional[Callable[[int], None]] = None,
+                 report_path: Optional[str] = None,
+                 sigterm_handler: bool = True, clock=time.time):
+        os.makedirs(run_dir, exist_ok=True)
+        self.run_dir = run_dir
+        self.report = SupervisorReport(
+            report_path if report_path is not None
+            else os.path.join(run_dir, "supervisor_report.json"),
+            clock=clock)
+        if elastic is None:
+            from ..distributed.elastic import ElasticTrainState
+            elastic = ElasticTrainState(
+                os.path.join(run_dir, "checkpoints"),
+                save_interval_steps=save_interval_steps,
+                install_sigterm_handler=sigterm_handler)
+        self.elastic = elastic
+        if hasattr(self.elastic, "set_event_sink"):
+            self.elastic.set_event_sink(self.report.record)
+        self.watchdog = Watchdog(timeout=watchdog_secs, report=self.report)
+        self.heartbeat = HeartbeatWriter(
+            run_dir, worker_id=worker_id, interval=heartbeat_secs,
+            clock=clock)
+        self.monitor = HeartbeatMonitor(
+            run_dir, expected=expected_workers, clock=clock,
+            report=self.report)
+        self.guard = guard or DivergenceGuard(report=self.report)
+        if self.guard.report is None:
+            self.guard.report = self.report
+        self.rollback = RollbackManager(
+            self.elastic, budget=rollback_budget, report=self.report,
+            reseed=reseed)
+        self.step_failure_budget = int(step_failure_budget)
+        self.pending_rollback: Optional[str] = None
+        self.last_action: Optional[str] = None
+        self.initial_state: Any = None
+        self.gstep = 0
+        self.consecutive_step_failures = 0
+        self._clock = clock
+        self._last_poll = 0.0
+        self._prev_global: Optional[Watchdog] = None
+        self._running = False
+        self._loss_injectors: List[Callable[[int, float], float]] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def begin_run(self, initial_state: Any = None) -> "RunSupervisor":
+        if not self._running:
+            self._running = True
+            if initial_state is not None:
+                self.initial_state = initial_state
+            if self.watchdog._closed:  # supervisor reused across runs
+                self.watchdog = Watchdog(timeout=self.watchdog.timeout,
+                                         report=self.report)
+            self.report.record("run_start", run_dir=self.run_dir,
+                               worker=self.heartbeat.worker_id,
+                               watchdog_secs=self.watchdog.timeout,
+                               heartbeat_secs=self.heartbeat.interval,
+                               rollback_budget=self.rollback.budget)
+            self.heartbeat.start()
+            self._prev_global = install_global(self.watchdog)
+        return self
+
+    def end_run(self, status: str = "completed") -> None:
+        if not self._running:
+            return
+        self._running = False
+        self.heartbeat.stop()
+        install_global(self._prev_global)
+        self.watchdog.close()
+        self.report.record("run_end", status=status, step=self.gstep,
+                           rollbacks=self.rollback.used,
+                           timeouts=self.watchdog.timeouts,
+                           bad_batches=self.guard.total_bad)
+
+    def attach(self, model) -> "RunSupervisor":
+        """Bind to a ``hapi.Model`` so ``train_batch`` consults the guard
+        and arms the watchdog even outside ``fit``."""
+        model._supervisor = self
+        return self
+
+    def __enter__(self) -> "RunSupervisor":
+        return self.begin_run()
+
+    def __exit__(self, exc_type, *exc) -> None:
+        self.end_run("failed" if exc_type else "completed")
+
+    # -- per-step protocol -------------------------------------------------
+    def inject_loss(self, fn: Callable[[int, float], float]) -> None:
+        """Test seam: ``fn(step, loss) -> loss`` runs on every host-side
+        loss before the guard sees it (``testing.faults.diverge_after``
+        and ``hang`` plug in here)."""
+        self._loss_injectors.append(fn)
+
+    def filter_loss(self, loss: float) -> float:
+        for fn in self._loss_injectors:
+            loss = fn(self.gstep, loss)
+        return loss
+
+    def guard_step(self, loss: float, grad_norm: Optional[float] = None,
+                   amp_active: bool = False) -> str:
+        """Guard verdict for this step's statistics; a ROLLBACK verdict is
+        latched in ``pending_rollback`` for the driving loop to execute."""
+        action = self.guard.observe(self.gstep, loss, grad_norm,
+                                    amp_active=amp_active)
+        self.last_action = action
+        if action == GuardAction.ROLLBACK:
+            self.pending_rollback = "divergence"
+        return action
+
+    def note_step_ok(self, state: Any = None) -> None:
+        self.consecutive_step_failures = 0
+        self.gstep += 1
+        self.heartbeat.maybe_beat(self.gstep)
+        self.maybe_poll()
+        if state is not None:
+            self.elastic.maybe_save(self.gstep, state)
+
+    def note_step_failure(self, reason: str = "step-timeout") -> str:
+        """SKIP while repeated failures stay inside the budget; beyond it
+        the failing step is a symptom, not an accident → ROLLBACK."""
+        self.consecutive_step_failures += 1
+        self.report.record("step_failure", step=self.gstep, reason=reason,
+                           consecutive=self.consecutive_step_failures)
+        if self.consecutive_step_failures > self.step_failure_budget:
+            self.pending_rollback = reason
+            return GuardAction.ROLLBACK
+        return GuardAction.SKIP
+
+    def maybe_poll(self) -> None:
+        """Heartbeat-health poll, throttled to half the stale window."""
+        now = float(self._clock())
+        if now - self._last_poll >= self.monitor.stale_after / 2.0:
+            self._last_poll = now
+            self.monitor.poll()
+
+    def perform_rollback(self, init_fn: Callable[[], Any],
+                         template_fn: Callable[[], Any],
+                         reason: Optional[str] = None) -> Tuple[Any, int]:
+        reason = reason or self.pending_rollback or "requested"
+        state, start = self.rollback.rollback(init_fn, template_fn,
+                                              reason=reason)
+        self.pending_rollback = None
+        self.consecutive_step_failures = 0
+        self.guard.reset_after_rollback()
+        vlog(0, "supervisor: rewound step counter %d → %d", self.gstep,
+             start)
+        self.gstep = start
+        return state, start

@@ -39,7 +39,8 @@ from ..utils import fsio
 from ..utils.retry import RetryPolicy
 
 __all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
-           "corrupt_manifest", "fast_retries"]
+           "corrupt_manifest", "fast_retries", "hang", "slow_call",
+           "diverge_after"]
 
 
 def _default_transient() -> OSError:
@@ -85,6 +86,13 @@ class FaultInjector:
         self._rules.append(("sigterm", nth))
         return self
 
+    def hang_on_write(self, nth: int, seconds: float) -> "FaultInjector":
+        """Stall the Nth write for ``seconds`` (a wedged NFS server) —
+        interruptibly, so a supervisor watchdog's ``StepTimeout`` can cut
+        it short (ISSUE 2)."""
+        self._rules.append(("hang", nth, seconds))
+        return self
+
     # -- interception ------------------------------------------------------
     def __enter__(self) -> "FaultInjector":
         self._orig = fsio.write_bytes
@@ -116,6 +124,10 @@ class FaultInjector:
                 self._orig(path, payload)
                 os.kill(os.getpid(), _signal.SIGTERM)
                 return None
+            if kind == "hang" and n == rule[1]:
+                self.injected.append((n, kind, path))
+                hang(rule[2])
+                return self._orig(path, payload)
         return self._orig(path, payload)
 
 
@@ -158,6 +170,65 @@ def corrupt_manifest(ckpt_dir: str, keep_bytes: int = 16) -> str:
              or [os.path.join(ckpt_dir, "manifest.json")])
     truncate_file(names[0], keep_bytes)
     return names[0]
+
+
+# -- run-level fault injectors (ISSUE 2: supervisor drills) ----------------
+def hang(seconds: float, interval: float = 0.01) -> None:
+    """Block for ``seconds`` in short interruptible slices — a simulated
+    hung collective/step.  Unlike one long ``time.sleep`` this yields a
+    bytecode boundary every ``interval``, so the watchdog's async
+    ``StepTimeout`` lands promptly instead of after the full hang."""
+    import time as _time
+
+    deadline = _time.monotonic() + float(seconds)
+    while _time.monotonic() < deadline:
+        _time.sleep(interval)
+
+
+def slow_call(fn: Callable, seconds: float) -> Callable:
+    """Wrap ``fn`` to stall (interruptibly) for ``seconds`` before every
+    call — slow-but-alive, the case a watchdog must NOT fire on when the
+    deadline is generous enough."""
+    import functools
+
+    @functools.wraps(fn)
+    def slowed(*args, **kwargs):
+        hang(seconds)
+        return fn(*args, **kwargs)
+    return slowed
+
+
+class diverge_after:
+    """Loss injector for the divergence-guard path: identity until
+    ``step``, then poisons every observed loss — ``mode="spike"`` grows
+    it by ``factor`` each step (finite blow-up), ``mode="nan"`` /
+    ``mode="inf"`` go non-finite at once.  Plugs into
+    ``RunSupervisor.inject_loss`` (called as ``fn(step, loss)``); also
+    works standalone against ``DivergenceGuard.observe``.  ``triggered``
+    counts poisoned steps; ``count`` bounds them (``None`` = keep
+    diverging forever — the genuinely-broken-run drill), so a transient
+    spike that a rollback recovers from is ``count=K``."""
+
+    def __init__(self, step: int, mode: str = "spike",
+                 factor: float = 100.0, count: Optional[int] = None):
+        if mode not in ("spike", "nan", "inf"):
+            raise ValueError(f"unknown divergence mode {mode!r}")
+        self.step = int(step)
+        self.mode = mode
+        self.factor = float(factor)
+        self.count = count
+        self.triggered = 0
+
+    def __call__(self, step: int, loss: float) -> float:
+        if step < self.step or (self.count is not None
+                                and self.triggered >= self.count):
+            return loss
+        self.triggered += 1
+        if self.mode == "nan":
+            return float("nan")
+        if self.mode == "inf":
+            return float("inf")
+        return (abs(loss) + 1.0) * self.factor ** self.triggered
 
 
 @contextlib.contextmanager

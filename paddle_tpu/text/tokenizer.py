@@ -125,7 +125,7 @@ class WordPieceTokenizer:
         if getattr(self, "_handle", None):
             try:
                 self._lib.wp_free(self._handle)
-            except Exception:
+            except Exception:  # noqa: swallow — best-effort finalizer
                 pass
 
     @property

@@ -1,0 +1,465 @@
+"""Run-supervisor proof (ISSUE 2): watchdog, heartbeats, divergence
+guard, auto-rollback, and the post-mortem report.
+
+Fault drills use ``paddle_tpu.testing.faults`` injectors (``hang``,
+``slow_call``, ``diverge_after``, ``hang_on_write``) so no test hangs for
+real: every blocking fault is interruptible and every deadline is short.
+
+End-to-end acceptance (ISSUE 2): with injected hang + injected
+divergence, a hapi training run completes by firing the watchdog,
+skipping / rolling back to the last committed checkpoint, and finishing
+within the rollback budget — with every event recorded in the
+supervisor's JSON report.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.supervisor import (DivergenceGuard, GuardAction,
+                                   HeartbeatMonitor, HeartbeatWriter,
+                                   RollbackBudgetExceeded, RollbackManager,
+                                   RunState, RunSupervisor, StepTimeout,
+                                   SupervisorReport, Watchdog,
+                                   global_watchdog, guarded, install_global)
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+
+# -- report ----------------------------------------------------------------
+class TestReport:
+    def test_record_flush_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "report.json")
+        report = SupervisorReport(path)
+        report.record("watchdog_timeout", label="train_batch")
+        report.record("rollback", reason="divergence", start_step=7)
+        loaded = SupervisorReport.load(path)
+        assert loaded.counts() == {"watchdog_timeout": 1, "rollback": 1}
+        assert loaded.of_kind("rollback")[0]["start_step"] == 7
+
+    def test_durable_after_every_record(self, tmp_path):
+        path = str(tmp_path / "report.json")
+        report = SupervisorReport(path)
+        report.record("step_failure", step=3)
+        # the file on disk already holds the event (post-mortem property)
+        doc = json.loads((tmp_path / "report.json").read_text())
+        assert doc["events"][0]["kind"] == "step_failure"
+
+    def test_memory_only_mode(self):
+        report = SupervisorReport(None)
+        report.record("x")
+        assert report.counts() == {"x": 1}
+
+
+# -- watchdog --------------------------------------------------------------
+class TestWatchdog:
+    def test_fires_on_injected_hang(self):
+        report = SupervisorReport()
+        with Watchdog(timeout=0.25, report=report) as wd:
+            t0 = time.monotonic()
+            with pytest.raises(StepTimeout):
+                with wd.armed("train_batch"):
+                    faults.hang(30.0)
+            # interrupted promptly, not after the full 30s hang
+            assert time.monotonic() - t0 < 5.0
+        (event,) = report.of_kind("watchdog_timeout")
+        assert event["label"] == "train_batch"
+        assert "MainThread" in event["stacks"]  # all-thread dump attached
+        assert wd.timeouts == 1
+
+    def test_does_not_fire_on_slow_but_alive(self):
+        with Watchdog(timeout=5.0) as wd:
+            with wd.armed("step"):
+                faults.slow_call(lambda: "ok", 0.05)()
+            assert wd.timeouts == 0
+
+    def test_per_section_timeout_override(self):
+        with Watchdog(timeout=60.0) as wd:
+            with pytest.raises(StepTimeout):
+                with wd.armed("barrier", timeout=0.2):
+                    faults.hang(30.0)
+
+    def test_global_install_and_guarded(self):
+        assert global_watchdog() is None
+        with Watchdog(timeout=0.2) as wd:
+            prev = install_global(wd)
+            try:
+                with pytest.raises(StepTimeout):
+                    with guarded("collective.barrier"):
+                        faults.hang(30.0)
+            finally:
+                install_global(prev)
+        assert global_watchdog() is None
+
+    def test_guarded_is_noop_without_global(self):
+        with guarded("barrier"):
+            pass  # must not raise nor require a watchdog
+
+    def test_env_knob_seeds_default(self, monkeypatch):
+        monkeypatch.setenv("PTPU_WATCHDOG_SECS", "123.5")
+        wd = Watchdog()
+        wd.close()
+        assert wd.timeout == 123.5
+
+    def test_barrier_runs_under_global_watchdog(self):
+        from paddle_tpu.distributed.collective import barrier
+        # single-process: must complete instantly, armed or not
+        with Watchdog(timeout=5.0) as wd:
+            prev = install_global(wd)
+            try:
+                barrier()
+                barrier(timeout=1.0)
+            finally:
+                install_global(prev)
+            assert wd.timeouts == 0
+
+
+# -- heartbeats ------------------------------------------------------------
+class TestHeartbeat:
+    def test_beat_goes_through_fsio_seam(self, tmp_path):
+        writer = HeartbeatWriter(str(tmp_path), worker_id=0, interval=60)
+        with faults.FaultInjector() as fi:
+            writer.beat(step=5)
+        assert fi.write_count >= 1  # durable write, injectable like all
+        payload = json.loads(writer.path and open(writer.path).read())
+        assert payload["worker"] == 0 and payload["step"] == 5
+
+    def test_staleness_classification(self, tmp_path):
+        clock = {"t": 1000.0}
+        w0 = HeartbeatWriter(str(tmp_path), worker_id=0, interval=1,
+                             clock=lambda: clock["t"])
+        w1 = HeartbeatWriter(str(tmp_path), worker_id=1, interval=1,
+                             clock=lambda: clock["t"])
+        report = SupervisorReport()
+        monitor = HeartbeatMonitor(str(tmp_path), stale_after=3,
+                                   lost_after=9, expected=2,
+                                   clock=lambda: clock["t"], report=report)
+        w0.beat(); w1.beat()
+        assert monitor.poll()["state"] == RunState.HEALTHY
+        # worker 1 goes quiet: stale first...
+        clock["t"] += 5
+        w0.beat()
+        detail = monitor.poll()
+        assert detail["state"] == RunState.DEGRADED
+        assert detail["stale"] == [1]
+        # ...then lost
+        clock["t"] += 6
+        w0.beat()
+        detail = monitor.poll()
+        assert detail["state"] == RunState.LOST_WORKER
+        assert detail["lost"] == [1]
+        # every transition recorded
+        states = [e["state"] for e in report.of_kind("run_state")]
+        assert states == [RunState.HEALTHY, RunState.DEGRADED,
+                          RunState.LOST_WORKER]
+
+    def test_expected_worker_never_appearing_is_lost(self, tmp_path):
+        clock = {"t": 0.0}
+        w0 = HeartbeatWriter(str(tmp_path), worker_id=0, interval=1,
+                             clock=lambda: clock["t"])
+        monitor = HeartbeatMonitor(str(tmp_path), stale_after=3,
+                                   lost_after=9, expected=2,
+                                   clock=lambda: clock["t"])
+        w0.beat()
+        assert monitor.poll()["state"] == RunState.HEALTHY  # grace window
+        clock["t"] += 10
+        w0.beat()
+        detail = monitor.poll()
+        assert detail["state"] == RunState.LOST_WORKER
+        assert detail["missing"] == [1]
+
+    def test_maybe_beat_throttles(self, tmp_path):
+        clock = {"t": 0.0}
+        writer = HeartbeatWriter(str(tmp_path), worker_id=0, interval=10,
+                                 clock=lambda: clock["t"])
+        clock["t"] = 100.0
+        assert writer.maybe_beat(1) is True
+        assert writer.maybe_beat(2) is False  # half-interval not elapsed
+        clock["t"] += 6.0
+        assert writer.maybe_beat(3) is True
+
+
+# -- divergence guard ------------------------------------------------------
+class TestDivergenceGuard:
+    def _guard(self, **kw):
+        kw.setdefault("skip_budget", 2)
+        kw.setdefault("max_lr_backoffs", 1)
+        kw.setdefault("min_history", 2)
+        return DivergenceGuard(**kw)
+
+    def test_escalation_ladder(self):
+        guard = self._guard()
+        for i in range(4):
+            assert guard.observe(i, 1.0) == GuardAction.OK
+        inject = faults.diverge_after(4, mode="spike")
+        seq = [guard.observe(s, inject(s, 1.0)) for s in range(4, 8)]
+        assert seq == [GuardAction.SKIP, GuardAction.SKIP,
+                       GuardAction.LOWER_LR, GuardAction.ROLLBACK]
+        assert guard.lr_scale == 0.5
+
+    def test_one_off_spike_costs_one_update(self):
+        guard = self._guard()
+        for i in range(4):
+            guard.observe(i, 1.0)
+        assert guard.observe(4, 1e6) == GuardAction.SKIP
+        assert guard.observe(5, 1.0) == GuardAction.OK
+        assert guard.consecutive_bad == 0 and guard.total_bad == 1
+
+    def test_nan_and_inf_are_bad(self):
+        guard = self._guard()
+        assert guard.observe(0, float("nan")) == GuardAction.SKIP
+        assert guard.observe(1, float("inf")) == GuardAction.SKIP
+
+    def test_grad_norm_spike_detected(self):
+        guard = self._guard()
+        for i in range(4):
+            guard.observe(i, 1.0, grad_norm=1.0)
+        assert guard.observe(4, 1.0, grad_norm=1e5) == GuardAction.SKIP
+
+    def test_amp_grace_does_not_escalate(self):
+        guard = self._guard(amp_grace=3)
+        # loss-scale search overflows: skipped but never climb the ladder
+        for i in range(3):
+            assert guard.observe(i, float("inf"),
+                                 amp_active=True) == GuardAction.SKIP
+        assert guard.consecutive_bad == 0
+        # grace spent: a further overflow escalates normally
+        assert guard.observe(3, float("inf"), amp_active=True) \
+            == GuardAction.SKIP
+        assert guard.consecutive_bad == 1
+
+    def test_reset_after_rollback_keeps_lowered_lr(self):
+        guard = self._guard()
+        inject = faults.diverge_after(0, mode="nan")
+        for s in range(4):
+            guard.observe(s, inject(s, 1.0))
+        assert guard.lr_scale == 0.5
+        guard.reset_after_rollback()
+        assert guard.consecutive_bad == 0 and guard.lr_scale == 0.5
+        guard.restore_lr()
+        assert guard.lr_scale == 1.0
+
+    def test_diverge_after_modes_and_count(self):
+        nan_inj = faults.diverge_after(2, mode="nan")
+        assert nan_inj(1, 5.0) == 5.0
+        assert np.isnan(nan_inj(2, 5.0))
+        spike = faults.diverge_after(0, mode="spike", factor=10.0, count=2)
+        poisoned = [spike(s, 1.0) for s in range(3)]
+        assert poisoned[0] == 20.0 and poisoned[1] == 200.0
+        assert poisoned[2] == 1.0 and spike.triggered == 2
+
+
+# -- elastic satellites ----------------------------------------------------
+class TestElasticSupervision:
+    def _mgr(self, tmp_path, **kw):
+        from paddle_tpu.distributed.elastic import ElasticTrainState
+        kw.setdefault("install_sigterm_handler", False)
+        return ElasticTrainState(str(tmp_path), **kw)
+
+    def _state(self, seed=0):
+        return {"w": jnp.asarray(np.random.RandomState(seed)
+                                 .randn(8).astype(np.float32))}
+
+    def test_last_good_step(self, tmp_path):
+        mgr = self._mgr(tmp_path, keep=5)
+        assert mgr.last_good_step() == -1
+        mgr.save(3, self._state(3), use_async=False)
+        mgr.save(7, self._state(7), use_async=False)
+        assert mgr.last_good_step() == 7
+
+    def test_quarantine_emits_supervisor_event(self, tmp_path):
+        report = SupervisorReport()
+        mgr = self._mgr(tmp_path, keep=5, event_sink=report.record)
+        mgr.save(1, self._state(1), use_async=False)
+        mgr.save(2, self._state(2), use_async=False)
+        faults.corrupt_shard(str(tmp_path / "step-2"))
+        state, start = mgr.restore_or(lambda: self._state(0),
+                                      lambda: self._state(0))
+        assert start == 2  # fell back to step 1
+        (event,) = report.of_kind("checkpoint_quarantined")
+        assert event["step"] == 2
+        assert event["next_good_step"] == 1
+
+
+# -- retry_reader exhaustion (satellite) -----------------------------------
+class TestRetryReaderExhaustion:
+    def test_final_error_carries_attempts_and_cause(self):
+        from paddle_tpu.reader import retry_reader
+        from paddle_tpu.utils.retry import RetriesExhausted
+
+        def always_fails():
+            yield 0
+            raise OSError("disk on fire")
+
+        robust = retry_reader(always_fails, max_attempts=3,
+                              sleep=lambda _t: None)
+        with pytest.raises(RetriesExhausted) as ei:
+            list(robust())
+        assert "3 attempt(s)" in str(ei.value)
+        assert isinstance(ei.value.__cause__, OSError)
+        assert "disk on fire" in str(ei.value.__cause__)
+        # still an OSError for callers filtering on the old contract
+        assert isinstance(ei.value, OSError)
+
+
+# -- rollback manager ------------------------------------------------------
+class TestRollbackManager:
+    def test_budget_exhaustion_raises_with_report(self, tmp_path):
+        from paddle_tpu.distributed.elastic import ElasticTrainState
+        report = SupervisorReport(str(tmp_path / "report.json"))
+        mgr = ElasticTrainState(str(tmp_path / "ckpt"),
+                                install_sigterm_handler=False)
+        rb = RollbackManager(mgr, budget=1, report=report)
+        state = {"w": jnp.zeros((4,), jnp.float32)}
+        mgr.save(5, state, use_async=False)
+        restored, start = rb.rollback(lambda: state, lambda: state)
+        assert start == 6
+        with pytest.raises(RollbackBudgetExceeded) as ei:
+            rb.rollback(lambda: state, lambda: state)
+        assert "report.json" in str(ei.value)
+        assert report.counts()["rollback_budget_exhausted"] == 1
+
+    def test_reseed_hook_called(self, tmp_path):
+        from paddle_tpu.distributed.elastic import ElasticTrainState
+        mgr = ElasticTrainState(str(tmp_path),
+                                install_sigterm_handler=False)
+        seeds = []
+        rb = RollbackManager(mgr, budget=2, reseed=seeds.append)
+        state = {"w": jnp.zeros((4,), jnp.float32)}
+        mgr.save(3, state, use_async=False)
+        rb.rollback(lambda: state, lambda: state)
+        assert seeds == [4]
+
+    def test_env_knob_seeds_budget(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PTPU_ROLLBACK_BUDGET", "7")
+        rb = RollbackManager(None)
+        assert rb.budget == 7
+
+
+# -- fault injector registry additions -------------------------------------
+class TestHangInjection:
+    def test_hang_on_write_reuses_registry(self, tmp_path):
+        from paddle_tpu.utils import fsio
+        with faults.FaultInjector() as fi:
+            fi.hang_on_write(1, seconds=0.05)
+            t0 = time.monotonic()
+            fsio.write_bytes(str(tmp_path / "f"), b"payload")
+            assert time.monotonic() - t0 >= 0.05
+        assert fi.injected == [(1, "hang", str(tmp_path / "f"))]
+        assert (tmp_path / "f").read_bytes() == b"payload"
+
+
+# -- end-to-end drills on a tiny hapi model --------------------------------
+def _tiny_supervised(tmp_path, **sup_kw):
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    pt.seed(0)
+    model = Model(nn.Linear(4, 2))
+    model.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+                  loss=lambda out, y: jnp.mean((out - y) ** 2))
+    rng = np.random.RandomState(0)
+    ds = TensorDataset([rng.randn(24, 4).astype(np.float32),
+                        rng.randn(24, 2).astype(np.float32)])
+    sup_kw.setdefault("save_interval_steps", 4)
+    sup_kw.setdefault("watchdog_secs", 30.0)
+    sup_kw.setdefault("heartbeat_secs", 60.0)
+    sup_kw.setdefault("sigterm_handler", False)
+    sup_kw.setdefault("guard", DivergenceGuard(skip_budget=2,
+                                               max_lr_backoffs=1,
+                                               min_history=2))
+    sup = RunSupervisor(str(tmp_path / "run"), **sup_kw)
+    return model, ds, sup
+
+
+class TestSupervisedFitEndToEnd:
+    def test_divergence_skip_rollback_resume(self, tmp_path):
+        """The acceptance drill: injected divergence → skip ×2 →
+        LR backoff → rollback to the last committed step → resume →
+        the run COMPLETES, with every event in the JSON report."""
+        model, ds, sup = _tiny_supervised(tmp_path, rollback_budget=2)
+        inject = faults.diverge_after(8, mode="spike", count=4)
+        sup.inject_loss(inject)
+        history = model.fit(ds, batch_size=1, epochs=1, verbose=0,
+                            supervisor=sup)
+        assert sup.rollback.used == 1  # within budget
+        assert np.isfinite(history["loss"][-1])
+        counts = SupervisorReport.load(
+            str(tmp_path / "run" / "supervisor_report.json")).counts()
+        for kind in ("run_start", "divergence_skip", "lr_backoff",
+                     "divergence_rollback", "rollback", "run_end"):
+            assert counts.get(kind), f"missing {kind} in report: {counts}"
+        assert counts["divergence_skip"] == 2
+        # rollback landed on the newest committed step at the time (8)
+        assert SupervisorReport.load(
+            str(tmp_path / "run" / "supervisor_report.json")
+        ).of_kind("rollback")[0]["start_step"] == 9
+        assert model._supervisor is None  # detached after the run
+
+    def test_watchdog_hang_skipped_run_completes(self, tmp_path):
+        model, ds, sup = _tiny_supervised(tmp_path, watchdog_secs=0.3)
+        hung = []
+
+        def hang_once(step, loss):
+            if step == 5 and not hung:
+                hung.append(step)
+                faults.hang(30.0)
+            return loss
+
+        sup.inject_loss(hang_once)
+        history = model.fit(ds, batch_size=1, epochs=1, verbose=0,
+                            supervisor=sup)
+        counts = sup.report.counts()
+        assert counts["watchdog_timeout"] == 1
+        assert counts["step_failure"] == 1
+        assert counts.get("rollback") is None  # one timeout → skip only
+        assert len(history["loss"]) == 23  # one batch lost to the hang
+
+    def test_repeated_hang_rolls_back(self, tmp_path):
+        model, ds, sup = _tiny_supervised(
+            tmp_path, watchdog_secs=0.3, rollback_budget=2,
+            step_failure_budget=1)
+        hangs = {"n": 0}
+
+        def hang_twice(step, loss):
+            if step >= 6 and hangs["n"] < 2:
+                hangs["n"] += 1
+                faults.hang(30.0)
+            return loss
+
+        sup.inject_loss(hang_twice)
+        model.fit(ds, batch_size=1, epochs=1, verbose=0, supervisor=sup)
+        counts = sup.report.counts()
+        assert counts["watchdog_timeout"] == 2
+        assert counts["step_failure"] == 2
+        assert counts["rollback"] == 1
+        assert sup.report.of_kind("rollback")[0]["reason"] == "step-timeout"
+
+    def test_budget_exhaustion_fails_loudly_with_report(self, tmp_path):
+        model, ds, sup = _tiny_supervised(tmp_path, rollback_budget=1)
+        sup.inject_loss(faults.diverge_after(6, mode="spike"))  # forever
+        with pytest.raises(RollbackBudgetExceeded) as ei:
+            model.fit(ds, batch_size=1, epochs=1, verbose=0,
+                      supervisor=sup)
+        assert "supervisor_report.json" in str(ei.value)
+        counts = SupervisorReport.load(
+            str(tmp_path / "run" / "supervisor_report.json")).counts()
+        assert counts["rollback_budget_exhausted"] == 1
+        (end,) = SupervisorReport.load(
+            str(tmp_path / "run" / "supervisor_report.json")
+        ).of_kind("run_end")
+        assert end["status"] == "failed"
+
+    def test_lr_backoff_applied_to_updates(self, tmp_path):
+        model, ds, sup = _tiny_supervised(tmp_path, rollback_budget=2)
+        sup.inject_loss(faults.diverge_after(8, mode="spike", count=3))
+        model.fit(ds, batch_size=1, epochs=1, verbose=0, supervisor=sup)
+        # ladder reached LOWER_LR (sticky) but not ROLLBACK
+        assert sup.guard.lr_scale == 0.5
+        assert sup.rollback.used == 0

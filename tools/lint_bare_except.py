@@ -1,10 +1,18 @@
 #!/usr/bin/env python
-"""Reject bare ``except:`` clauses in paddle_tpu/ (resilience hygiene).
+"""Reject bare ``except:`` clauses — and silent ``except Exception: pass``
+swallowing — in paddle_tpu/ (resilience hygiene).
 
 A bare except swallows KeyboardInterrupt/SystemExit and — worse for the
 fault-tolerance layer — silently eats the SIGTERM-driven control flow and
 corruption errors the restore fallback chain depends on seeing.  Every
 handler must name what it catches (``except Exception:`` at minimum).
+
+An ``except Exception: pass`` (or ``except BaseException: pass``) names
+what it catches and then discards it anyway — the run supervisor (ISSUE 2)
+exists precisely because swallowed failures turn into silent hangs and
+divergence.  Handlers that legitimately must swallow (finalizers,
+best-effort shutdown paths) carry an explicit ``# noqa: swallow`` comment
+on the ``except`` or ``pass`` line.
 
 Usage: ``python tools/lint_bare_except.py [root ...]`` (default:
 ``paddle_tpu/``).  Exits 1 listing ``file:line`` for every violation.
@@ -15,16 +23,48 @@ import ast
 import os
 import sys
 
+_NOQA = "# noqa: swallow"
+_BROAD = {"Exception", "BaseException"}
 
-def find_bare_excepts(path: str):
+
+def _is_swallow(node: ast.ExceptHandler) -> bool:
+    """True for ``except Exception/BaseException [as e]: pass``."""
+    if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+        return False
+    t = node.type
+    return (t is None or (isinstance(t, ast.Name) and t.id in _BROAD)
+            or (isinstance(t, ast.Attribute) and t.attr in _BROAD))
+
+
+def find_violations(path: str):
     with open(path, "rb") as f:
         source = f.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [(getattr(e, "lineno", 0) or 0, f"syntax error: {e.msg}")]
-    return [(node.lineno, "bare except") for node in ast.walk(tree)
-            if isinstance(node, ast.ExceptHandler) and node.type is None]
+    lines = source.decode("utf-8", errors="replace").splitlines()
+
+    def allowlisted(node: ast.ExceptHandler) -> bool:
+        check = {node.lineno, node.body[0].lineno if node.body else 0}
+        return any(_NOQA in lines[n - 1] for n in check
+                   if 0 < n <= len(lines))
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno, "bare except"))
+        elif _is_swallow(node) and not allowlisted(node):
+            out.append((node.lineno,
+                        "swallowed exception (`except Exception: pass`) — "
+                        "handle it, narrow it, or mark `# noqa: swallow`"))
+    return out
+
+
+# back-compat alias (pre-ISSUE-2 name)
+find_bare_excepts = find_violations
 
 
 def main(argv):
@@ -39,15 +79,16 @@ def main(argv):
                     continue
                 full = os.path.join(dirpath, name)
                 checked += 1
-                for lineno, what in find_bare_excepts(full):
+                for lineno, what in find_violations(full):
                     violations.append(f"{os.path.relpath(full)}:{lineno}: "
                                       f"{what}")
     if violations:
         print("\n".join(violations))
-        print(f"\n{len(violations)} bare except clause(s) found — name the "
-              "exception (at minimum `except Exception:`)")
+        print(f"\n{len(violations)} violation(s) found — name the "
+              "exception (at minimum `except Exception:`) and don't "
+              "swallow it silently")
         return 1
-    print(f"bare-except lint: {checked} files clean")
+    print(f"bare-except/swallow lint: {checked} files clean")
     return 0
 
 

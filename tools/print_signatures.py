@@ -62,6 +62,7 @@ _MODULES = [
     "paddle_tpu.incubate",
     "paddle_tpu.regularizer",
     "paddle_tpu.utils",
+    "paddle_tpu.supervisor",
 ]
 
 
