@@ -33,30 +33,38 @@ import jax
 import jax.numpy as jnp
 
 
-# bf16 peak matmul TFLOPs per chip by TPU generation (public specs);
-# CPU fallback uses a nominal figure so the script still runs in dev envs.
-_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+# The peak-TFLOPs table and MFU math live in paddle_tpu.observability.mfu
+# (ISSUE 3) — one definition shared by this one-shot harness and the live
+# per-step MFU in hapi.Model.fit.  Imported lazily: bench must configure
+# the (virtual) mesh in main() before paddle_tpu touches a backend.
 
 
 def _peak_flops_per_sec() -> float:
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "").lower()
-    for gen, tf in _PEAK_TFLOPS.items():
-        if gen in kind:
-            return tf * 1e12
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    if gen in _PEAK_TFLOPS:
-        return _PEAK_TFLOPS[gen] * 1e12
-    return _PEAK_TFLOPS["v5e"] * 1e12
+    from paddle_tpu.observability.mfu import peak_flops_per_sec
+    return peak_flops_per_sec()
 
 
 def _param_count(params) -> int:
-    return sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
+    from paddle_tpu.observability.mfu import param_count
+    return param_count(params)
 
 
 def _flops_per_token(n_params: int, cfg, S: int) -> float:
     # 6N for fwd+bwd matmuls + causal attention term 12*L*h*S per token
-    return 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S // 2
+    from paddle_tpu.observability.mfu import flops_per_token
+    return flops_per_token(n_params, num_layers=cfg.num_layers,
+                           hidden_size=cfg.hidden_size, seq_len=S,
+                           causal=True)
+
+
+def _emit_diag(kind: str, **fields) -> None:
+    """Mirror a stderr diagnostic as a structured telemetry record: with
+    a metrics sink attached (``PTPU_METRICS_DIR``, or any sink on the
+    global registry) every bench diagnostic also lands on the JSONL
+    timeline as ``bench.<kind>``; with none attached this is a no-op —
+    stdout stays one parseable JSON line either way."""
+    from paddle_tpu.observability import get_registry
+    get_registry().emit("bench." + kind, **fields)
 
 
 def _build(cfg, B, S, lr=1e-4, opt_factory=None):
@@ -123,6 +131,9 @@ def _bench_config(cfg, B, S, steps, warmup, tag):
           f"compile+warmup={warm_t:.1f}s step={dt * 1e3:.1f}ms "
           f"tok/s={tok_s:.0f} mfu={mfu:.3f} loss={loss:.3f}",
           file=sys.stderr, flush=True)
+    _emit_diag("config", tag=tag, params_m=n_params / 1e6, batch=B,
+               seqlen=S, warmup_s=warm_t, step_ms=dt * 1e3, tok_s=tok_s,
+               mfu=mfu, loss=loss)
     return tok_s, mfu
 
 
@@ -148,6 +159,8 @@ def _bench_slice_estimate(cfg_factory, slice_layers, B, S=2048, tag="slice",
         times[L] = dt
         print(f"[{tag} L={L}] step={dt * 1e3:.1f}ms loss={loss:.3f}",
               file=sys.stderr, flush=True)
+        _emit_diag("slice", tag=tag, num_layers=L, step_ms=dt * 1e3,
+                   loss=loss)
         # drop this slice's device buffers before building the next/bigger
         # one — leftovers OOM the large slice on a 16GB chip
         del jitted, model, params, opt_state, ids, labels
@@ -164,6 +177,9 @@ def _bench_slice_estimate(cfg_factory, slice_layers, B, S=2048, tag="slice",
           f"est_step={est * 1e3:.0f}ms est_tok/s={tok_s:.0f} "
           f"est_mfu={mfu:.3f} (ESTIMATE composed from measured slices)",
           file=sys.stderr, flush=True)
+    _emit_diag("slice_estimate", tag=tag, per_layer_ms=per_layer * 1e3,
+               est_step_ms=est * 1e3, est_tok_s=tok_s, est_mfu=mfu,
+               estimate=True)
     if artifact is not None:
         _write_artifact(artifact, {
             "slice_step_ms": {str(k): v * 1e3 for k, v in times.items()},
@@ -223,6 +239,9 @@ def _bench_1p3b_fullstep(S=2048, B=4):
               f"({tag}, SGD) B={B} S={S} step={dt * 1e3:.0f}ms "
               f"tok/s={tok_s:.0f} mfu={mfu:.3f} loss={loss:.3f}",
               file=sys.stderr, flush=True)
+        _emit_diag("fullstep_1p3b", tag=tag, params_m=n_params / 1e6,
+                   batch=B, seqlen=S, step_ms=dt * 1e3, tok_s=tok_s,
+                   mfu=mfu, loss=loss)
         return {"tok_s": tok_s, "mfu": mfu, "step_ms": dt * 1e3,
                 "params_m": n_params / 1e6, "vocab": vocab}
     return None
@@ -246,6 +265,9 @@ def _bench_flash_ab(B=8, S=2048, steps=8, warmup=3):
               f"tok/s={B * S / dt:.0f}", file=sys.stderr, flush=True)
     rows["speedup_flash_over_xla"] = (rows["xla"]["step_ms"]
                                       / rows["flash"]["step_ms"])
+    _emit_diag("flash_ab", flash_step_ms=rows["flash"]["step_ms"],
+               xla_step_ms=rows["xla"]["step_ms"],
+               speedup=rows["speedup_flash_over_xla"])
     _write_artifact("flash_ab.json", rows)
     return rows
 
@@ -314,6 +336,8 @@ def _bench_resnet50(B=128, hw=224, steps=10, warmup=3, depth=50):
         # and the recorded artifact only make sense on that config
         mfu = img_s * 3 * 4.089e9 / _peak_flops_per_sec()
         print(f"[resnet50] mfu={mfu:.3f}", file=sys.stderr, flush=True)
+        _emit_diag("resnet50", batch=B, step_ms=dt * 1e3, img_s=img_s,
+                   mfu=mfu)
         _write_artifact("resnet50.json", {
             "batch": B, "step_ms": dt * 1e3, "img_per_sec": img_s,
             "mfu": mfu})
@@ -363,13 +387,19 @@ def _bench_bert_base(B=16, S=512, steps=10, warmup=3, cfg_factory=None):
     seq_s = B / dt
     n_params = _param_count(params)
     # 6N per token + bidirectional attention 12*L*h*S (no causal halving)
-    flops_tok = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S
+    from paddle_tpu.observability.mfu import flops_per_token
+    flops_tok = flops_per_token(n_params, num_layers=cfg.num_layers,
+                                hidden_size=cfg.hidden_size, seq_len=S,
+                                causal=False)
     mfu = seq_s * S * flops_tok / _peak_flops_per_sec()
     tag = "bert-base" if cfg_factory is None else "bert-smoke"
     print(f"[{tag}] params={n_params / 1e6:.1f}M B={B} S={S} "
           f"compile+warmup={warm_t:.1f}s step={dt * 1e3:.1f}ms "
           f"seq/s={seq_s:.0f} mfu={mfu:.3f} loss={loss:.3f}",
           file=sys.stderr, flush=True)
+    _emit_diag("bert", tag=tag, params_m=n_params / 1e6, batch=B,
+               seqlen=S, step_ms=dt * 1e3, seq_s=seq_s, mfu=mfu,
+               loss=loss)
     if cfg_factory is None:      # only record the real bert-base config
         _write_artifact("bert_base.json", {
             "batch": B, "seqlen": S, "step_ms": dt * 1e3,
@@ -426,6 +456,9 @@ def _sweep_seqlen_ab(bh=24, d=64, seqlens=(2048, 4096, 8192), steps=5,
         results[str(S)] = row
         print(f"[seqlen-ab S={S}] flash={row.get('flash')}ms "
               f"xla={row.get('xla')}ms", file=sys.stderr, flush=True)
+        _emit_diag("seqlen_ab", seqlen=S, flash_ms=row.get("flash"),
+                   xla_ms=row.get("xla"),
+                   speedup=row.get("speedup_flash_over_xla"))
     if artifact:
         _write_artifact("flash_seqlen_ab.json", results)
     return results
@@ -470,6 +503,7 @@ def _sweep_block_sizes(bh=96, S=2048, d=64):
             results[f"{b}/{b}"] = {"fwd_bwd_ms": dt * 1e3}
             print(f"[block-sweep {b}/{b}] fwd+bwd={dt * 1e3:.1f}ms",
                   file=sys.stderr, flush=True)
+            _emit_diag("block_sweep", block=b, fwd_bwd_ms=dt * 1e3)
     finally:
         fa_mod._block_sizes = orig
     _write_artifact("flash_block_sweep.json", results)
@@ -590,6 +624,10 @@ def main():
         tok_s, mfu = _bench_config(cfg, B=2, S=128, steps=3, warmup=1,
                                    tag="smoke")
 
+    _emit_diag("headline", metric="gpt_tokens_per_sec_per_chip",
+               tok_s=tok_s, mfu=mfu, vs_target=mfu / 0.45)
+    from paddle_tpu.observability import get_registry
+    get_registry().flush()
     print(json.dumps({
         "metric": "gpt_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),

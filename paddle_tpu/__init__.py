@@ -36,6 +36,7 @@ from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401
 from .hapi import flops, summary  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import regularizer  # noqa: F401

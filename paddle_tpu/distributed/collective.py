@@ -16,6 +16,8 @@ The reference's eager tensor-in-place mutation API is reshaped functional:
 """
 from __future__ import annotations
 
+import functools
+import time
 from typing import Optional, Sequence, Union
 
 import jax
@@ -38,6 +40,30 @@ class ReduceOp:
     MIN = "min"
     PROD = "prod"
     AVG = "avg"
+
+
+def _observed(fn):
+    """Record per-collective host latency into the telemetry registry
+    (ISSUE 3): histogram ``collective.<op>.ms`` + counter
+    ``collective.<op>.calls``.  For ops invoked inside a traced program
+    this measures trace/dispatch cost (the wire time lives in the XLA
+    schedule); for host-blocking ops — ``barrier`` above all — it is the
+    real wait, which is exactly the number a wedged fleet shows first."""
+    hist_name = f"collective.{fn.__name__}.ms"
+    count_name = f"collective.{fn.__name__}.calls"
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            from ..observability import get_registry
+            reg = get_registry()
+            reg.histogram(hist_name).observe(
+                (time.perf_counter() - t0) * 1e3)
+            reg.counter(count_name).inc()
+    return wrapped
 
 
 def bound_axis_size(name: str):
@@ -64,6 +90,7 @@ def _arr(x):
     return x.__jax_array__() if hasattr(x, "__jax_array__") else jnp.asarray(x)
 
 
+@_observed
 def all_reduce(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp"):
     """c_allreduce_{sum,max,min,prod} (reference collective/c_allreduce_op.h).
     ``group`` is a mesh axis name or tuple of axis names."""
@@ -86,6 +113,7 @@ def all_reduce(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp"):
     raise ValueError(f"unknown reduce op {op!r}")
 
 
+@_observed
 def all_gather(x, group: Optional[str] = "dp", axis: int = 0,
                tiled: bool = True):
     """c_allgather (reference collective/c_allgather_op.cc): concatenate the
@@ -96,6 +124,7 @@ def all_gather(x, group: Optional[str] = "dp", axis: int = 0,
     return lax.all_gather(x, group, axis=axis, tiled=tiled)
 
 
+@_observed
 def reduce_scatter(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
                    axis: int = 0):
     """c_reducescatter (reference collective/c_reducescatter_op.cc)."""
@@ -105,6 +134,7 @@ def reduce_scatter(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
     return lax.psum_scatter(x, group, scatter_dimension=axis, tiled=True)
 
 
+@_observed
 def broadcast(x, src: int = 0, group: Optional[str] = "dp"):
     """c_broadcast: every device gets src's value.  Implemented as a
     masked psum (XLA lowers single-source psum patterns to a broadcast)."""
@@ -116,6 +146,7 @@ def broadcast(x, src: int = 0, group: Optional[str] = "dp"):
     return lax.psum(masked, group)
 
 
+@_observed
 def reduce(x, dst: int = 0, op: str = ReduceOp.SUM,
            group: Optional[str] = "dp"):
     """c_reduce: full result lands on dst, zeros elsewhere (SPMD shape must
@@ -128,6 +159,7 @@ def reduce(x, dst: int = 0, op: str = ReduceOp.SUM,
     return jnp.where(idx == dst, total, jnp.zeros_like(total))
 
 
+@_observed
 def scatter(x, src: int = 0, group: Optional[str] = "dp", axis: int = 0):
     """Each device keeps its slice of src's tensor."""
     x = _arr(x)
@@ -144,6 +176,7 @@ def scatter(x, src: int = 0, group: Optional[str] = "dp", axis: int = 0):
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=axis)
 
 
+@_observed
 def all_to_all(x, group: Optional[str] = "ep", split_axis: int = 0,
                concat_axis: int = 0):
     """alltoall (reference collective/alltoall_op.cc; MoE dispatch backbone
@@ -155,6 +188,7 @@ def all_to_all(x, group: Optional[str] = "ep", split_axis: int = 0,
                           concat_axis=concat_axis, tiled=True)
 
 
+@_observed
 def send_recv_permute(x, perm: Sequence[tuple], group: str = "pp"):
     """Point-to-point via collective_permute — the ICI-native replacement for
     the reference's NCCL send/recv pairs (partial_send/recv,
@@ -165,6 +199,7 @@ def send_recv_permute(x, perm: Sequence[tuple], group: str = "pp"):
     return lax.ppermute(x, group, perm=list(perm))
 
 
+@_observed
 def p2p_push(x, offset: int = 1, group: str = "pp"):
     """Shift along a ring: stage i sends to stage i+offset (mod n) — the 1F1B
     forward/backward activation hand-off."""
@@ -176,6 +211,7 @@ def p2p_push(x, offset: int = 1, group: str = "pp"):
     return lax.ppermute(x, group, perm=perm)
 
 
+@_observed
 def split(x, group: str = "mp", axis: int = -1):
     """c_split: keep this device's slice along ``axis``."""
     x = _arr(x)
@@ -192,6 +228,7 @@ def split(x, group: str = "mp", axis: int = -1):
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=ax)
 
 
+@_observed
 def barrier(group: Optional[str] = None, timeout: Optional[float] = None):
     """Host-side rendezvous.  Inside a traced program this is a no-op
     (one program, one schedule — XLA's execution model is the barrier;
@@ -213,6 +250,7 @@ def barrier(group: Optional[str] = None, timeout: Optional[float] = None):
     return None
 
 
+@_observed
 def all_reduce_quantized(x, group: str = "dp", bits: int = 8,
                          block_size: int = 256):
     """Quantized sum all-reduce: block-wise absmax int8 quantization with

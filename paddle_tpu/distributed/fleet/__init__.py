@@ -333,7 +333,7 @@ class UtilBase:
 
     def print_on_rank(self, message: str, rank_id: int = 0):
         if jax.process_index() == rank_id:
-            print(message)
+            print(message)  # noqa: print
 
 
 class PaddleCloudRoleMaker:

@@ -116,6 +116,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
     if args.nnodes <= 1:
         sys.argv = [args.script] + list(args.script_args)
         runpy.run_path(args.script, run_name="__main__")
+        if args.run_dir:
+            _aggregate_metrics(args.run_dir)
         return 0
 
     if args.node_rank is not None:
@@ -143,7 +145,27 @@ def launch(argv: Optional[List[str]] = None) -> int:
         rc = rc or code
     if stop_monitor is not None:
         stop_monitor()
+    if args.run_dir:
+        _aggregate_metrics(args.run_dir)
     return rc
+
+
+def _aggregate_metrics(run_dir: str) -> None:
+    """Merge the workers' ``<run_dir>/metrics/worker-*.jsonl`` telemetry
+    streams into ``metrics/summary.json`` (ISSUE 3) — the launcher is the
+    one process guaranteed to outlive every worker, so cross-worker
+    aggregation happens here."""
+    from ...observability import aggregate_run
+    try:
+        summary = aggregate_run(run_dir)
+    except OSError as e:
+        vlog(0, "launch: metrics aggregation under %s failed: %s",
+             run_dir, e)
+        return
+    if summary is not None:
+        vlog(0, "launch: merged %d worker metric streams (%d records) → "
+             "%s/metrics/summary.json", len(summary["workers"]),
+             summary["records"], run_dir)
 
 
 def _monitor_heartbeats(run_dir: str, nnodes: int):

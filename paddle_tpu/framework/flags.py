@@ -17,6 +17,15 @@ from typing import Any, Dict
 _lock = threading.Lock()
 _registry: Dict[str, Any] = {}
 _defaults: Dict[str, Any] = {}
+# bumped on every mutation — hot paths (framework.log.vlog) cache flag
+# lookups keyed on this instead of taking the lock per call
+_version = 0
+
+
+def version() -> int:
+    """Monotone counter bumped by every flag mutation (cache key for
+    hot-path flag reads)."""
+    return _version
 
 
 def _coerce(env_value: str, default: Any) -> Any:
@@ -31,6 +40,7 @@ def _coerce(env_value: str, default: Any) -> Any:
 
 def define_flag(name: str, default: Any, doc: str = "") -> None:
     """Register a flag, seeding from env var ``FLAGS_<name>`` if present."""
+    global _version
     with _lock:
         if name in _registry:
             return
@@ -40,6 +50,7 @@ def define_flag(name: str, default: Any, doc: str = "") -> None:
             value = _coerce(env, default)
         _registry[name] = value
         _defaults[name] = default
+        _version += 1
 
 
 def get_flags(names=None) -> Dict[str, Any]:
@@ -57,11 +68,13 @@ def get_flag(name: str) -> Any:
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
+    global _version
     with _lock:
         for name, value in flags.items():
             if name not in _registry:
                 raise KeyError(f"unknown flag {name!r}; define_flag it first")
             _registry[name] = value
+        _version += 1
 
 
 # ---------------------------------------------------------------------------

@@ -10,11 +10,20 @@ import logging
 import sys
 from typing import Any
 
-from .flags import get_flags
+from . import flags as _flags
+from .flags import get_flags  # noqa: F401  (public re-export)
 
 __all__ = ["get_logger", "vlog"]
 
 _logger = None
+
+# vlog is called on hot paths where the message is usually suppressed —
+# cache the log_level flag keyed on the registry's mutation counter so a
+# disabled call costs two attribute reads and a compare, not a locked
+# dict-building get_flags round-trip.  set_flags/define_flag bump the
+# counter, which invalidates this cache.
+_cached_level = None
+_cached_version = -1
 
 
 def get_logger() -> logging.Logger:
@@ -34,5 +43,10 @@ def get_logger() -> logging.Logger:
 
 def vlog(level: int, msg: str, *args: Any) -> None:
     """Emit ``msg`` when FLAGS_log_level >= level (glog VLOG semantics)."""
-    if int(get_flags(["log_level"])["log_level"]) >= level:
+    global _cached_level, _cached_version
+    v = _flags._version
+    if v != _cached_version:
+        _cached_level = int(get_flags(["log_level"])["log_level"])
+        _cached_version = v
+    if _cached_level >= level:
         get_logger().info(msg, *args)

@@ -93,11 +93,11 @@ class ProgBarLogger(Callback):
             extras = " ".join(f"{k}: {v:.4f}" for k, v in logs.items()
                               if isinstance(v, (int, float)))
             epochs = self.params.get("epochs", "?")
-            print(f"Epoch {self._epoch + 1}/{epochs} step {step} {extras}")
+            print(f"Epoch {self._epoch + 1}/{epochs} step {step} {extras}")  # noqa: print
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            print(f"Epoch {epoch + 1} done in {time.time() - self._t0:.1f}s")
+            print(f"Epoch {epoch + 1} done in {time.time() - self._t0:.1f}s")  # noqa: print
 
 
 class ModelCheckpoint(Callback):

@@ -53,9 +53,9 @@ def flops(net, input_size: Sequence[int], custom_ops=None,
         if print_detail:
             by_bytes = {k: v for k, v in ca.items()
                         if k.startswith("bytes accessed")}
-            print(f"FLOPs: {total}")
+            print(f"FLOPs: {total}")  # noqa: print
             for k, v in sorted(by_bytes.items()):
-                print(f"  {k}: {int(v)}")
+                print(f"  {k}: {int(v)}")  # noqa: print
         return total
     finally:
         for layer, mode in modes:
@@ -75,17 +75,17 @@ def summary(net, input_size=None, dtypes=None) -> dict:
             trainable += n
         lines.append(f"  {name:48s} {str(tuple(p.shape)):24s} {n:>12,}")
     header = f"{'Layer (param)':50s} {'Shape':24s} {'Param #':>12s}"
-    print(header)
-    print("-" * len(header))
-    print("\n".join(lines))
-    print("-" * len(header))
-    print(f"Total params: {total:,}")
-    print(f"Trainable params: {trainable:,}")
+    print(header)  # noqa: print
+    print("-" * len(header))  # noqa: print
+    print("\n".join(lines))  # noqa: print
+    print("-" * len(header))  # noqa: print
+    print(f"Total params: {total:,}")  # noqa: print
+    print(f"Trainable params: {trainable:,}")  # noqa: print
     if input_size is not None:
         try:
             f = flops(net, input_size,
                       dtype=dtypes[0] if dtypes else None)
-            print(f"Forward FLOPs @ {tuple(input_size)}: {f:,}")
+            print(f"Forward FLOPs @ {tuple(input_size)}: {f:,}")  # noqa: print
         except Exception as e:  # cost analysis unavailable on some backends
-            print(f"(FLOPs unavailable: {e})")
+            print(f"(FLOPs unavailable: {e})")  # noqa: print
     return {"total_params": total, "trainable_params": trainable}

@@ -8,6 +8,7 @@ dygraph/static execution paths.
 from __future__ import annotations
 
 import math
+import time
 from typing import List, Optional
 
 import jax
@@ -15,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import framework
+from .. import observability as obs
 from ..framework import debug
 from ..framework import random as fw_random
 from ..framework.errors import enforce
@@ -42,6 +44,14 @@ class Model:
         self._nonfinite_budget: Optional[int] = None
         self._nonfinite_skipped = 0
         self._supervisor = None  # set by RunSupervisor.attach / fit()
+        # -- telemetry (ISSUE 3): last train_batch's dispatch/readback
+        # split + cached MFU accounting inputs
+        self._last_batch_timing: Optional[dict] = None
+        self._obs_n_params: Optional[int] = None
+        self._obs_flops_token: Optional[float] = None
+        self._obs_seq_len: Optional[int] = None
+        self._obs_peak: Optional[float] = None
+        self._obs_step = 0
 
     # -- setup ------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -144,11 +154,18 @@ class Model:
             # the armed region covers the jitted step AND the host sync on
             # its results — where a hung collective actually blocks
             with sup.watchdog.armed("train_batch"):
-                loss, out, new_params, new_opt_state, finite, gnorm = \
-                    self._train_step(trainable, rest, self._opt_state, key,
-                                     lr_override, *data)
-                loss_v = sup.filter_loss(float(loss))
-                gnorm_v = float(gnorm)
+                with obs.span("dispatch") as sp_d:
+                    loss, out, new_params, new_opt_state, finite, gnorm = \
+                        self._train_step(trainable, rest, self._opt_state,
+                                         key, lr_override, *data)
+                # the readback IS the device sync (bench.py methodology:
+                # on tunneled TPUs dispatch returns before completion, so
+                # this span absorbs the device compute)
+                with obs.span("readback") as sp_r:
+                    loss_v = sup.filter_loss(float(loss))
+                    gnorm_v = float(gnorm)
+            self._last_batch_timing = {"dispatch_s": sp_d.elapsed,
+                                       "readback_s": sp_r.elapsed}
             action = sup.guard_step(loss_v, gnorm_v,
                                     amp_active=bool(self._amp_level))
             from ..supervisor.guard import GuardAction
@@ -158,10 +175,14 @@ class Model:
                 # supervisor for the driving loop to execute
                 return loss_v, [m.accumulate() for m in self._metrics]
         else:
-            loss, out, new_params, new_opt_state, finite, _gnorm = \
-                self._train_step(trainable, rest, self._opt_state, key,
-                                 lr_override, *data)
-            loss_v = float(loss)
+            with obs.span("dispatch") as sp_d:
+                loss, out, new_params, new_opt_state, finite, _gnorm = \
+                    self._train_step(trainable, rest, self._opt_state, key,
+                                     lr_override, *data)
+            with obs.span("readback") as sp_r:
+                loss_v = float(loss)
+            self._last_batch_timing = {"dispatch_s": sp_d.elapsed,
+                                       "readback_s": sp_r.elapsed}
         if debug.check_nan_inf_enabled():
             debug.assert_all_finite(finite, context="train_batch")
         if self._nonfinite_budget is not None and not math.isfinite(loss_v):
@@ -255,12 +276,15 @@ class Model:
                     m.reset()
                 cbs.on_epoch_begin(epoch)
                 epoch_losses = []
-                for step, batch in enumerate(train_loader):
+                for step, (batch, data_s) in enumerate(
+                        self._timed_batches(train_loader)):
                     cbs.on_train_batch_begin(step)
                     *inputs, label = batch
                     if sup is not None:
                         try:
-                            loss, metrics = self.train_batch(inputs, label)
+                            with obs.span("step") as sp_step:
+                                loss, metrics = self.train_batch(inputs,
+                                                                 label)
                         except StepTimeout:
                             # watchdog fired: the step is dead, not the
                             # run — skip it, roll back when they repeat
@@ -282,7 +306,10 @@ class Model:
                                 self._supervised_state() if good else None)
                     else:
                         good = True
-                        loss, metrics = self.train_batch(inputs, label)
+                        with obs.span("step") as sp_step:
+                            loss, metrics = self.train_batch(inputs, label)
+                    self._record_step_telemetry(data_s, sp_step.elapsed,
+                                                label, loss)
                     history["loss"].append(loss)
                     if good:
                         epoch_losses.append(loss)
@@ -325,6 +352,72 @@ class Model:
             self._supervisor = None
         cbs.on_train_end()
         return history
+
+    # -- telemetry plumbing (ISSUE 3) -------------------------------------
+    @staticmethod
+    def _timed_batches(loader):
+        """Iterate ``loader`` yielding ``(batch, data_wait_seconds)`` —
+        the data-wait half of the per-step breakdown."""
+        it = iter(loader)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                with obs.span("data_load"):
+                    batch = next(it)
+            except StopIteration:
+                return
+            yield batch, time.perf_counter() - t0
+
+    def _record_step_telemetry(self, data_s: float, step_s: float, label,
+                               loss) -> None:
+        """One ``step`` record per train batch: wall time split into
+        data-wait / dispatch (compute) / host-readback, tokens/sec, and
+        live MFU against the chip's peak (``observability.mfu``) —
+        emitted to whatever sinks are attached, accumulated in the
+        registry's histograms either way."""
+        try:
+            reg = obs.get_registry()
+            timing = self._last_batch_timing or {}
+            lab = np.asarray(label)
+            tokens = max(1, int(lab.size))
+            seq_len = int(lab.shape[-1]) if lab.ndim >= 2 else None
+            if self._obs_n_params is None:
+                self._obs_n_params = obs.param_count(
+                    self.network.state_dict())
+                self._obs_peak = obs.peak_flops_per_sec()
+            if self._obs_flops_token is None or seq_len != self._obs_seq_len:
+                cfg = getattr(self.network, "config", None)
+                self._obs_flops_token = obs.flops_per_token(
+                    self._obs_n_params,
+                    num_layers=getattr(cfg, "num_layers", None),
+                    hidden_size=getattr(cfg, "hidden_size", None),
+                    seq_len=seq_len)
+                self._obs_seq_len = seq_len
+            total_s = max(1e-9, data_s + step_s)
+            tps = tokens / total_s
+            mfu_v = obs.mfu(tps, self._obs_flops_token, self._obs_peak)
+            compute_ms = timing.get("dispatch_s", 0.0) * 1e3
+            readback_ms = timing.get("readback_s", 0.0) * 1e3
+            reg.histogram("step.time_ms").observe(total_s * 1e3)
+            reg.histogram("step.data_ms").observe(data_s * 1e3)
+            reg.histogram("step.compute_ms").observe(compute_ms)
+            reg.histogram("step.readback_ms").observe(readback_ms)
+            reg.counter("step.count").inc()
+            reg.counter("step.tokens").inc(tokens)
+            reg.gauge("step.tokens_per_sec").set(tps)
+            reg.gauge("step.mfu").set(mfu_v)
+            sup = self._supervisor
+            reg.emit("step",
+                     step=(sup.gstep if sup is not None
+                           else self._obs_step),
+                     step_time_ms=total_s * 1e3, data_ms=data_s * 1e3,
+                     compute_ms=compute_ms, readback_ms=readback_ms,
+                     tokens=tokens, tokens_per_sec=tps, mfu=mfu_v,
+                     loss=float(loss))
+            self._obs_step += 1
+        except Exception as e:
+            # telemetry must never take the training loop down with it
+            vlog(1, "hapi: step telemetry failed: %r", e)
 
     # -- supervision plumbing (ISSUE 2) -----------------------------------
     def _supervised_state(self):
@@ -371,7 +464,7 @@ class Model:
         for m in self._metrics:
             result[m.name()] = m.accumulate()
         if verbose:
-            print("Eval:", result)
+            print("Eval:", result)  # noqa: print
         return result
 
     def predict(self, test_data, batch_size: int = 1, num_workers: int = 0):
@@ -410,5 +503,5 @@ class Model:
             total += n
             lines.append(f"  {name:40s} {str(p.shape):20s} {n}")
         out = "\n".join(lines) + f"\nTotal params: {total}"
-        print(out)
+        print(out)  # noqa: print
         return {"total_params": total}

@@ -1,0 +1,58 @@
+"""Unified telemetry layer (ISSUE 3).
+
+Before this package, run health lived in four unrelated channels — VLOG
+lines (``framework/log.py``), the XPlane profiler wrapper, the
+supervisor's JSON report, heartbeat files — and the only MFU number came
+from ``bench.py``'s one-shot harness.  This package is the shared spine
+they all report through:
+
+- :mod:`registry` — process-wide counters / gauges / bounded-reservoir
+  histograms, thread-safe, near-zero cost when no sink is attached;
+- :mod:`tracing` — nesting ``span()`` context managers that feed the
+  profiler's host annotations, an aggregated span tree, and a
+  chrome-trace exporter;
+- :mod:`sinks` — the run-scoped JSONL ``MetricsWriter`` (fsync'd via
+  ``utils/fsio``), a periodic stderr summary line, and a Prometheus
+  textfile exporter;
+- :mod:`mfu` — the peak-TFLOPs table and FLOPs-per-token math shared by
+  ``bench.py`` and the live per-step MFU in ``hapi.Model.fit``;
+- :mod:`aggregate` — merges ``<run_dir>/metrics/worker-*.jsonl`` into
+  ``summary.json`` (driven by ``launch --run_dir``).
+
+Emitters across the stack (hapi step breakdown, collective latencies,
+supervisor events) talk to :func:`get_registry` unconditionally; records
+flow only when a sink is attached — by the run supervisor under its
+``run_dir``, by ``PTPU_METRICS_DIR``, or explicitly via ``add_sink``.
+
+Env knobs: ``PTPU_METRICS_DIR`` (auto-attach a JSONL writer),
+``PTPU_METRICS_INTERVAL`` (sink flush/summary period, default 30s),
+``PTPU_TRACE_BUFFER`` (span buffer bound, default 65536).
+See docs/ARCHITECTURE.md "Telemetry".
+"""
+from __future__ import annotations
+
+from .aggregate import aggregate_run, read_worker_stream
+from .mfu import (PEAK_TFLOPS, flops_per_token, mfu, param_count,
+                  peak_flops_per_sec, readback_sync)
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       get_registry)
+from .sinks import (MetricsWriter, PrometheusTextfile, StderrSummary,
+                    default_interval, metrics_dir)
+from .tracing import (export_chrome_trace, reset_tracing, span,
+                      span_tree_totals, trace_events)
+
+__all__ = [
+    # registry
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    # tracing
+    "span", "span_tree_totals", "export_chrome_trace", "trace_events",
+    "reset_tracing",
+    # sinks
+    "MetricsWriter", "StderrSummary", "PrometheusTextfile", "metrics_dir",
+    "default_interval",
+    # mfu
+    "PEAK_TFLOPS", "peak_flops_per_sec", "param_count", "flops_per_token",
+    "mfu", "readback_sync",
+    # aggregation
+    "aggregate_run", "read_worker_stream",
+]

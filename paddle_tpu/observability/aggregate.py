@@ -1,0 +1,155 @@
+"""Cross-worker metrics aggregation (ISSUE 3).
+
+Each worker of a run streams ``<run_dir>/metrics/worker-<i>.jsonl``; the
+launcher (or anyone, via ``python -m paddle_tpu.observability.aggregate
+<run_dir>``) merges them into ``<run_dir>/metrics/summary.json``: per-
+worker and run-wide step-time percentiles, token totals, mean/max MFU,
+and an event census (how many of each record kind, including the
+supervisor events sharing the timeline) — the one file a dashboard or a
+post-mortem reads first.
+
+Torn trailing lines (a worker died mid-append) are skipped, not fatal:
+the stream is JSONL precisely so a partial write costs one record.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+from ..framework.log import vlog
+from ..utils import fsio
+from .sinks import metrics_dir
+
+__all__ = ["read_worker_stream", "aggregate_run"]
+
+_WORKER_RE = re.compile(r"^worker-(\d+)\.jsonl$")
+
+
+def read_worker_stream(path: str) -> List[Dict[str, Any]]:
+    """Parse one worker JSONL file, skipping torn/garbled lines."""
+    records = []
+    try:
+        raw = fsio.read_bytes(path)
+    except OSError:
+        return records
+    for line in raw.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail from a mid-append death
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def _pct(sorted_vals: List[float], p: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _step_stats(steps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    times = sorted(float(s["step_time_ms"]) for s in steps
+                   if s.get("step_time_ms") is not None)
+    mfus = [float(s["mfu"]) for s in steps if s.get("mfu") is not None]
+    toks = [float(s["tokens"]) for s in steps
+            if s.get("tokens") is not None]
+    tps = [float(s["tokens_per_sec"]) for s in steps
+           if s.get("tokens_per_sec") is not None]
+    out: Dict[str, Any] = {"steps": len(steps)}
+    if times:
+        out["step_time_ms"] = {
+            "mean": sum(times) / len(times), "min": times[0],
+            "max": times[-1], "p50": _pct(times, 50),
+            "p90": _pct(times, 90), "p99": _pct(times, 99)}
+    if toks:
+        out["total_tokens"] = sum(toks)
+    if tps:
+        out["tokens_per_sec_mean"] = sum(tps) / len(tps)
+    if mfus:
+        out["mfu"] = {"mean": sum(mfus) / len(mfus), "max": max(mfus),
+                      "last": mfus[-1]}
+    return out
+
+
+def aggregate_run(run_dir: str,
+                  out_path: Optional[str] = None) -> Optional[dict]:
+    """Merge every ``worker-*.jsonl`` under ``<run_dir>/metrics`` into
+    ``summary.json`` (atomic write through fsio).  Returns the summary
+    dict, or None when the run produced no metrics at all."""
+    mdir = metrics_dir(run_dir)
+    if not os.path.isdir(mdir):
+        return None
+    workers: Dict[int, List[Dict[str, Any]]] = {}
+    for name in sorted(os.listdir(mdir)):
+        m = _WORKER_RE.match(name)
+        if not m:
+            continue
+        workers[int(m.group(1))] = read_worker_stream(
+            os.path.join(mdir, name))
+    if not workers:
+        return None
+
+    all_records: List[Dict[str, Any]] = []
+    per_worker: Dict[str, Any] = {}
+    for wid, records in sorted(workers.items()):
+        all_records.extend(records)
+        steps = [r for r in records if r.get("kind") == "step"]
+        kinds: Dict[str, int] = {}
+        for r in records:
+            k = str(r.get("kind"))
+            kinds[k] = kinds.get(k, 0) + 1
+        per_worker[str(wid)] = {"records": len(records),
+                                "kinds": kinds,
+                                **_step_stats(steps)}
+
+    kinds_total: Dict[str, int] = {}
+    for r in all_records:
+        k = str(r.get("kind"))
+        kinds_total[k] = kinds_total.get(k, 0) + 1
+    ts = [float(r["ts"]) for r in all_records if "ts" in r]
+    summary = {
+        "run_dir": os.path.abspath(run_dir),
+        "workers": sorted(workers),
+        "records": len(all_records),
+        "kinds": dict(sorted(kinds_total.items())),
+        "supervisor_events": {k: v for k, v in sorted(kinds_total.items())
+                              if k.startswith("supervisor.")},
+        "time_range": ([min(ts), max(ts)] if ts else None),
+        "overall": _step_stats(
+            [r for r in all_records if r.get("kind") == "step"]),
+        "per_worker": per_worker,
+    }
+    out_path = out_path or os.path.join(mdir, "summary.json")
+    fsio.atomic_write_bytes(
+        out_path, json.dumps(summary, indent=1, default=str,
+                             sort_keys=False).encode("utf-8"))
+    vlog(1, "observability: aggregated %d workers → %s", len(workers),
+         out_path)
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 1:
+        print("usage: python -m paddle_tpu.observability.aggregate "  # noqa: print
+              "<run_dir>", file=sys.stderr)
+        return 2
+    summary = aggregate_run(args[0])
+    if summary is None:
+        print(f"no metrics under {args[0]}", file=sys.stderr)  # noqa: print
+        return 1
+    print(json.dumps(summary, indent=1, default=str))  # noqa: print
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

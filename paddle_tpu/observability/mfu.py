@@ -1,0 +1,84 @@
+"""MFU accounting helpers (ISSUE 3) — extracted from ``bench.py`` so the
+one-shot benchmark and the live per-step telemetry share one definition
+of "model FLOPs utilization".
+
+Two halves:
+
+- the **denominator**: :func:`peak_flops_per_sec` — bf16 peak matmul
+  TFLOPs per chip by TPU generation (public specs), with the
+  ``PALLAS_AXON_TPU_GEN`` env override and a nominal v5e figure for CPU
+  dev environments so the math always produces a number;
+- the **numerator**: :func:`flops_per_token` — the standard 6N
+  fwd+bwd matmul estimate plus the attention term
+  ``12·L·h·S`` per token (halved when causal), exactly the formula the
+  benchmark has always used.
+
+Timing methodology note (shared with ``bench.py``): on tunneled TPU
+platforms ``block_until_ready`` returns at *dispatch*, not completion —
+a host readback is the only true synchronization.  :func:`readback_sync`
+is that readback; hapi's step breakdown times it as the "readback"
+component, which on TPU absorbs the device compute the dispatch call
+didn't wait for.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["PEAK_TFLOPS", "peak_flops_per_sec", "param_count",
+           "flops_per_token", "mfu", "readback_sync"]
+
+# bf16 peak matmul TFLOPs per chip by TPU generation (public specs);
+# CPU fallback uses a nominal figure so the math still runs in dev envs.
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+
+def peak_flops_per_sec() -> float:
+    """Peak bf16 FLOP/s of the first visible device (nominal v5e figure
+    on CPU so dev-box MFU numbers exist — they are labelled by the
+    device field every step record carries)."""
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    for gen, tf in PEAK_TFLOPS.items():
+        if gen in kind:
+            return tf * 1e12
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if gen in PEAK_TFLOPS:
+        return PEAK_TFLOPS[gen] * 1e12
+    return PEAK_TFLOPS["v5e"] * 1e12
+
+
+def param_count(params: Any) -> int:
+    """Total element count of a parameter pytree."""
+    import jax
+    import numpy as np
+    return sum(int(np.prod(v.shape))
+               for v in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(n_params: int, num_layers: Optional[int] = None,
+                    hidden_size: Optional[int] = None,
+                    seq_len: Optional[int] = None,
+                    causal: bool = True) -> float:
+    """Train-step (fwd+bwd) FLOPs per token: 6N for the matmuls, plus the
+    attention term ``12·L·h·S`` when the transformer shape is known
+    (halved for causal masking).  With no shape info this degrades to
+    the plain 6N estimate — still the right order for MLPs/CNNs."""
+    total = 6.0 * float(n_params)
+    if num_layers and hidden_size and seq_len:
+        attn = 12.0 * num_layers * hidden_size * seq_len
+        total += attn / 2.0 if causal else attn
+    return total
+
+
+def mfu(tokens_per_sec: float, flops_token: float,
+        peak: Optional[float] = None) -> float:
+    """Achieved / peak FLOP throughput."""
+    return tokens_per_sec * flops_token / (peak or peak_flops_per_sec())
+
+
+def readback_sync(x) -> float:
+    """Host readback of a scalar — the only true device synchronization
+    on platforms where ``block_until_ready`` returns at dispatch."""
+    return float(x)

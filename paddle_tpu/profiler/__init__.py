@@ -240,7 +240,11 @@ class Profiler:
     # -- reporting ---------------------------------------------------------
     def summary(self, sorted_by: str = "total", reset: bool = False) -> str:
         """Host-side table: RecordEvent stats + step timing (the
-        profiler_statistic.py report analog)."""
+        profiler_statistic.py report analog), followed by the
+        observability layer's span TREE — path → count / total / self ms,
+        where self excludes child spans — so nested regions
+        (``step/dispatch`` under ``step``) read as a hierarchy instead of
+        a flat list (ISSUE 3)."""
         rows = [(name, n, tot) for name, (n, tot) in
                 profiler_summary(reset=reset).items()]
         rows.sort(key=lambda r: r[2], reverse=True)
@@ -253,6 +257,16 @@ class Profiler:
             ts = self._step_times
             lines.append(f"steps: {len(ts)}  avg "
                          f"{sum(ts) / len(ts) * 1e3:.2f} ms")
+        from ..observability.tracing import span_tree_totals
+        tree = span_tree_totals(reset=reset)
+        if tree:
+            lines.append("")
+            lines.append(f"{'span':40s} {'count':>8s} {'total ms':>10s} "
+                         f"{'self ms':>10s}")
+            for path, row in tree.items():
+                lines.append(f"{path[:40]:40s} {row['count']:8d} "
+                             f"{row['total_ms']:10.2f} "
+                             f"{row['self_ms']:10.2f}")
         return "\n".join(lines)
 
     def __enter__(self) -> "Profiler":

@@ -113,6 +113,7 @@ class RunSupervisor:
         self._prev_global: Optional[Watchdog] = None
         self._running = False
         self._loss_injectors: List[Callable[[int, float], float]] = []
+        self._metrics_sink = None  # run-scoped JSONL writer (ISSUE 3)
 
     # -- lifecycle ---------------------------------------------------------
     def begin_run(self, initial_state: Any = None) -> "RunSupervisor":
@@ -123,6 +124,19 @@ class RunSupervisor:
             if self.watchdog._closed:  # supervisor reused across runs
                 self.watchdog = Watchdog(timeout=self.watchdog.timeout,
                                          report=self.report)
+            # run-scoped telemetry: everything emitted while this run is
+            # live — step breakdowns, collective latencies, and the
+            # supervisor's own events — streams to
+            # <run_dir>/metrics/worker-<i>.jsonl (ISSUE 3)
+            from ..observability import MetricsWriter, get_registry
+            from ..observability import metrics_dir as _metrics_dir
+            try:
+                self._metrics_sink = get_registry().add_sink(
+                    MetricsWriter(_metrics_dir(self.run_dir),
+                                  worker_id=self.heartbeat.worker_id))
+            except OSError as e:
+                vlog(0, "supervisor: metrics sink under %s unavailable: "
+                     "%s", self.run_dir, e)
             self.report.record("run_start", run_dir=self.run_dir,
                                worker=self.heartbeat.worker_id,
                                watchdog_secs=self.watchdog.timeout,
@@ -143,6 +157,10 @@ class RunSupervisor:
                            rollbacks=self.rollback.used,
                            timeouts=self.watchdog.timeouts,
                            bad_batches=self.guard.total_bad)
+        if self._metrics_sink is not None:
+            from ..observability import get_registry
+            get_registry().remove_sink(self._metrics_sink)  # flush+close
+            self._metrics_sink = None
 
     def attach(self, model) -> "RunSupervisor":
         """Bind to a ``hapi.Model`` so ``train_batch`` consults the guard

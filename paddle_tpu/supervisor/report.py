@@ -44,8 +44,28 @@ class SupervisorReport:
         event.update(fields)
         self.events.append(event)
         vlog(1, "supervisor: event %s %s", kind, fields)
+        self._mirror_to_metrics(event)
         self.flush()
         return event
+
+    def _mirror_to_metrics(self, event: Dict[str, Any]) -> None:
+        """Every supervisor event also lands on the telemetry timeline
+        (ISSUE 3): a ``supervisor.<kind>`` record through whatever sinks
+        are attached — so one JSONL stream interleaves step breakdowns
+        with watchdog fires, guard verdicts, heartbeat transitions and
+        rollbacks — plus a counter per kind for dashboards."""
+        try:
+            from ..observability import get_registry
+            reg = get_registry()
+            kind = event["kind"]
+            reg.counter(f"supervisor.{kind}").inc()
+            fields = {k: v for k, v in event.items()
+                      if k not in ("kind", "time", "ts")}
+            reg.emit(f"supervisor.{kind}", ts=event["time"], **fields)
+        except Exception as e:
+            # telemetry is best-effort; the durable report above is the
+            # record of truth
+            vlog(1, "supervisor: metrics mirror failed: %r", e)
 
     def flush(self) -> None:
         if self.path is None:

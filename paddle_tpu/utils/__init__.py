@@ -64,7 +64,7 @@ def run_check() -> bool:
     jitted = jax.jit(lambda a: (a @ a).sum())
     enforce(float(jitted(x)) == 128.0 * 128 * 128,
             "jitted matmul sanity check failed")
-    print(f"paddle_tpu is installed successfully on {dev.platform} "
+    print(f"paddle_tpu is installed successfully on {dev.platform} "  # noqa: print
           f"({getattr(dev, 'device_kind', 'cpu')})")
     return True
 

@@ -61,7 +61,7 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                *extra_cxx_cflags, *sources, "-o", tmp_path, *extra_ldflags]
         if verbose:
-            print("compiling:", " ".join(cmd))
+            print("compiling:", " ".join(cmd))  # noqa: print
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             enforce(proc.returncode == 0,

@@ -21,12 +21,24 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["write_bytes", "atomic_write_bytes", "read_bytes", "fsync_dir"]
+__all__ = ["write_bytes", "atomic_write_bytes", "append_bytes",
+           "read_bytes", "fsync_dir"]
 
 
 def write_bytes(path: str, payload: bytes) -> None:
     """Write ``payload`` to ``path`` and fsync it (durable, NOT atomic)."""
     with open(path, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def append_bytes(path: str, payload: bytes) -> None:
+    """Append ``payload`` to ``path`` and fsync it — the JSONL-stream
+    variant of :func:`write_bytes` (observability metric streams).  Same
+    injectability contract: the fault harness patches this to tear/fail
+    telemetry appends."""
+    with open(path, "ab") as f:
         f.write(payload)
         f.flush()
         os.fsync(f.fileno())

@@ -12,9 +12,9 @@ with_tpu = "ON"
 
 
 def show():
-    print(f"full_version: {full_version}")
-    print(f"commit: {commit}")
-    print(f"with_tpu: {with_tpu}")
+    print(f"full_version: {full_version}")  # noqa: print
+    print(f"commit: {commit}")  # noqa: print
+    print(f"with_tpu: {with_tpu}")  # noqa: print
 
 
 def cuda():

@@ -44,6 +44,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "faults: fault-injection / resilience tests "
         "(tier-1 runs these; budget ~30s on JAX_PLATFORMS=cpu)")
+    config.addinivalue_line(
+        "markers", "telemetry: observability-layer tests (registry, "
+        "tracing, sinks, aggregation; ci.sh runs this tier explicitly)")
 
 
 def pytest_collection_modifyitems(config, items):

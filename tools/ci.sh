@@ -29,11 +29,15 @@ fi
 if (( SHARD == 0 )); then
     python tools/print_signatures.py --check
     python tools/lint_bare_except.py
+    python tools/lint_print.py
     # resilience tier: the fault-injection suite must stay green even when
     # sharding happens to place its files elsewhere
     python -m pytest -q -m faults tests/test_fault_tolerance.py \
         tests/test_supervisor.py
+    # telemetry tier (ISSUE 3): registry/tracing/sinks/aggregation + the
+    # e2e step-breakdown/MFU records contract
+    python -m pytest -q -m telemetry tests/test_observability.py
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
-    echo "api-guard + bare-except/swallow lint + faults tier + bench smoke ok"
+    echo "api-guard + lints + faults tier + telemetry tier + bench smoke ok"
 fi
 echo "shard ${SHARD} green"

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Reject bare ``print(`` calls in paddle_tpu/ (telemetry hygiene).
+
+With the unified telemetry layer (ISSUE 3) every signal has a proper
+channel: human-readable lines go through ``framework.log`` (VLOG / the
+package logger), machine-readable signals through
+``observability.get_registry()`` sinks.  A bare ``print`` bypasses both
+— it can't be silenced, filtered, redirected per-run, or aggregated, and
+on a 256-host pod it turns stdout into noise no one can parse.
+
+Deliberate console surfaces (the paddle-parity ``Model.summary`` /
+``flops`` pretty-printers, ``ProgBarLogger``, ``version`` / ``run_check``
+CLIs) carry an explicit ``# noqa: print`` on the call line.
+
+Only plain-name ``print(...)`` calls are flagged — attribute calls like
+``jax.debug.print`` are a different (traced) mechanism.
+
+Usage: ``python tools/lint_print.py [root ...]`` (default:
+``paddle_tpu/``).  Exits 1 listing ``file:line`` for every violation.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+_NOQA = "# noqa: print"
+
+
+def find_violations(path: str):
+    with open(path, "rb") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(getattr(e, "lineno", 0) or 0, f"syntax error: {e.msg}")]
+    lines = source.decode("utf-8", errors="replace").splitlines()
+
+    def allowlisted(node: ast.Call) -> bool:
+        n = node.lineno
+        return 0 < n <= len(lines) and _NOQA in lines[n - 1]
+
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and not allowlisted(node)):
+            out.append((node.lineno,
+                        "bare print() — route through framework.log / an "
+                        "observability sink, or mark a deliberate console "
+                        "surface with `# noqa: print`"))
+    return out
+
+
+def main(argv):
+    roots = argv or [os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "paddle_tpu")]
+    violations = []
+    checked = 0
+    for root in roots:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                checked += 1
+                for lineno, what in find_violations(full):
+                    violations.append(f"{os.path.relpath(full)}:{lineno}: "
+                                      f"{what}")
+    if violations:
+        print("\n".join(violations))
+        print(f"\n{len(violations)} violation(s) found — output belongs "
+              "in framework.log or an observability sink")
+        return 1
+    print(f"print lint: {checked} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

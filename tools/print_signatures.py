@@ -63,6 +63,7 @@ _MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.utils",
     "paddle_tpu.supervisor",
+    "paddle_tpu.observability",
 ]
 
 
