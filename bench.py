@@ -97,7 +97,11 @@ def _build(cfg, B, S, lr=1e-4, opt_factory=None):
         new_params, new_state = opt.apply_gradients(grads, params, opt_state)
         return loss, new_params, new_state
 
-    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    from paddle_tpu.observability.compilation import track_jit
+    jitted = track_jit(jax.jit(train_step, donate_argnums=(0, 1)),
+                       name="bench.gpt_step",
+                       arg_names=("params", "opt_state", "inputs",
+                                  "labels", "key"))
     return jitted, model, params, opt_state, ids, labels
 
 
@@ -323,7 +327,11 @@ def _bench_resnet50(B=128, hw=224, steps=10, warmup=3, depth=50):
         new_params, new_state = opt.apply_gradients(grads, params, opt_state)
         return loss, new_params, new_state
 
-    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    from paddle_tpu.observability.compilation import track_jit
+    jitted = track_jit(jax.jit(train_step, donate_argnums=(0, 1)),
+                       name="bench.resnet_step",
+                       arg_names=("params", "opt_state", "inputs",
+                                  "labels", "key"))
     dt, loss, warm_t = _timed_steps(jitted, trainable, opt_state, imgs,
                                     labels, steps=steps, warmup=warmup)
     img_s = B / dt
@@ -381,7 +389,11 @@ def _bench_bert_base(B=16, S=512, steps=10, warmup=3, cfg_factory=None):
         new_params, new_state = opt.apply_gradients(grads, params, opt_state)
         return loss, new_params, new_state
 
-    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    from paddle_tpu.observability.compilation import track_jit
+    jitted = track_jit(jax.jit(train_step, donate_argnums=(0, 1)),
+                       name="bench.bert_step",
+                       arg_names=("params", "opt_state", "inputs",
+                                  "labels", "key"))
     dt, loss, warm_t = _timed_steps(jitted, params, opt_state, ids, mlm,
                                     steps=steps, warmup=warmup)
     seq_s = B / dt

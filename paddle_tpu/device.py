@@ -19,7 +19,9 @@ from .framework.dtype import get_device, set_device  # noqa: F401
 
 __all__ = ["device_count", "get_all_devices", "get_device_properties",
            "memory_stats", "memory_allocated", "max_memory_allocated",
-           "memory_reserved", "set_device", "get_device", "cuda", "tpu"]
+           "memory_reserved", "local_device_memory_stats",
+           "local_memory_stats", "largest_alloc_size", "set_device",
+           "get_device", "cuda", "tpu"]
 
 
 def device_count() -> int:
@@ -79,6 +81,31 @@ def max_memory_allocated(device: Optional[int] = None) -> int:
 def memory_reserved(device: Optional[int] = None) -> int:
     """Allocator pool size; PJRT reports the usable limit."""
     return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def largest_alloc_size(device: Optional[int] = None) -> int:
+    """Largest single live allocation — the number that explains "the
+    limit says there's room but the arena is fragmented"."""
+    return int(memory_stats(device).get("largest_alloc_size", 0))
+
+
+def local_device_memory_stats(d: "jax.Device") -> Dict[str, int]:
+    """PJRT allocator stats for one concrete (addressable) jax.Device;
+    {} for backends without allocator telemetry (CPU)."""
+    try:
+        stats = d.memory_stats()
+    except NotImplementedError:
+        return {}
+    return dict(stats or {})
+
+
+def local_memory_stats() -> Dict[str, Dict[str, int]]:
+    """{``platform:id``: stats} for every device addressable from this
+    process — the per-worker HBM watermark table
+    (``observability.memory`` samples this on a step cadence)."""
+    return {f"{d.platform}:{d.id}": stats
+            for d in jax.local_devices()
+            if (stats := local_device_memory_stats(d))}
 
 
 class _Namespace:

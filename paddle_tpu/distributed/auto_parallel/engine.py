@@ -136,9 +136,13 @@ class Engine:
         def predict_step(variables, *inputs):
             return model.apply(variables, *inputs)
 
-        self._train_step = jax.jit(train_step)
-        self._eval_step = jax.jit(eval_step)
-        self._predict_step = jax.jit(predict_step)
+        from ...observability.compilation import track_jit
+        self._train_step = track_jit(jax.jit(train_step),
+                                     name="engine.train_step")
+        self._eval_step = track_jit(jax.jit(eval_step),
+                                    name="engine.eval_step")
+        self._predict_step = track_jit(jax.jit(predict_step),
+                                       name="engine.predict_step")
         self._place_params()
         self._prepared = True
         return self

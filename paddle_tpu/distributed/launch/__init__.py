@@ -166,6 +166,29 @@ def _aggregate_metrics(run_dir: str) -> None:
         vlog(0, "launch: merged %d worker metric streams (%d records) → "
              "%s/metrics/summary.json", len(summary["workers"]),
              summary["records"], run_dir)
+        _run_doctor(run_dir)
+
+
+def _run_doctor(run_dir: str) -> None:
+    """Post-run diagnosis (ISSUE 4): rank retrace storms / HBM pressure /
+    stragglers / data starvation into ``<run_dir>/diagnosis.json`` and
+    log the verdicts — the launcher outlives every worker, so this is
+    where the whole-run view exists."""
+    from ...observability import doctor as doctor_mod
+    try:
+        diagnosis = doctor_mod.diagnose(run_dir)
+    except Exception as e:  # diagnosis is best-effort, the run is done
+        vlog(0, "launch: run doctor failed under %s: %r", run_dir, e)
+        return
+    if diagnosis is None:
+        return
+    if diagnosis["healthy"]:
+        vlog(0, "launch: run doctor — no findings (healthy run)")
+        return
+    top = diagnosis["findings"][0]
+    vlog(0, "launch: run doctor — %d finding(s) → %s/diagnosis.json; "
+         "top: [%d] %s: %s", len(diagnosis["findings"]), run_dir,
+         top["severity"], top["kind"], top["title"])
 
 
 def _monitor_heartbeats(run_dir: str, nnodes: int):

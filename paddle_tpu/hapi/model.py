@@ -118,8 +118,16 @@ class Model:
             out = net.apply(params, *inputs)
             return loss_fn(out, label) if loss_fn is not None else 0.0, out
 
-        self._train_step = jax.jit(train_step)
-        self._eval_fn = jax.jit(eval_fn)
+        # compile/retrace accounting (ISSUE 4): every trace of the step
+        # lands on the telemetry timeline as a `compile` record, and a
+        # shape-churning argument is named by the retrace-storm detector
+        self._train_step = obs.track_jit(
+            jax.jit(train_step), name="hapi.train_step",
+            arg_names=("trainable", "rest", "opt_state", "key",
+                       "lr_override", "data[0]", "data[1]", "data[2]",
+                       "data[3]", "data[4]", "data[5]"))
+        self._eval_fn = obs.track_jit(jax.jit(eval_fn),
+                                      name="hapi.eval_fn")
 
     # -- per-batch --------------------------------------------------------
     def _variables(self):
@@ -150,39 +158,51 @@ class Model:
             # top of whatever schedule is active
             lr_override = jnp.asarray(
                 self._optimizer.get_lr() * sup.guard.lr_scale, jnp.float32)
-        if sup is not None:
-            # the armed region covers the jitted step AND the host sync on
-            # its results — where a hung collective actually blocks
-            with sup.watchdog.armed("train_batch"):
+        try:
+            if sup is not None:
+                # the armed region covers the jitted step AND the host
+                # sync on its results — where a hung collective actually
+                # blocks
+                with sup.watchdog.armed("train_batch"):
+                    with obs.span("dispatch") as sp_d:
+                        loss, out, new_params, new_opt_state, finite, \
+                            gnorm = self._train_step(
+                                trainable, rest, self._opt_state,
+                                key, lr_override, *data)
+                    # the readback IS the device sync (bench.py
+                    # methodology: on tunneled TPUs dispatch returns
+                    # before completion, so this span absorbs the device
+                    # compute)
+                    with obs.span("readback") as sp_r:
+                        loss_v = sup.filter_loss(float(loss))
+                        gnorm_v = float(gnorm)
+                self._last_batch_timing = {"dispatch_s": sp_d.elapsed,
+                                           "readback_s": sp_r.elapsed}
+                action = sup.guard_step(loss_v, gnorm_v,
+                                        amp_active=bool(self._amp_level))
+                from ..supervisor.guard import GuardAction
+                if action != GuardAction.OK:
+                    # SKIP / LOWER_LR / ROLLBACK all drop this batch's
+                    # update (params AND optimizer state); ROLLBACK is
+                    # latched on the supervisor for the driving loop to
+                    # execute
+                    return loss_v, [m.accumulate() for m in self._metrics]
+            else:
                 with obs.span("dispatch") as sp_d:
-                    loss, out, new_params, new_opt_state, finite, gnorm = \
+                    loss, out, new_params, new_opt_state, finite, _gnorm = \
                         self._train_step(trainable, rest, self._opt_state,
                                          key, lr_override, *data)
-                # the readback IS the device sync (bench.py methodology:
-                # on tunneled TPUs dispatch returns before completion, so
-                # this span absorbs the device compute)
                 with obs.span("readback") as sp_r:
-                    loss_v = sup.filter_loss(float(loss))
-                    gnorm_v = float(gnorm)
-            self._last_batch_timing = {"dispatch_s": sp_d.elapsed,
-                                       "readback_s": sp_r.elapsed}
-            action = sup.guard_step(loss_v, gnorm_v,
-                                    amp_active=bool(self._amp_level))
-            from ..supervisor.guard import GuardAction
-            if action != GuardAction.OK:
-                # SKIP / LOWER_LR / ROLLBACK all drop this batch's update
-                # (params AND optimizer state); ROLLBACK is latched on the
-                # supervisor for the driving loop to execute
-                return loss_v, [m.accumulate() for m in self._metrics]
-        else:
-            with obs.span("dispatch") as sp_d:
-                loss, out, new_params, new_opt_state, finite, _gnorm = \
-                    self._train_step(trainable, rest, self._opt_state, key,
-                                     lr_override, *data)
-            with obs.span("readback") as sp_r:
-                loss_v = float(loss)
-            self._last_batch_timing = {"dispatch_s": sp_d.elapsed,
-                                       "readback_s": sp_r.elapsed}
+                    loss_v = float(loss)
+                self._last_batch_timing = {"dispatch_s": sp_d.elapsed,
+                                           "readback_s": sp_r.elapsed}
+        except Exception as e:
+            # an allocator OOM kills the step AND the evidence — dump the
+            # last-known per-device watermark table first (ISSUE 4)
+            if obs.is_oom_error(e):
+                obs.oom_postmortem(error=e, step=(
+                    sup.gstep if sup is not None else self._obs_step))
+            raise
         if debug.check_nan_inf_enabled():
             debug.assert_all_finite(finite, context="train_batch")
         if self._nonfinite_budget is not None and not math.isfinite(loss_v):
@@ -407,9 +427,12 @@ class Model:
             reg.gauge("step.tokens_per_sec").set(tps)
             reg.gauge("step.mfu").set(mfu_v)
             sup = self._supervisor
+            cur_step = sup.gstep if sup is not None else self._obs_step
+            # HBM watermark sample on its PTPU_MEM_SAMPLE_EVERY cadence
+            # (no-op off cadence / on backends without allocator stats)
+            obs.get_sampler().sample(cur_step)
             reg.emit("step",
-                     step=(sup.gstep if sup is not None
-                           else self._obs_step),
+                     step=cur_step,
                      step_time_ms=total_s * 1e3, data_ms=data_s * 1e3,
                      compute_ms=compute_ms, readback_ms=readback_ms,
                      tokens=tokens, tokens_per_sec=tps, mfu=mfu_v,

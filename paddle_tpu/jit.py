@@ -71,7 +71,11 @@ def to_static(function=None, input_spec=None, **kw):
     ``ProgramTranslator.enable(False)`` routes calls to the raw python
     function (the reference's debug-eagerly workflow)."""
     def deco(fn):
-        jitted = jax.jit(fn)
+        from .observability.compilation import track_jit
+        # every to_static callsite reports compiles/retraces to the run
+        # doctor under its own name (ISSUE 4)
+        jitted = track_jit(jax.jit(fn),
+                           name=f"to_static.{getattr(fn, '__name__', fn)}")
         import functools
 
         @functools.wraps(fn)

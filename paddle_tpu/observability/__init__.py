@@ -17,7 +17,17 @@ they all report through:
 - :mod:`mfu` — the peak-TFLOPs table and FLOPs-per-token math shared by
   ``bench.py`` and the live per-step MFU in ``hapi.Model.fit``;
 - :mod:`aggregate` — merges ``<run_dir>/metrics/worker-*.jsonl`` into
-  ``summary.json`` (driven by ``launch --run_dir``).
+  ``summary.json`` (driven by ``launch --run_dir``), including the
+  cross-worker straggler skew stats;
+- :mod:`compilation` — compile/retrace tracking (ISSUE 4):
+  :func:`track_jit` signature cache, per-argument retrace diffs and
+  storm detection naming the shape-churning argument;
+- :mod:`memory` — per-step HBM watermark sampling from PJRT
+  ``memory_stats()`` (``PTPU_MEM_SAMPLE_EVERY``) + the OOM postmortem;
+- :mod:`doctor` — ``python -m paddle_tpu.observability.doctor
+  <run_dir>``: ranked ``diagnosis.json`` (retrace storm / HBM creep /
+  straggler / data-starved) with evidence, mirrored into the
+  supervisor report.
 
 Emitters across the stack (hapi step breakdown, collective latencies,
 supervisor events) talk to :func:`get_registry` unconditionally; records
@@ -26,12 +36,18 @@ flow only when a sink is attached — by the run supervisor under its
 
 Env knobs: ``PTPU_METRICS_DIR`` (auto-attach a JSONL writer),
 ``PTPU_METRICS_INTERVAL`` (sink flush/summary period, default 30s),
-``PTPU_TRACE_BUFFER`` (span buffer bound, default 65536).
-See docs/ARCHITECTURE.md "Telemetry".
+``PTPU_TRACE_BUFFER`` (span buffer bound, default 65536),
+``PTPU_MEM_SAMPLE_EVERY`` (HBM watermark cadence, default 16 steps).
+See docs/ARCHITECTURE.md "Telemetry" and "Run doctor".
 """
 from __future__ import annotations
 
-from .aggregate import aggregate_run, read_worker_stream
+from .aggregate import aggregate_run, read_worker_stream, straggler_stats
+from .compilation import (CompileTracker, arg_signature, diff_signatures,
+                          get_tracker, track_jit)
+from .doctor import diagnose, render_report
+from .memory import (MemorySampler, get_sampler, is_oom_error,
+                     oom_postmortem)
 from .mfu import (PEAK_TFLOPS, flops_per_token, mfu, param_count,
                   peak_flops_per_sec, readback_sync)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -54,5 +70,12 @@ __all__ = [
     "PEAK_TFLOPS", "peak_flops_per_sec", "param_count", "flops_per_token",
     "mfu", "readback_sync",
     # aggregation
-    "aggregate_run", "read_worker_stream",
+    "aggregate_run", "read_worker_stream", "straggler_stats",
+    # compile/retrace tracking (ISSUE 4)
+    "CompileTracker", "arg_signature", "diff_signatures", "get_tracker",
+    "track_jit",
+    # memory watermarks (ISSUE 4)
+    "MemorySampler", "get_sampler", "is_oom_error", "oom_postmortem",
+    # run doctor (ISSUE 4)
+    "diagnose", "render_report",
 ]

@@ -23,14 +23,33 @@ from ..framework.log import vlog
 from ..utils import fsio
 from .sinks import metrics_dir
 
-__all__ = ["read_worker_stream", "aggregate_run"]
+__all__ = ["read_worker_stream", "aggregate_run", "straggler_stats",
+           "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS"]
 
 _WORKER_RE = re.compile(r"^worker-(\d+)\.jsonl$")
 
+# version of the record/summary schema this reader understands.  Records
+# carry no schema_version (= v1) or one the reader knows; anything newer
+# is skipped with drop accounting so old tooling stays usable against
+# new runs (and vice versa) instead of mis-parsing them.
+SCHEMA_VERSION = 1
+KNOWN_SCHEMA_VERSIONS = (1,)
 
-def read_worker_stream(path: str) -> List[Dict[str, Any]]:
-    """Parse one worker JSONL file, skipping torn/garbled lines."""
+
+def read_worker_stream(path: str,
+                       drops: Optional[Dict[str, int]] = None
+                       ) -> List[Dict[str, Any]]:
+    """Parse one worker JSONL file, skipping torn/garbled lines and
+    records from a schema this reader doesn't know.
+
+    ``drops``, when given, accumulates the loss accounting:
+    ``torn_lines`` (unparseable — a mid-append death) and
+    ``unknown_schema`` (valid JSON, foreign ``schema_version``)."""
     records = []
+    if drops is None:
+        drops = {}
+    drops.setdefault("torn_lines", 0)
+    drops.setdefault("unknown_schema", 0)
     try:
         raw = fsio.read_bytes(path)
     except OSError:
@@ -42,9 +61,16 @@ def read_worker_stream(path: str) -> List[Dict[str, Any]]:
         try:
             rec = json.loads(line)
         except ValueError:
+            drops["torn_lines"] += 1
             continue  # torn tail from a mid-append death
-        if isinstance(rec, dict):
-            records.append(rec)
+        if not isinstance(rec, dict):
+            drops["torn_lines"] += 1
+            continue
+        if rec.get("schema_version",
+                   SCHEMA_VERSION) not in KNOWN_SCHEMA_VERSIONS:
+            drops["unknown_schema"] += 1
+            continue
+        records.append(rec)
     return records
 
 
@@ -80,6 +106,64 @@ def _step_stats(steps: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def straggler_stats(workers: Dict[int, List[Dict[str, Any]]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Cross-worker skew analysis (ISSUE 4).
+
+    Aligns each worker's ``step`` records by step index and, for every
+    step at least two workers reported, measures the **spread** — the
+    slowest minus the fastest worker's ``step_time_ms``.  Returns
+    ``p50``/``p99`` of the spread (absolute and relative to the median
+    step time), plus the attribution: which worker was slowest how
+    often, and each worker's mean step time.  ``None`` for runs with no
+    alignable steps (single worker, or no step records)."""
+    per_step: Dict[Any, Dict[int, float]] = {}
+    for wid, records in workers.items():
+        for r in records:
+            if r.get("kind") != "step" or r.get("step") is None \
+                    or r.get("step_time_ms") is None:
+                continue
+            # a worker that rolled back revisits a step; keep the last
+            per_step.setdefault(r["step"], {})[wid] = float(
+                r["step_time_ms"])
+    aligned = {s: times for s, times in per_step.items()
+               if len(times) >= 2}
+    if not aligned:
+        return None
+    spreads, all_times = [], []
+    slowest_count: Dict[int, int] = {}
+    for _s, times in sorted(aligned.items(), key=lambda kv: str(kv[0])):
+        vals = sorted(times.values())
+        spreads.append(vals[-1] - vals[0])
+        all_times.extend(vals)
+        worst = max(times, key=lambda w: times[w])
+        slowest_count[worst] = slowest_count.get(worst, 0) + 1
+    spreads.sort()
+    all_times.sort()
+    median_step = _pct(all_times, 50) or 0.0
+    p50, p99 = _pct(spreads, 50), _pct(spreads, 99)
+    worker_means = {
+        str(wid): (sum(t for times in aligned.values()
+                       if wid in times for t in [times[wid]])
+                   / max(1, sum(1 for times in aligned.values()
+                                if wid in times)))
+        for wid in workers}
+    straggler = max(slowest_count, key=lambda w: slowest_count[w])
+    return {
+        "aligned_steps": len(aligned),
+        "spread_ms": {"p50": p50, "p99": p99, "max": spreads[-1]},
+        "relative_spread": {
+            "p50": (p50 / median_step) if median_step else None,
+            "p99": (p99 / median_step) if median_step else None},
+        "median_step_ms": median_step,
+        "slowest_counts": {str(w): c
+                           for w, c in sorted(slowest_count.items())},
+        "worker_mean_step_ms": worker_means,
+        "straggler": straggler,
+        "straggler_fraction": slowest_count[straggler] / len(aligned),
+    }
+
+
 def aggregate_run(run_dir: str,
                   out_path: Optional[str] = None) -> Optional[dict]:
     """Merge every ``worker-*.jsonl`` under ``<run_dir>/metrics`` into
@@ -89,12 +173,13 @@ def aggregate_run(run_dir: str,
     if not os.path.isdir(mdir):
         return None
     workers: Dict[int, List[Dict[str, Any]]] = {}
+    drops: Dict[str, int] = {}
     for name in sorted(os.listdir(mdir)):
         m = _WORKER_RE.match(name)
         if not m:
             continue
         workers[int(m.group(1))] = read_worker_stream(
-            os.path.join(mdir, name))
+            os.path.join(mdir, name), drops=drops)
     if not workers:
         return None
 
@@ -117,9 +202,11 @@ def aggregate_run(run_dir: str,
         kinds_total[k] = kinds_total.get(k, 0) + 1
     ts = [float(r["ts"]) for r in all_records if "ts" in r]
     summary = {
+        "schema_version": SCHEMA_VERSION,
         "run_dir": os.path.abspath(run_dir),
         "workers": sorted(workers),
         "records": len(all_records),
+        "dropped": drops,
         "kinds": dict(sorted(kinds_total.items())),
         "supervisor_events": {k: v for k, v in sorted(kinds_total.items())
                               if k.startswith("supervisor.")},
@@ -127,6 +214,7 @@ def aggregate_run(run_dir: str,
         "overall": _step_stats(
             [r for r in all_records if r.get("kind") == "step"]),
         "per_worker": per_worker,
+        "straggler": straggler_stats(workers),
     }
     out_path = out_path or os.path.join(mdir, "summary.json")
     fsio.atomic_write_bytes(

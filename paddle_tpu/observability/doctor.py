@@ -1,0 +1,421 @@
+"""Run doctor (ISSUE 4): post-run diagnosis of the silent MFU killers.
+
+``python -m paddle_tpu.observability.doctor <run_dir>`` reads everything
+a run left behind — the per-worker JSONL timelines under
+``<run_dir>/metrics/``, the cross-worker ``summary.json`` (recomputed if
+stale/absent), and the supervisor's post-mortem reports — and emits a
+ranked ``<run_dir>/diagnosis.json`` plus a human-readable report.
+
+Diagnosis taxonomy (each finding carries a 0–100 severity and concrete
+evidence lines):
+
+- ``oom``            — a ``memory.oom`` postmortem record exists; the
+                       watermark table names the fullest device.
+- ``retrace_storm``  — ``compile.retrace_storm`` records (or a high
+                       retrace count) name the function and the argument
+                       whose signature churn forced the recompiles.
+- ``hbm_creep``      — per-device ``bytes_in_use`` trends upward across
+                       ``memory`` samples, or the peak watermark sits
+                       near ``bytes_limit``.
+- ``straggler``      — cross-worker step-time spread (p50/p99, from
+                       :func:`aggregate.straggler_stats`) attributes the
+                       consistently slowest worker, with per-worker
+                       ``collective.<op>.ms`` evidence from each
+                       worker's final ``metrics.snapshot`` record (a
+                       straggler computes while its peers wait in the
+                       collective).
+- ``data_starved``   — data-wait dominates the step-time breakdown.
+- ``unstable``       — the supervisor logged rollbacks / watchdog
+                       timeouts / step failures (corroborating context,
+                       ranked below the causes above).
+
+Verdicts are mirrored into ``supervisor_report.json`` (kind
+``doctor.verdict``) so the run's one post-mortem file carries the
+diagnosis too.  See docs/ARCHITECTURE.md "Run doctor".
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from ..framework.log import vlog
+from ..utils import fsio
+from .aggregate import (SCHEMA_VERSION, aggregate_run, read_worker_stream,
+                        straggler_stats, _WORKER_RE)
+from .sinks import metrics_dir
+
+__all__ = ["diagnose", "render_report", "main"]
+
+# tunables: thresholds a finding must clear before it is reported
+RETRACE_WARN = 3            # retraces (not first compiles) per function
+HBM_NEAR_LIMIT = 0.92       # peak/limit utilization
+HBM_CREEP_FRAC = 0.05       # in_use growth first→last sample, fraction
+STRAGGLER_REL_SPREAD = 0.2  # p99 spread / median step time
+DATA_STARVED_FRAC = 0.3     # data_ms / step_time_ms
+
+
+def _finding(kind: str, severity: float, title: str,
+             evidence: List[str], **data) -> Dict[str, Any]:
+    return {"kind": kind, "severity": int(max(0, min(100, severity))),
+            "title": title, "evidence": evidence, "data": data}
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _read_workers(run_dir: str) -> Dict[int, List[Dict[str, Any]]]:
+    mdir = metrics_dir(run_dir)
+    workers: Dict[int, List[Dict[str, Any]]] = {}
+    if not os.path.isdir(mdir):
+        return workers
+    for name in sorted(os.listdir(mdir)):
+        m = _WORKER_RE.match(name)
+        if m:
+            workers[int(m.group(1))] = read_worker_stream(
+                os.path.join(mdir, name))
+    return workers
+
+
+def _read_supervisor_events(run_dir: str) -> List[Dict[str, Any]]:
+    events: List[Dict[str, Any]] = []
+    for name in ("supervisor_report.json", "launcher_report.json"):
+        path = os.path.join(run_dir, name)
+        try:
+            payload = json.loads(fsio.read_bytes(path))
+        except (OSError, ValueError):
+            continue
+        for e in payload.get("events", []):
+            if isinstance(e, dict):
+                events.append({**e, "_source": name})
+    return events
+
+
+# -- checks (each returns a list of findings) ------------------------------
+def _check_compilation(workers) -> List[Dict[str, Any]]:
+    findings = []
+    storms: Dict[str, Dict[str, Any]] = {}
+    retraces: Dict[str, int] = {}
+    culprit_freq: Dict[str, Dict[str, int]] = {}
+    for wid, records in workers.items():
+        for r in records:
+            if r.get("kind") == "compile.retrace_storm":
+                fn = str(r.get("function"))
+                storms.setdefault(fn, {"count": 0, "worker": wid,
+                                       "culprit": r.get("culprit")})
+                storms[fn]["count"] += 1
+                if r.get("culprit"):
+                    storms[fn]["culprit"] = r["culprit"]
+            elif r.get("kind") == "compile" and r.get("retrace"):
+                fn = str(r.get("function"))
+                retraces[fn] = retraces.get(fn, 0) + 1
+                for c in r.get("changed") or []:
+                    freq = culprit_freq.setdefault(fn, {})
+                    freq[c["arg"]] = freq.get(c["arg"], 0) + 1
+    for fn, info in storms.items():
+        n = retraces.get(fn, info["count"])
+        culprit = info["culprit"]
+        if not culprit and culprit_freq.get(fn):
+            culprit = max(culprit_freq[fn], key=culprit_freq[fn].get)
+        detail = _culprit_detail(workers, fn, culprit)
+        ev = [f"{info['count']} retrace storm(s) on {fn} "
+              f"({n} retraces total)",
+              f"offending argument: {culprit!r}"
+              + (f" — {detail}" if detail else "")]
+        findings.append(_finding(
+            "retrace_storm", 60 + 10 * min(3, info["count"]),
+            f"retrace storm in {fn} driven by argument {culprit!r}",
+            ev, function=fn, retraces=n, storms=info["count"],
+            argument=culprit))
+    for fn, n in retraces.items():
+        if fn in storms or n < RETRACE_WARN:
+            continue
+        culprit = (max(culprit_freq[fn], key=culprit_freq[fn].get)
+                   if culprit_freq.get(fn) else None)
+        findings.append(_finding(
+            "retrace_storm", 40 + 5 * min(6, n),
+            f"{n} retraces of {fn} (most-changed argument {culprit!r})",
+            [f"{n} retraces beyond the first compile",
+             f"signature churn concentrated in {culprit!r}"],
+            function=fn, retraces=n, storms=0, argument=culprit))
+    return findings
+
+
+def _culprit_detail(workers, fn: str, culprit) -> Optional[str]:
+    """One concrete shape transition for the evidence line."""
+    if culprit is None:
+        return None
+    for records in workers.values():
+        for r in records:
+            if r.get("kind") != "compile" or r.get("function") != fn:
+                continue
+            for c in r.get("changed") or []:
+                if c["arg"] == culprit and c.get("detail"):
+                    return c["detail"]
+    return None
+
+
+def _check_memory(workers) -> List[Dict[str, Any]]:
+    findings = []
+    series: Dict[str, List[Dict[str, Any]]] = {}
+    oom: Optional[Dict[str, Any]] = None
+    for records in workers.values():
+        for r in records:
+            if r.get("kind") == "memory":
+                for dev, row in (r.get("devices") or {}).items():
+                    series.setdefault(dev, []).append(row)
+            elif r.get("kind") == "memory.oom":
+                oom = r
+    if oom is not None:
+        devices = oom.get("devices") or {}
+        fullest = max(devices,
+                      key=lambda d: devices[d].get("utilization", 0),
+                      default=None)
+        ev = [f"memory.oom postmortem at step {oom.get('step')}: "
+              f"{oom.get('error') or 'allocator error'}"]
+        if fullest:
+            row = devices[fullest]
+            ev.append(
+                f"fullest device {fullest}: "
+                f"{_fmt_bytes(row.get('bytes_in_use', 0))} in use / "
+                f"{_fmt_bytes(row.get('bytes_limit', 0))} limit "
+                f"(peak {_fmt_bytes(row.get('peak_bytes_in_use', 0))})")
+        findings.append(_finding(
+            "oom", 95, f"device OOM (fullest device: {fullest})", ev,
+            step=oom.get("step"), device=fullest))
+    for dev, rows in series.items():
+        in_use = [r["bytes_in_use"] for r in rows if "bytes_in_use" in r]
+        limit = next((r["bytes_limit"] for r in rows
+                      if r.get("bytes_limit")), None)
+        peak = max((r.get("peak_bytes_in_use", 0) for r in rows),
+                   default=0)
+        if limit and peak / limit >= HBM_NEAR_LIMIT:
+            findings.append(_finding(
+                "hbm_creep", 70 + 20 * min(1.0, peak / limit - 0.9) / 0.1,
+                f"HBM watermark near limit on {dev}",
+                [f"peak {_fmt_bytes(peak)} of {_fmt_bytes(limit)} limit "
+                 f"({peak / limit:.1%})"],
+                device=dev, peak=peak, limit=limit))
+        elif len(in_use) >= 3 and in_use[0] > 0:
+            growth = (in_use[-1] - in_use[0]) / in_use[0]
+            # monotone-ish creep, not one transient spike
+            rising = sum(b >= a for a, b in zip(in_use, in_use[1:]))
+            if growth >= HBM_CREEP_FRAC and rising >= 0.7 * (len(in_use) - 1):
+                findings.append(_finding(
+                    "hbm_creep", 35 + 100 * min(0.4, growth),
+                    f"HBM usage creeping on {dev} (+{growth:.1%})",
+                    [f"bytes_in_use {_fmt_bytes(in_use[0])} → "
+                     f"{_fmt_bytes(in_use[-1])} across "
+                     f"{len(in_use)} samples"],
+                    device=dev, growth=growth, samples=len(in_use)))
+    return findings
+
+
+def _collective_skew_evidence(workers, straggler: int) -> List[str]:
+    """Compare per-worker collective histograms from the final
+    ``metrics.snapshot`` records: a straggler shows *less* collective
+    wait than its peers (they wait for it)."""
+    per_worker: Dict[int, Dict[str, float]] = {}
+    for wid, records in workers.items():
+        snap = next((r for r in reversed(records)
+                     if r.get("kind") == "metrics.snapshot"), None)
+        if not snap:
+            continue
+        for name, m in (snap.get("snapshot") or {}).items():
+            if (name.startswith("collective.") and name.endswith(".ms")
+                    and isinstance(m, dict) and m.get("count")):
+                per_worker.setdefault(wid, {})[name] = (
+                    m["sum"] / m["count"])
+        per_worker.setdefault(wid, {})
+    if len(per_worker) < 2:
+        return []
+    ev = []
+    ops = sorted({op for d in per_worker.values() for op in d})
+    best_op, best_gap = None, 0.0
+    for op in ops:
+        vals = {w: d[op] for w, d in per_worker.items() if op in d}
+        if straggler not in vals or len(vals) < 2:
+            continue
+        others = [v for w, v in vals.items() if w != straggler]
+        gap = (sum(others) / len(others)) - vals[straggler]
+        if gap > best_gap:
+            best_op, best_gap = op, gap
+    if best_op is not None and best_gap > 0:
+        op_label = best_op[len("collective."):-len(".ms")]
+        ev.append(
+            f"peers wait in {op_label}: mean {best_gap:.1f}ms longer "
+            f"than worker {straggler} (the straggler computes while "
+            f"the fleet blocks)")
+    return ev
+
+
+def _check_straggler(workers, summary) -> List[Dict[str, Any]]:
+    stats = (summary or {}).get("straggler") or straggler_stats(workers)
+    if not stats:
+        return []
+    rel = (stats.get("relative_spread") or {}).get("p99")
+    if rel is None or rel < STRAGGLER_REL_SPREAD:
+        return []
+    wid = stats["straggler"]
+    frac = stats["straggler_fraction"]
+    means = stats.get("worker_mean_step_ms") or {}
+    ev = [f"p99 cross-worker step spread "
+          f"{stats['spread_ms']['p99']:.1f}ms = {rel:.0%} of the "
+          f"median step ({stats['median_step_ms']:.1f}ms) across "
+          f"{stats['aligned_steps']} aligned steps",
+          f"worker {wid} slowest on {frac:.0%} of aligned steps"]
+    if means:
+        ev.append("mean step ms per worker: " + ", ".join(
+            f"w{w}={m:.1f}" for w, m in sorted(means.items())))
+    ev += _collective_skew_evidence(workers, wid)
+    sev = 50 + 40 * min(1.0, rel) * frac
+    return [_finding(
+        "straggler", sev,
+        f"worker {wid} is a straggler ({frac:.0%} of steps, "
+        f"p99 spread {rel:.0%} of step time)",
+        ev, worker=wid, fraction=frac, relative_spread_p99=rel,
+        spread_ms=stats["spread_ms"])]
+
+
+def _check_data_starved(workers) -> List[Dict[str, Any]]:
+    data_ms, step_ms = [], []
+    for records in workers.values():
+        for r in records:
+            if r.get("kind") == "step" and r.get("step_time_ms"):
+                step_ms.append(float(r["step_time_ms"]))
+                data_ms.append(float(r.get("data_ms") or 0.0))
+    if len(step_ms) < 3:
+        return []
+    frac = sum(data_ms) / max(1e-9, sum(step_ms))
+    if frac < DATA_STARVED_FRAC:
+        return []
+    return [_finding(
+        "data_starved", 30 + 50 * min(1.0, frac),
+        f"data pipeline starving the device ({frac:.0%} of step time)",
+        [f"data-wait is {frac:.0%} of total step time across "
+         f"{len(step_ms)} steps"], fraction=frac)]
+
+
+def _check_supervisor(events) -> List[Dict[str, Any]]:
+    if not events:
+        return []
+    counts: Dict[str, int] = {}
+    for e in events:
+        k = str(e.get("kind"))
+        counts[k] = counts.get(k, 0) + 1
+    bad = {k: v for k, v in counts.items()
+           if k in ("rollback", "watchdog_timeout", "step_failure",
+                    "guard_rollback", "worker_lost", "budget_exhausted")}
+    if not bad:
+        return []
+    total = sum(bad.values())
+    ev = [f"{v}× {k}" for k, v in sorted(bad.items())]
+    return [_finding(
+        "unstable", 25 + 5 * min(10, total),
+        "supervisor intervened during the run",
+        ev, events=bad)]
+
+
+def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
+    """Run every check against ``run_dir``; returns the diagnosis dict
+    (findings ranked most-severe first) or ``None`` when the run left no
+    telemetry at all.  ``write=True`` also lands
+    ``<run_dir>/diagnosis.json`` (atomic) and mirrors the verdicts into
+    the supervisor report."""
+    workers = _read_workers(run_dir)
+    if not workers:
+        return None
+    # the cross-worker summary: reuse a fresh one, else recompute
+    summary = aggregate_run(run_dir)
+    events = _read_supervisor_events(run_dir)
+    findings: List[Dict[str, Any]] = []
+    findings += _check_memory(workers)           # oom outranks everything
+    findings += _check_compilation(workers)
+    findings += _check_straggler(workers, summary)
+    findings += _check_data_starved(workers)
+    findings += _check_supervisor(events)
+    findings.sort(key=lambda f: (-f["severity"], f["kind"]))
+    diagnosis = {
+        "schema_version": SCHEMA_VERSION,
+        "run_dir": os.path.abspath(run_dir),
+        "workers": sorted(workers),
+        "records": sum(len(r) for r in workers.values()),
+        "supervisor_events": len(events),
+        "healthy": not findings,
+        "findings": findings,
+    }
+    if write:
+        fsio.atomic_write_bytes(
+            os.path.join(run_dir, "diagnosis.json"),
+            json.dumps(diagnosis, indent=1, default=str).encode("utf-8"))
+        _mirror_to_supervisor(run_dir, findings)
+    return diagnosis
+
+
+def _mirror_to_supervisor(run_dir: str,
+                          findings: List[Dict[str, Any]]) -> None:
+    """Append one ``doctor.verdict`` event per finding to the run's
+    supervisor report, so the post-mortem file carries the diagnosis."""
+    path = os.path.join(run_dir, "supervisor_report.json")
+    if not os.path.exists(path):
+        return
+    try:
+        from ..supervisor.report import SupervisorReport
+        report = SupervisorReport.load(path)
+        for f in findings:
+            report.record("doctor.verdict", verdict=f["kind"],
+                          severity=f["severity"], title=f["title"])
+        if not findings:
+            report.record("doctor.verdict", verdict="healthy",
+                          severity=0, title="no findings")
+    except (OSError, ValueError, KeyError) as e:
+        vlog(0, "doctor: could not mirror verdicts into %s: %s", path, e)
+
+
+def render_report(diagnosis: Dict[str, Any]) -> str:
+    """The human-readable half of the diagnosis."""
+    lines = [f"run doctor — {diagnosis['run_dir']}",
+             f"workers: {len(diagnosis['workers'])}, "
+             f"records: {diagnosis['records']}, "
+             f"supervisor events: {diagnosis['supervisor_events']}"]
+    if diagnosis["healthy"]:
+        lines.append("no findings — the run looks healthy.")
+        return "\n".join(lines)
+    lines.append(f"{len(diagnosis['findings'])} finding(s), "
+                 "most severe first:")
+    for i, f in enumerate(diagnosis["findings"], 1):
+        lines.append(f"  {i}. [{f['severity']:3d}] {f['kind']}: "
+                     f"{f['title']}")
+        for ev in f["evidence"]:
+            lines.append(f"       - {ev}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    args = [a for a in args if a != "--json"]
+    if len(args) != 1:
+        print("usage: python -m paddle_tpu.observability.doctor "  # noqa: print
+              "[--json] <run_dir>", file=sys.stderr)
+        return 2
+    diagnosis = diagnose(args[0])
+    if diagnosis is None:
+        print(f"no telemetry under {args[0]} — nothing to "  # noqa: print
+              "diagnose", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(diagnosis, indent=1, default=str))  # noqa: print
+    else:
+        print(render_report(diagnosis))  # noqa: print
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

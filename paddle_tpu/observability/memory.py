@@ -1,0 +1,194 @@
+"""Per-step HBM accounting (ISSUE 4).
+
+PJRT's allocator telemetry (``device.memory_stats()``: ``bytes_in_use``,
+``peak_bytes_in_use``, ``largest_alloc_size``, ``bytes_limit``) is the
+only ground truth for the second silent MFU killer — HBM pressure.  A
+run that creeps toward the limit starts fragmenting, then rematerializing,
+then OOMs; by the time the OOM surfaces, the interesting state is gone.
+This module samples the watermark table on a step cadence and keeps the
+last table around so an OOM leaves a postmortem.
+
+- :class:`MemorySampler` — samples every ``PTPU_MEM_SAMPLE_EVERY`` steps
+  (default 16; PJRT stats are a host RPC on some backends, so not every
+  step).  Each sample emits one ``memory`` record with the per-device
+  table plus deltas vs the previous sample, and refreshes gauges
+  ``memory.bytes_in_use[device=..]`` / ``memory.peak_bytes[device=..]``
+  / ``memory.utilization[device=..]``.
+- :func:`oom_postmortem` — called when a step dies with an allocator
+  error (:func:`is_oom_error`): emits a ``memory.oom`` record carrying
+  the last-known watermark table per device — the state *before* the
+  allocation that killed the run.
+
+CPU backends report no allocator stats ({}); the sampler then emits
+nothing and costs one dict probe per cadence.  Tests inject
+``stats_fn``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["MEM_SAMPLE_ENV", "MemorySampler", "default_sample_every",
+           "device_stats_table", "is_oom_error", "oom_postmortem",
+           "get_sampler", "reset_sampler"]
+
+MEM_SAMPLE_ENV = "PTPU_MEM_SAMPLE_EVERY"
+
+# the PJRT stat keys a watermark table carries (when the backend has them)
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size",
+         "bytes_limit", "bytes_reserved", "num_allocs")
+
+
+def default_sample_every() -> int:
+    return max(1, int(os.environ.get(MEM_SAMPLE_ENV, "16")))
+
+
+def device_stats_table() -> Dict[str, Dict[str, int]]:
+    """{``platform:id``: PJRT stats} for every *addressable* device —
+    the per-device accounting the cross-replica weight-update analysis
+    assumes.  Devices without allocator telemetry are omitted."""
+    from .. import device as device_mod
+    return device_mod.local_memory_stats()
+
+
+class MemorySampler:
+    """Step-cadenced HBM watermark sampler.
+
+    ``stats_fn`` returns the per-device table (defaults to
+    :func:`device_stats_table`); ``every`` defaults to the
+    ``PTPU_MEM_SAMPLE_EVERY`` env knob.  ``sample(step)`` is a no-op off
+    cadence, so it can sit unconditionally in the per-step telemetry
+    path.
+    """
+
+    def __init__(self, every: Optional[int] = None,
+                 stats_fn: Optional[Callable[[], Dict[str, Dict[str, int]]]]
+                 = None, registry=None):
+        self.every = default_sample_every() if every is None else max(
+            1, int(every))
+        self._stats_fn = stats_fn or device_stats_table
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._prev: Dict[str, Dict[str, int]] = {}
+        self.last_table: Dict[str, Dict[str, int]] = {}
+        self.last_step: Optional[int] = None
+        self.samples = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from .registry import get_registry
+        return get_registry()
+
+    def sample(self, step: Optional[int] = None,
+               force: bool = False) -> Optional[Dict[str, Any]]:
+        """Take one sample (off-cadence calls return None).  The emitted
+        ``memory`` record carries, per device, the raw watermark keys
+        plus ``in_use_delta`` / ``largest_alloc_delta`` vs the previous
+        sample — the creep signal a doctor trends on."""
+        if not force and step is not None and step % self.every != 0:
+            return None
+        try:
+            table = {dev: {k: int(v) for k, v in stats.items()
+                           if k in _KEYS}
+                     for dev, stats in self._stats_fn().items()}
+        except Exception as e:  # sampling must never hurt the run
+            from ..framework.log import vlog
+            vlog(1, "observability: memory sample failed: %r", e)
+            return None
+        if not table:
+            return None
+        reg = self._reg()
+        devices: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            prev = self._prev
+            for dev, stats in table.items():
+                row: Dict[str, Any] = dict(stats)
+                p = prev.get(dev, {})
+                if "bytes_in_use" in stats:
+                    row["in_use_delta"] = (
+                        stats["bytes_in_use"] - p.get("bytes_in_use",
+                                                      stats["bytes_in_use"]))
+                if "largest_alloc_size" in stats:
+                    row["largest_alloc_delta"] = (
+                        stats["largest_alloc_size"]
+                        - p.get("largest_alloc_size",
+                                stats["largest_alloc_size"]))
+                limit = stats.get("bytes_limit")
+                if limit:
+                    row["utilization"] = stats.get("bytes_in_use", 0) / limit
+                devices[dev] = row
+            self._prev = table
+            self.last_table = devices
+            self.last_step = step
+            self.samples += 1
+        for dev, row in devices.items():
+            if "bytes_in_use" in row:
+                reg.gauge(f"memory.bytes_in_use[device={dev}]").set(
+                    row["bytes_in_use"])
+            if "peak_bytes_in_use" in row:
+                reg.gauge(f"memory.peak_bytes[device={dev}]").set(
+                    row["peak_bytes_in_use"])
+            if "utilization" in row:
+                reg.gauge(f"memory.utilization[device={dev}]").set(
+                    row["utilization"])
+        record = {"step": step, "devices": devices}
+        reg.emit("memory", **record)
+        return record
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Does this exception look like a device allocator OOM?  XLA
+    surfaces them as RESOURCE_EXHAUSTED ``XlaRuntimeError``s; match on
+    the message so the check needs no backend-private exception types."""
+    msg = str(exc).lower()
+    return ("resource_exhausted" in msg or "out of memory" in msg
+            or ("allocating" in msg and "exceeds" in msg))
+
+
+def oom_postmortem(sampler: Optional[MemorySampler] = None,
+                   error: Optional[BaseException] = None,
+                   step: Optional[int] = None) -> Dict[str, Any]:
+    """Dump the last-known watermark table per device as a
+    ``memory.oom`` record (and return it).  Tries one fresh sample first
+    — often the allocator survives the failed allocation and the
+    *current* table shows exactly how full each device is."""
+    sampler = sampler or get_sampler()
+    try:
+        sampler.sample(step=step, force=True)
+    except Exception:  # noqa: swallow
+        pass  # post-OOM stats RPC may itself die; the stale table below
+        # is still the best evidence we have
+    table = sampler.last_table
+    reg = sampler._reg()
+    reg.counter("memory.oom_count").inc()
+    record = {"step": step if step is not None else sampler.last_step,
+              "error": (f"{type(error).__name__}: {error}"[:512]
+                        if error is not None else None),
+              "devices": table}
+    reg.emit("memory.oom", **record)
+    from ..framework.log import vlog
+    vlog(0, "observability: OOM postmortem — %d device watermark rows "
+         "recorded", len(table))
+    return record
+
+
+_sampler_lock = threading.Lock()
+_sampler: Optional[MemorySampler] = None
+
+
+def get_sampler() -> MemorySampler:
+    """The process-global sampler (honors ``PTPU_MEM_SAMPLE_EVERY``)."""
+    global _sampler
+    with _sampler_lock:
+        if _sampler is None:
+            _sampler = MemorySampler()
+        return _sampler
+
+
+def reset_sampler() -> None:
+    """Drop the global sampler (tests re-read the env knob)."""
+    global _sampler
+    with _sampler_lock:
+        _sampler = None

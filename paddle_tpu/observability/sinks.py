@@ -162,10 +162,48 @@ class StderrSummary:
 
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+# instrument-name label convention: "memory.bytes_in_use[device=tpu:0]"
+# → metric paddle_tpu_memory_bytes_in_use{device="tpu:0"}
+_PROM_LABELED = re.compile(r"^(?P<base>[^\[\]]+)\[(?P<labels>[^\]]*)\]$")
 
 
 def _prom_name(name: str) -> str:
     return "paddle_tpu_" + _PROM_BAD.sub("_", name)
+
+
+def _prom_label_value(value: str) -> str:
+    """Escape a label VALUE per the Prometheus text exposition format
+    (backslash, double-quote, newline) — values pass through verbatim
+    otherwise, unlike metric/label names which get sanitized."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _prom_parse(name: str):
+    """Split an instrument name into (prom metric name, label dict).
+    Labels ride in a ``[k=v,k2=v2]`` suffix; names stay sanitized,
+    values only escaped (a device label like ``tpu:0`` must survive)."""
+    m = _PROM_LABELED.match(name)
+    if not m:
+        return _prom_name(name), {}
+    labels = {}
+    for part in m.group("labels").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[_PROM_BAD.sub("_", k.strip())] = v.strip()
+    return _prom_name(m.group("base")), labels
+
+
+def _prom_labels(labels: Dict[str, str], extra: Optional[Dict[str, Any]]
+                 = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(f'{k}="{_prom_label_value(v)}"'
+                    for k, v in sorted(merged.items()))
+    return "{" + body + "}"
 
 
 class PrometheusTextfile:
@@ -193,24 +231,32 @@ class PrometheusTextfile:
         lines = []
         if self._registry is None:
             return ""
+        typed = set()
         for name, m in self._registry.snapshot().items():
-            pname = _prom_name(name)
+            pname, labels = _prom_parse(name)
+            lb = _prom_labels(labels)
             if m["type"] == "counter":
-                lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname} {m['value']:g}")
+                if pname not in typed:
+                    lines.append(f"# TYPE {pname} counter")
+                    typed.add(pname)
+                lines.append(f"{pname}{lb} {m['value']:g}")
             elif m["type"] == "gauge":
                 if m["value"] is None:
                     continue
-                lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {m['value']:g}")
+                if pname not in typed:
+                    lines.append(f"# TYPE {pname} gauge")
+                    typed.add(pname)
+                lines.append(f"{pname}{lb} {m['value']:g}")
             else:  # histogram → summary (count/sum + quantile gauges)
-                lines.append(f"# TYPE {pname} summary")
+                if pname not in typed:
+                    lines.append(f"# TYPE {pname} summary")
+                    typed.add(pname)
                 for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
                     if m.get(key) is not None:
-                        lines.append(
-                            f'{pname}{{quantile="{q}"}} {m[key]:g}')
-                lines.append(f"{pname}_sum {m['sum']:g}")
-                lines.append(f"{pname}_count {m['count']:g}")
+                        qlb = _prom_labels(labels, {"quantile": str(q)})
+                        lines.append(f"{pname}{qlb} {m[key]:g}")
+                lines.append(f"{pname}_sum{lb} {m['sum']:g}")
+                lines.append(f"{pname}_count{lb} {m['count']:g}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def flush(self) -> None:

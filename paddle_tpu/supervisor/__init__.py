@@ -153,6 +153,18 @@ class RunSupervisor:
         self.heartbeat.stop()
         install_global(self._prev_global)
         self.watchdog.close()
+        # final per-worker instrument snapshot onto this worker's JSONL
+        # stream (ISSUE 4): carries the collective.<op>.ms histograms and
+        # compile counters the run doctor needs for cross-worker
+        # straggler/retrace attribution
+        try:
+            from ..observability import get_registry
+            reg = get_registry()
+            reg.emit("metrics.snapshot", step=self.gstep,
+                     worker=self.heartbeat.worker_id,
+                     snapshot=reg.snapshot())
+        except Exception as e:
+            vlog(1, "supervisor: final metrics snapshot failed: %r", e)
         self.report.record("run_end", status=status, step=self.gstep,
                            rollbacks=self.rollback.used,
                            timeouts=self.watchdog.timeouts,

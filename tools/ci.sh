@@ -34,10 +34,25 @@ if (( SHARD == 0 )); then
     # sharding happens to place its files elsewhere
     python -m pytest -q -m faults tests/test_fault_tolerance.py \
         tests/test_supervisor.py
-    # telemetry tier (ISSUE 3): registry/tracing/sinks/aggregation + the
-    # e2e step-breakdown/MFU records contract
-    python -m pytest -q -m telemetry tests/test_observability.py
+    # telemetry tier (ISSUE 3/4): registry/tracing/sinks/aggregation +
+    # compile/memory/doctor diagnosis + the e2e records contracts
+    python -m pytest -q -m telemetry tests/test_observability.py \
+        tests/test_doctor.py
+    # run-doctor smoke (ISSUE 4): diagnose the checked-in degraded
+    # fixture run; fail on nonzero exit or an empty diagnosis
+    DOCTOR_TMP=$(mktemp -d)
+    cp -r tests/fixtures/doctor_run "$DOCTOR_TMP/run"
+    python -m paddle_tpu.observability.doctor "$DOCTOR_TMP/run"
+    python - "$DOCTOR_TMP/run/diagnosis.json" <<'PYEOF'
+import json, sys
+diag = json.load(open(sys.argv[1]))
+assert diag["findings"], "doctor smoke: empty diagnosis on degraded fixture"
+kinds = {f["kind"] for f in diag["findings"]}
+assert {"retrace_storm", "straggler"} <= kinds, f"doctor smoke: {kinds}"
+PYEOF
+    rm -rf "$DOCTOR_TMP"
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
-    echo "api-guard + lints + faults tier + telemetry tier + bench smoke ok"
+    echo "api-guard + lints + faults tier + telemetry tier + doctor" \
+         "smoke + bench smoke ok"
 fi
 echo "shard ${SHARD} green"
