@@ -115,7 +115,13 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
     if args.nnodes <= 1:
         sys.argv = [args.script] + list(args.script_args)
-        runpy.run_path(args.script, run_name="__main__")
+        stop_live = (_live_aggregate(args.run_dir) if args.run_dir
+                     else None)
+        try:
+            runpy.run_path(args.script, run_name="__main__")
+        finally:
+            if stop_live is not None:
+                stop_live()
         if args.run_dir:
             _aggregate_metrics(args.run_dir)
         return 0
@@ -135,14 +141,23 @@ def launch(argv: Optional[List[str]] = None) -> int:
                "--nnodes", str(args.nnodes), "--master", args.master,
                "--node_rank", str(rank), args.script] + list(args.script_args)
         procs.append(subprocess.Popen(cmd, env=env_for(rank)))
-    stop_monitor = None
+    stop_monitor = stop_live = None
     if args.run_dir:
-        stop_monitor = _monitor_heartbeats(args.run_dir, args.nnodes)
+        # one launcher report shared by the heartbeat monitor and the
+        # live aggregator — both record onto the same event log
+        from ...supervisor.report import SupervisorReport
+        report = SupervisorReport(
+            os.path.join(args.run_dir, "launcher_report.json"))
+        stop_monitor = _monitor_heartbeats(args.run_dir, args.nnodes,
+                                           report)
+        stop_live = _live_aggregate(args.run_dir, report)
     rc = 0
     for rank, proc in enumerate(procs):
         code = proc.wait()
         vlog(1, "rank %d exited with %d", rank, code)
         rc = rc or code
+    if stop_live is not None:
+        stop_live()
     if stop_monitor is not None:
         stop_monitor()
     if args.run_dir:
@@ -191,7 +206,34 @@ def _run_doctor(run_dir: str) -> None:
          top["severity"], top["kind"], top["title"])
 
 
-def _monitor_heartbeats(run_dir: str, nnodes: int):
+def _live_aggregate(run_dir: str, report=None):
+    """In-flight cross-worker aggregation (ISSUE 5): a background
+    :class:`~paddle_tpu.observability.monitor.LiveAggregator` tail-reads
+    the workers' still-growing JSONL streams every
+    ``PTPU_MONITOR_INTERVAL`` seconds, re-runs the doctor's rules on the
+    window, keeps ``<run_dir>/live_status.json`` rolling, and records
+    ``monitor.alert`` events in ``launcher_report.json`` the moment a
+    verdict first fires — the launcher names a retrace storm or a
+    straggler while the run still burns chips, not at teardown.
+    Returns a callable that stops the thread (with one final poll)."""
+    from ...observability.monitor import LiveAggregator
+
+    if report is None:
+        from ...supervisor.report import SupervisorReport
+        report = SupervisorReport(os.path.join(run_dir,
+                                               "launcher_report.json"))
+    aggregator = LiveAggregator(run_dir, report=report).start()
+
+    def stop_fn():
+        aggregator.stop()
+        if aggregator.alerts:
+            vlog(0, "launch: live monitor raised %d alert(s); first: %s",
+                 len(aggregator.alerts), aggregator.alerts[0]["title"])
+
+    return stop_fn
+
+
+def _monitor_heartbeats(run_dir: str, nnodes: int, report=None):
     """Launcher-side health view (ISSUE 2): poll the workers' heartbeat
     files and record every healthy/degraded/lost-worker transition in
     ``<run_dir>/launcher_report.json`` — the acting end of the heartbeat
@@ -203,7 +245,9 @@ def _monitor_heartbeats(run_dir: str, nnodes: int):
     from ...supervisor.heartbeat import HeartbeatMonitor, default_interval
     from ...supervisor.report import SupervisorReport
 
-    report = SupervisorReport(os.path.join(run_dir, "launcher_report.json"))
+    if report is None:
+        report = SupervisorReport(os.path.join(run_dir,
+                                               "launcher_report.json"))
     monitor = HeartbeatMonitor(run_dir, expected=nnodes, report=report)
     stop = threading.Event()
 

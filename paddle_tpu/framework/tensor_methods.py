@@ -57,12 +57,38 @@ def _delegate(name, kind: str = "pt"):
     return method
 
 
+def _resolve_targets() -> list:
+    """The classes the method surface lands on.  ``ArrayImpl`` lives in
+    a jax-private module; a jax refactor that moves it must DEGRADE the
+    install (RuntimeWarning, tracer-only surface) — never hard-fail
+    ``import paddle_tpu`` (ADVICE round 5)."""
+    import warnings
+    targets = []
+    try:
+        from jax._src.array import ArrayImpl
+        targets.append(ArrayImpl)
+    except ImportError:
+        warnings.warn(
+            "paddle_tpu: jax._src.array.ArrayImpl not importable under "
+            f"jax {jax.__version__} — paddle Tensor methods will be "
+            "unavailable on concrete arrays (traced code is unaffected)",
+            RuntimeWarning)
+    tracer = getattr(jax.core, "Tracer", None)
+    if tracer is not None:
+        targets.append(tracer)
+    else:
+        warnings.warn(
+            "paddle_tpu: jax.core.Tracer not found — paddle Tensor "
+            "methods will be unavailable inside jit traces",
+            RuntimeWarning)
+    return targets
+
+
 def _install(table) -> None:
     """Shared install loop: bind onto the concrete array class and the
     tracer base, never touching existing attributes; sealed-type
     failures are LOUD (a silent skip would vanish the whole surface)."""
-    from jax._src.array import ArrayImpl
-    targets = [ArrayImpl, jax.core.Tracer]
+    targets = _resolve_targets()
     failed = []
     for name, fn in table.items():
         for t in targets:
@@ -402,10 +428,49 @@ def _resolve_ref_method(name):
     return None, None
 
 
+# in-place method names (`add_`, `clip_`, ...) delegate to their
+# non-mutating bases — immutable arrays can't be written through — so
+# `x.add_(y)` computes a NEW array and the receiver is unchanged.
+# Ported paddle code calling them for the side effect gets a ONE-TIME
+# runtime signal instead of silence (ADVICE round 5).
+_INPLACE_WARNED: set = set()
+
+
+def _warn_inplace(name: str) -> None:
+    if name in _INPLACE_WARNED:
+        return
+    _INPLACE_WARNED.add(name)
+    import warnings
+    warnings.warn(
+        f"paddle_tpu: Tensor.{name}() cannot mutate an immutable jax "
+        "array — it returns a new tensor and the receiver is unchanged; "
+        "assign the result (docs/MIGRATION.md: in-place ops)",
+        UserWarning, stacklevel=3)
+
+
+def _inplace_delegate(name, base, kind):
+    inner = _delegate(base, kind)
+
+    def method(self, *args, **kwargs):
+        _warn_inplace(name)
+        return inner(self, *args, **kwargs)
+    method.__name__ = name
+    return method
+
+
 def _uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
-    """Reference Tensor.uniform_(min, max): a uniform fill of SELF's
-    shape/dtype — must NOT fall through to the creation op
-    paddle.uniform(shape, ...), whose first argument is a shape."""
+    """Reference Tensor.uniform_(min, max, seed): a uniform fill of
+    SELF's shape/dtype — must NOT fall through to the creation op
+    paddle.uniform(shape, ...), whose first argument is a shape.  A
+    nonzero ``seed`` is folded into a dedicated key (the reference's
+    per-call seeded draw) instead of silently ignored (ADVICE round 5)."""
+    _warn_inplace("uniform_")
+    if seed:
+        key = (jax.random.key(int(seed)) if hasattr(jax.random, "key")
+               else jax.random.PRNGKey(int(seed)))
+        dtype = (self.dtype if jnp.issubdtype(self.dtype, jnp.floating)
+                 else jnp.float32)
+        return jax.random.uniform(key, self.shape, dtype, min, max)
     import paddle_tpu as pt
     return pt.uniform(self.shape, str(self.dtype), min, max)
 
@@ -424,6 +489,12 @@ def install_reference_method_contract() -> None:
         if name in table:
             continue
         resolved, kind = _resolve_ref_method(name)
-        if resolved is not None:
+        if resolved is None:
+            continue
+        if name.endswith("_") and resolved == name[:-1]:
+            # `name_` fell through to its non-mutating base: warn once
+            # at first call that nothing is mutated
+            table[name] = _inplace_delegate(name, resolved, kind)
+        else:
             table[name] = _delegate(resolved, kind)
     _install(table)

@@ -428,6 +428,10 @@ class Model:
             reg.gauge("step.mfu").set(mfu_v)
             sup = self._supervisor
             cur_step = sup.gstep if sup is not None else self._obs_step
+            # where-is-it-now gauges for the live monitor's /statusz
+            # page (ISSUE 5)
+            reg.gauge("step.current").set(cur_step)
+            reg.gauge("step.loss").set(float(loss))
             # HBM watermark sample on its PTPU_MEM_SAMPLE_EVERY cadence
             # (no-op off cadence / on backends without allocator stats)
             obs.get_sampler().sample(cur_step)

@@ -27,7 +27,18 @@ they all report through:
 - :mod:`doctor` — ``python -m paddle_tpu.observability.doctor
   <run_dir>``: ranked ``diagnosis.json`` (retrace storm / HBM creep /
   straggler / data-starved) with evidence, mirrored into the
-  supervisor report.
+  supervisor report;
+- :mod:`monitor` — the live layer (ISSUE 5): per-worker
+  :class:`~paddle_tpu.observability.monitor.StatusServer`
+  (``/metrics`` ``/statusz`` ``/healthz``, started by the supervisor
+  when ``PTPU_MONITOR_PORT`` is set) and the
+  :class:`~paddle_tpu.observability.monitor.LiveAggregator` that
+  tail-reads still-growing worker streams, re-runs the doctor's rules
+  on a sliding window, and raises ``monitor.alert`` records mid-run;
+- :mod:`flight` — the crash flight recorder: a bounded ring of the
+  newest records (``PTPU_FLIGHT_BUFFER``), dumped to
+  ``<run_dir>/flight/worker-<i>.json`` on signals/atexit/fault paths
+  and ingested by the doctor when the JSONL tail was lost.
 
 Emitters across the stack (hapi step breakdown, collective latencies,
 supervisor events) talk to :func:`get_registry` unconditionally; records
@@ -42,18 +53,23 @@ See docs/ARCHITECTURE.md "Telemetry" and "Run doctor".
 """
 from __future__ import annotations
 
-from .aggregate import aggregate_run, read_worker_stream, straggler_stats
+from .aggregate import (StreamTail, aggregate_run, read_worker_stream,
+                        straggler_stats)
 from .compilation import (CompileTracker, arg_signature, diff_signatures,
                           get_tracker, track_jit)
 from .doctor import diagnose, render_report
+from .flight import FlightRecorder, flight_dir, read_flight_bundles
 from .memory import (MemorySampler, get_sampler, is_oom_error,
                      oom_postmortem)
 from .mfu import (PEAK_TFLOPS, flops_per_token, mfu, param_count,
                   peak_flops_per_sec, readback_sync)
+from .monitor import (LiveAggregator, StatusServer,
+                      default_monitor_interval, live_status_path,
+                      maybe_start_server)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
 from .sinks import (MetricsWriter, PrometheusTextfile, StderrSummary,
-                    default_interval, metrics_dir)
+                    default_interval, metrics_dir, render_prometheus)
 from .tracing import (export_chrome_trace, reset_tracing, span,
                       span_tree_totals, trace_events)
 
@@ -65,12 +81,17 @@ __all__ = [
     "reset_tracing",
     # sinks
     "MetricsWriter", "StderrSummary", "PrometheusTextfile", "metrics_dir",
-    "default_interval",
+    "default_interval", "render_prometheus",
     # mfu
     "PEAK_TFLOPS", "peak_flops_per_sec", "param_count", "flops_per_token",
     "mfu", "readback_sync",
     # aggregation
-    "aggregate_run", "read_worker_stream", "straggler_stats",
+    "aggregate_run", "read_worker_stream", "straggler_stats", "StreamTail",
+    # live monitor (ISSUE 5)
+    "StatusServer", "LiveAggregator", "maybe_start_server",
+    "default_monitor_interval", "live_status_path",
+    # flight recorder (ISSUE 5)
+    "FlightRecorder", "flight_dir", "read_flight_bundles",
     # compile/retrace tracking (ISSUE 4)
     "CompileTracker", "arg_signature", "diff_signatures", "get_tracker",
     "track_jit",

@@ -23,8 +23,8 @@ from ..framework.log import vlog
 from ..utils import fsio
 from .sinks import metrics_dir
 
-__all__ = ["read_worker_stream", "aggregate_run", "straggler_stats",
-           "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS"]
+__all__ = ["read_worker_stream", "StreamTail", "aggregate_run",
+           "straggler_stats", "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS"]
 
 _WORKER_RE = re.compile(r"^worker-(\d+)\.jsonl$")
 
@@ -36,25 +36,14 @@ SCHEMA_VERSION = 1
 KNOWN_SCHEMA_VERSIONS = (1,)
 
 
-def read_worker_stream(path: str,
-                       drops: Optional[Dict[str, int]] = None
-                       ) -> List[Dict[str, Any]]:
-    """Parse one worker JSONL file, skipping torn/garbled lines and
-    records from a schema this reader doesn't know.
-
-    ``drops``, when given, accumulates the loss accounting:
-    ``torn_lines`` (unparseable — a mid-append death) and
-    ``unknown_schema`` (valid JSON, foreign ``schema_version``)."""
-    records = []
-    if drops is None:
-        drops = {}
+def _parse_stream_lines(text: str, drops: Dict[str, int]
+                        ) -> List[Dict[str, Any]]:
+    """The shared drop-tolerant JSONL line parser: torn/garbled lines and
+    foreign ``schema_version`` records are skipped with accounting."""
+    records: List[Dict[str, Any]] = []
     drops.setdefault("torn_lines", 0)
     drops.setdefault("unknown_schema", 0)
-    try:
-        raw = fsio.read_bytes(path)
-    except OSError:
-        return records
-    for line in raw.decode("utf-8", errors="replace").splitlines():
+    for line in text.splitlines():
         line = line.strip()
         if not line:
             continue
@@ -72,6 +61,68 @@ def read_worker_stream(path: str,
             continue
         records.append(rec)
     return records
+
+
+def read_worker_stream(path: str,
+                       drops: Optional[Dict[str, int]] = None
+                       ) -> List[Dict[str, Any]]:
+    """Parse one worker JSONL file, skipping torn/garbled lines and
+    records from a schema this reader doesn't know.
+
+    ``drops``, when given, accumulates the loss accounting:
+    ``torn_lines`` (unparseable — a mid-append death) and
+    ``unknown_schema`` (valid JSON, foreign ``schema_version``)."""
+    if drops is None:
+        drops = {}
+    drops.setdefault("torn_lines", 0)
+    drops.setdefault("unknown_schema", 0)
+    try:
+        raw = fsio.read_bytes(path)
+    except OSError:
+        return []
+    return _parse_stream_lines(raw.decode("utf-8", errors="replace"),
+                               drops)
+
+
+class StreamTail:
+    """Incremental reader of one still-growing worker JSONL stream
+    (ISSUE 5: the live monitor's view).
+
+    Unlike :func:`read_worker_stream` this keeps a byte offset and only
+    parses bytes appended since the last :meth:`poll` — and it never
+    consumes past the last newline, so a line the writer is mid-append
+    on is read complete on the NEXT poll instead of counting as torn.
+    A shrunken file (rotation/truncation) resets the offset and rereads.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.drops: Dict[str, int] = {"torn_lines": 0,
+                                      "unknown_schema": 0}
+
+    def poll(self) -> List[Dict[str, Any]]:
+        """Records appended since the previous poll (possibly empty)."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < self.offset:   # truncated/rotated under us
+                    self.offset = 0
+                if size == self.offset:
+                    return []
+                f.seek(self.offset)
+                chunk = f.read(size - self.offset)
+        except OSError:
+            return []
+        # stop at the last complete line; a partial tail is not torn,
+        # just not finished yet
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        self.offset += end + 1
+        return _parse_stream_lines(
+            chunk[:end].decode("utf-8", errors="replace"), self.drops)
 
 
 def _pct(sorted_vals: List[float], p: float) -> Optional[float]:

@@ -46,7 +46,9 @@ from .aggregate import (SCHEMA_VERSION, aggregate_run, read_worker_stream,
                         straggler_stats, _WORKER_RE)
 from .sinks import metrics_dir
 
-__all__ = ["diagnose", "render_report", "main"]
+__all__ = ["diagnose", "render_report", "main", "check_compilation",
+           "check_memory", "check_straggler", "check_data_starved",
+           "check_supervisor"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -70,16 +72,38 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}TiB"
 
 
-def _read_workers(run_dir: str) -> Dict[int, List[Dict[str, Any]]]:
+def _read_workers(run_dir: str,
+                  flight_workers: Optional[List[int]] = None
+                  ) -> Dict[int, List[Dict[str, Any]]]:
+    """Per-worker timelines: the JSONL streams, plus any crash flight
+    bundles (ISSUE 5) folded in — a worker whose stream tail was lost
+    (buffered records died with the process) gets the ring the flight
+    recorder dumped, deduped against what the stream did land."""
     mdir = metrics_dir(run_dir)
     workers: Dict[int, List[Dict[str, Any]]] = {}
-    if not os.path.isdir(mdir):
-        return workers
-    for name in sorted(os.listdir(mdir)):
-        m = _WORKER_RE.match(name)
-        if m:
-            workers[int(m.group(1))] = read_worker_stream(
-                os.path.join(mdir, name))
+    if os.path.isdir(mdir):
+        for name in sorted(os.listdir(mdir)):
+            m = _WORKER_RE.match(name)
+            if m:
+                workers[int(m.group(1))] = read_worker_stream(
+                    os.path.join(mdir, name))
+    from .flight import read_flight_bundles
+    for wid, bundle in read_flight_bundles(run_dir).items():
+        recs = [r for r in bundle.get("records", [])
+                if isinstance(r, dict)]
+        if not recs:
+            continue
+        stream = workers.setdefault(wid, [])
+        seen = {(r.get("ts"), r.get("kind")) for r in stream}
+        fresh = [r for r in recs
+                 if (r.get("ts"), r.get("kind")) not in seen]
+        if fresh:
+            stream.extend(fresh)
+            stream.sort(key=lambda r: r.get("ts") or 0.0)
+            if flight_workers is not None:
+                flight_workers.append(wid)
+            vlog(1, "doctor: worker %d — %d records recovered from the "
+                 "flight bundle", wid, len(fresh))
     return workers
 
 
@@ -98,7 +122,7 @@ def _read_supervisor_events(run_dir: str) -> List[Dict[str, Any]]:
 
 
 # -- checks (each returns a list of findings) ------------------------------
-def _check_compilation(workers) -> List[Dict[str, Any]]:
+def check_compilation(workers) -> List[Dict[str, Any]]:
     findings = []
     storms: Dict[str, Dict[str, Any]] = {}
     retraces: Dict[str, int] = {}
@@ -161,7 +185,7 @@ def _culprit_detail(workers, fn: str, culprit) -> Optional[str]:
     return None
 
 
-def _check_memory(workers) -> List[Dict[str, Any]]:
+def check_memory(workers) -> List[Dict[str, Any]]:
     findings = []
     series: Dict[str, List[Dict[str, Any]]] = {}
     oom: Optional[Dict[str, Any]] = None
@@ -255,7 +279,7 @@ def _collective_skew_evidence(workers, straggler: int) -> List[str]:
     return ev
 
 
-def _check_straggler(workers, summary) -> List[Dict[str, Any]]:
+def check_straggler(workers, summary=None) -> List[Dict[str, Any]]:
     stats = (summary or {}).get("straggler") or straggler_stats(workers)
     if not stats:
         return []
@@ -283,7 +307,7 @@ def _check_straggler(workers, summary) -> List[Dict[str, Any]]:
         spread_ms=stats["spread_ms"])]
 
 
-def _check_data_starved(workers) -> List[Dict[str, Any]]:
+def check_data_starved(workers) -> List[Dict[str, Any]]:
     data_ms, step_ms = [], []
     for records in workers.values():
         for r in records:
@@ -302,7 +326,7 @@ def _check_data_starved(workers) -> List[Dict[str, Any]]:
          f"{len(step_ms)} steps"], fraction=frac)]
 
 
-def _check_supervisor(events) -> List[Dict[str, Any]]:
+def check_supervisor(events) -> List[Dict[str, Any]]:
     if not events:
         return []
     counts: Dict[str, int] = {}
@@ -328,23 +352,30 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     telemetry at all.  ``write=True`` also lands
     ``<run_dir>/diagnosis.json`` (atomic) and mirrors the verdicts into
     the supervisor report."""
-    workers = _read_workers(run_dir)
+    flight_workers: List[int] = []
+    workers = _read_workers(run_dir, flight_workers=flight_workers)
     if not workers:
         return None
-    # the cross-worker summary: reuse a fresh one, else recompute
+    # the cross-worker summary: reuse a fresh one, else recompute.  It is
+    # built from the JSONL streams only — when flight bundles recovered a
+    # lost tail, the in-memory `workers` view is the richer one, so the
+    # checks below get that and the summary only seeds straggler stats.
     summary = aggregate_run(run_dir)
+    if flight_workers:
+        summary = None  # recompute skew over the recovered timelines
     events = _read_supervisor_events(run_dir)
     findings: List[Dict[str, Any]] = []
-    findings += _check_memory(workers)           # oom outranks everything
-    findings += _check_compilation(workers)
-    findings += _check_straggler(workers, summary)
-    findings += _check_data_starved(workers)
-    findings += _check_supervisor(events)
+    findings += check_memory(workers)           # oom outranks everything
+    findings += check_compilation(workers)
+    findings += check_straggler(workers, summary)
+    findings += check_data_starved(workers)
+    findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {
         "schema_version": SCHEMA_VERSION,
         "run_dir": os.path.abspath(run_dir),
         "workers": sorted(workers),
+        "flight_workers": sorted(flight_workers),
         "records": sum(len(r) for r in workers.values()),
         "supervisor_events": len(events),
         "healthy": not findings,
@@ -384,6 +415,10 @@ def render_report(diagnosis: Dict[str, Any]) -> str:
              f"workers: {len(diagnosis['workers'])}, "
              f"records: {diagnosis['records']}, "
              f"supervisor events: {diagnosis['supervisor_events']}"]
+    if diagnosis.get("flight_workers"):
+        lines.append(
+            "flight-recorder evidence recovered for worker(s): "
+            + ", ".join(str(w) for w in diagnosis["flight_workers"]))
     if diagnosis["healthy"]:
         lines.append("no findings — the run looks healthy.")
         return "\n".join(lines)

@@ -1,0 +1,483 @@
+"""Live run monitor (ISSUE 5): the in-flight half of the observability
+story.
+
+Everything before this module is post-hoc — ``aggregate`` merges worker
+streams after the run, the doctor reads a finished run_dir.  This module
+makes the same telemetry operable *while the chips burn*:
+
+- :class:`StatusServer` — a per-worker stdlib HTTP thread exposing
+  ``/metrics`` (Prometheus text, the same rendering the textfile
+  exporter writes), ``/statusz`` (one JSON page: step, step-time
+  p50/p99, live MFU, heartbeat age, watchdog state, HBM watermarks,
+  compile-cache stats) and ``/healthz`` (200/503 from supervisor
+  state).  ``RunSupervisor.begin_run`` starts one when
+  ``PTPU_MONITOR_PORT`` is set (port + worker rank, so a localhost
+  multi-worker simulation gets distinct ports).
+- :class:`LiveAggregator` — the launcher-side (or in-process) watcher:
+  tail-reads the still-growing ``<run_dir>/metrics/worker-*.jsonl``
+  streams with the drop-tolerant reader, keeps a bounded window of
+  recent records per worker, and re-runs the doctor's rule functions
+  over that window every ``PTPU_MONITOR_INTERVAL`` seconds.  Verdicts
+  land in a rolling ``<run_dir>/live_status.json`` and — the moment one
+  first fires — as a ``monitor.alert`` record on the supervisor
+  timeline, so a retrace storm at step 40 is *named* at step ~41, not
+  at teardown.
+
+Env knobs: ``PTPU_MONITOR_PORT`` (status server; 0 = ephemeral),
+``PTPU_MONITOR_INTERVAL`` (aggregation cadence, default 5s),
+``PTPU_FLIGHT_BUFFER`` (see :mod:`flight`).  See docs/ARCHITECTURE.md
+"Live monitoring".
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ..framework.log import vlog
+from ..utils import fsio
+from .aggregate import StreamTail, straggler_stats
+from .sinks import metrics_dir, render_prometheus
+
+__all__ = ["MONITOR_PORT_ENV", "MONITOR_INTERVAL_ENV", "StatusServer",
+           "LiveAggregator", "default_monitor_interval",
+           "maybe_start_server", "live_status_path"]
+
+MONITOR_PORT_ENV = "PTPU_MONITOR_PORT"
+MONITOR_INTERVAL_ENV = "PTPU_MONITOR_INTERVAL"
+
+_WORKER_RE = re.compile(r"^worker-(\d+)\.jsonl$")
+
+
+def default_monitor_interval() -> float:
+    return float(os.environ.get(MONITOR_INTERVAL_ENV, "5"))
+
+
+def live_status_path(run_dir: str) -> str:
+    return os.path.join(run_dir, "live_status.json")
+
+
+# ---------------------------------------------------------------------------
+# per-worker status server
+# ---------------------------------------------------------------------------
+class StatusServer:
+    """One HTTP thread per worker answering the three operator questions
+    — *what are the numbers* (``/metrics``), *what is this worker doing
+    right now* (``/statusz``), *is it alive* (``/healthz``).
+
+    ``port=0`` binds an ephemeral port (read back via ``.port``);
+    ``registry`` defaults to the process-global one at request time so a
+    server started before the first instrument still sees everything.
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 registry=None, supervisor=None,
+                 worker_id: Optional[int] = None):
+        self._registry = registry
+        self.supervisor = supervisor
+        self.worker_id = worker_id
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.requests = 0
+        self.host = host
+        self._requested_port = int(port)
+        self.port: Optional[int] = None
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from .registry import get_registry
+        return get_registry()
+
+    # -- the three pages ---------------------------------------------------
+    def render_metrics(self) -> str:
+        return render_prometheus(self._reg())
+
+    def healthz(self):
+        """(http_status, state_string) from supervisor state: 503 the
+        moment the run is not something a load balancer / babysitter
+        should route to or wait on quietly."""
+        sup = self.supervisor
+        if sup is None:
+            return 200, "ok"          # standalone server: serving = alive
+        if not getattr(sup, "_running", False):
+            return 503, "not-running"
+        if sup.pending_rollback:
+            return 503, f"rollback-pending:{sup.pending_rollback}"
+        state = getattr(sup.monitor, "_last_state", None)
+        from ..supervisor.heartbeat import RunState
+        if state == RunState.LOST_WORKER:
+            return 503, state
+        return 200, state or "healthy"
+
+    def statusz(self) -> Dict[str, Any]:
+        reg = self._reg()
+        snap = reg.snapshot()
+        now = time.time()
+
+        def hist(name):
+            m = snap.get(name)
+            if not m or m.get("type") != "histogram" or not m["count"]:
+                return None
+            return {"count": m["count"], "mean": m["mean"],
+                    "p50": m["p50"], "p99": m["p99"]}
+
+        def gauge(name):
+            m = snap.get(name)
+            return m["value"] if m and m.get("type") == "gauge" else None
+
+        status: Dict[str, Any] = {
+            "worker": self.worker_id,
+            "pid": os.getpid(),
+            "time": now,
+            "step": gauge("step.current"),
+            "loss": gauge("step.loss"),
+            "step_time_ms": hist("step.time_ms"),
+            "data_ms": hist("step.data_ms"),
+            "mfu": gauge("step.mfu"),
+            "tokens_per_sec": gauge("step.tokens_per_sec"),
+        }
+        hs, state = self.healthz()
+        status["health"] = {"ok": hs == 200, "state": state}
+        sup = self.supervisor
+        if sup is not None:
+            if status["step"] is None:
+                status["step"] = sup.gstep
+            hb = sup.heartbeat
+            status["heartbeat"] = {
+                "beats": hb.beats,
+                "last": hb._last_beat or None,
+                "age_secs": (now - hb._last_beat) if hb.beats else None,
+            }
+            wd = sup.watchdog
+            with wd._cond:
+                armed = [e.label for e in wd._entries]
+            status["watchdog"] = {"timeout_secs": wd.timeout,
+                                  "timeouts": wd.timeouts,
+                                  "armed": armed,
+                                  "closed": wd._closed}
+            status["supervisor"] = {
+                "running": sup._running,
+                "last_action": sup.last_action,
+                "pending_rollback": sup.pending_rollback,
+                "rollbacks_used": sup.rollback.used,
+                "bad_batches": sup.guard.total_bad,
+                "consecutive_step_failures":
+                    sup.consecutive_step_failures,
+            }
+            fr = getattr(sup, "flight", None)
+            if fr is not None:
+                status["flight"] = {"records": fr.seen,
+                                    "capacity": fr.capacity,
+                                    "dumps": fr.dumps}
+        try:
+            from .memory import get_sampler
+            status["memory"] = get_sampler().last_table or None
+        except Exception:  # noqa: swallow
+            status["memory"] = None
+        try:
+            from .compilation import get_tracker
+            tr = get_tracker()
+            status["compile"] = {fn: tr.stats(fn)
+                                 for fn in tr.functions()} or None
+        except Exception:  # noqa: swallow
+            status["compile"] = None
+        return status
+
+    # -- plumbing ----------------------------------------------------------
+    def start(self) -> "StatusServer":
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet: vlog, not stderr
+                vlog(2, "monitor: %s", fmt % args)
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                server.requests += 1
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(200,
+                                   server.render_metrics().encode("utf-8"),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/statusz":
+                        self._send(200, json.dumps(
+                            server.statusz(), indent=1,
+                            default=str).encode("utf-8"))
+                    elif path in ("/healthz", "/"):
+                        code, state = server.healthz()
+                        self._send(code, json.dumps(
+                            {"ok": code == 200,
+                             "state": state}).encode("utf-8"))
+                    else:
+                        self._send(404, b'{"error": "not found"}')
+                except Exception as e:  # a broken page must not kill serving
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode("utf-8"))
+                    except OSError:  # noqa: swallow
+                        pass  # client hung up mid-error
+
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="ptpu-status-server",
+                                        daemon=True)
+        self._thread.start()
+        vlog(0, "monitor: status server on %s:%d (/metrics /statusz "
+             "/healthz)", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "StatusServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def maybe_start_server(supervisor=None, worker_id: Optional[int] = None,
+                       registry=None) -> Optional[StatusServer]:
+    """Start a :class:`StatusServer` when ``PTPU_MONITOR_PORT`` is set.
+
+    A nonzero base port is offset by the worker rank (worker 3 of a
+    localhost simulation serves on base+3); 0 requests an ephemeral
+    port per worker.  Returns None when the knob is unset or the bind
+    fails — monitoring must never take the run down with it."""
+    raw = os.environ.get(MONITOR_PORT_ENV)
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        base = int(raw)
+    except ValueError:
+        vlog(0, "monitor: bad %s=%r — not starting a status server",
+             MONITOR_PORT_ENV, raw)
+        return None
+    wid = int(worker_id or 0)
+    port = base + wid if base > 0 else 0
+    try:
+        return StatusServer(port=port, registry=registry,
+                            supervisor=supervisor,
+                            worker_id=wid).start()
+    except OSError as e:
+        vlog(0, "monitor: cannot bind status server on port %d: %s",
+             port, e)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# in-flight cross-worker aggregation
+# ---------------------------------------------------------------------------
+class LiveAggregator:
+    """Re-runs the doctor's rule functions over a sliding window of the
+    still-growing worker streams (ISSUE 5).
+
+    Each :meth:`poll` tail-reads every ``worker-*.jsonl`` under
+    ``<run_dir>/metrics`` (new files are picked up as workers appear),
+    appends the records to a bounded per-worker window, evaluates the
+    retrace-storm / HBM / straggler / data-starvation rules on the
+    window, rewrites ``<run_dir>/live_status.json`` atomically, and —
+    for every verdict *not seen before* — records a ``monitor.alert``
+    event (through ``report``, typically the launcher's
+    ``SupervisorReport``, whose metrics mirror puts it on the shared
+    timeline).  Use ``start()`` for the background-thread form the
+    launcher babysitter runs, or call ``poll()`` from your own loop.
+    """
+
+    def __init__(self, run_dir: str, interval: Optional[float] = None,
+                 window: int = 512, report=None, registry=None,
+                 clock=time.time):
+        self.run_dir = run_dir
+        self.interval = (default_monitor_interval() if interval is None
+                         else float(interval))
+        self.window = int(window)
+        self.report = report
+        self._registry = registry
+        self._clock = clock
+        self._tails: Dict[int, StreamTail] = {}
+        self._windows: Dict[int, deque] = {}
+        self._alerted: set = set()
+        self.alerts: List[Dict[str, Any]] = []
+        self.polls = 0
+        self._last_poll = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from .registry import get_registry
+        return get_registry()
+
+    # -- discovery + tailing -----------------------------------------------
+    def _discover(self) -> None:
+        mdir = metrics_dir(self.run_dir)
+        if not os.path.isdir(mdir):
+            return
+        for name in sorted(os.listdir(mdir)):
+            m = _WORKER_RE.match(name)
+            if m and int(m.group(1)) not in self._tails:
+                wid = int(m.group(1))
+                self._tails[wid] = StreamTail(os.path.join(mdir, name))
+                self._windows[wid] = deque(maxlen=self.window)
+
+    def _ingest(self) -> int:
+        self._discover()
+        fresh = 0
+        for wid, tail in self._tails.items():
+            recs = tail.poll()
+            if recs:
+                self._windows[wid].extend(recs)
+                fresh += len(recs)
+        return fresh
+
+    # -- rules over the window ---------------------------------------------
+    def _evaluate(self) -> List[Dict[str, Any]]:
+        from . import doctor
+        workers = {wid: list(w) for wid, w in self._windows.items() if w}
+        if not workers:
+            return []
+        findings: List[Dict[str, Any]] = []
+        findings += doctor.check_memory(workers)
+        findings += doctor.check_compilation(workers)
+        findings += doctor.check_straggler(workers)
+        findings += doctor.check_data_starved(workers)
+        findings.sort(key=lambda f: (-f["severity"], f["kind"]))
+        return findings
+
+    @staticmethod
+    def _alert_key(finding: Dict[str, Any]) -> tuple:
+        data = finding.get("data") or {}
+        return (finding["kind"], data.get("function"), data.get("device"),
+                data.get("worker"))
+
+    def _raise_alerts(self, findings: List[Dict[str, Any]]) -> None:
+        for f in findings:
+            key = self._alert_key(f)
+            if key in self._alerted:
+                continue
+            self._alerted.add(key)
+            alert = {"kind": f["kind"], "severity": f["severity"],
+                     "title": f["title"], "evidence": f["evidence"],
+                     "first_seen": float(self._clock())}
+            self.alerts.append(alert)
+            vlog(0, "monitor: ALERT [%d] %s: %s", f["severity"],
+                 f["kind"], f["title"])
+            reg = self._reg()
+            reg.counter("monitor.alerts").inc()
+            reg.emit("monitor.alert", verdict=f["kind"],
+                     severity=f["severity"], title=f["title"])
+            if self.report is not None:
+                try:
+                    self.report.record("monitor.alert", verdict=f["kind"],
+                                       severity=f["severity"],
+                                       title=f["title"],
+                                       evidence=f["evidence"])
+                except Exception as e:  # alerting is best-effort
+                    vlog(1, "monitor: alert record failed: %r", e)
+
+    # -- the poll ----------------------------------------------------------
+    def poll(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        """One tail-read + rule pass; throttled to ``interval`` unless
+        ``force``.  Returns the status dict written to
+        ``live_status.json`` (None when throttled)."""
+        now = float(self._clock())
+        if not force and now - self._last_poll < self.interval:
+            return None
+        self._last_poll = now
+        self.polls += 1
+        self._ingest()
+        findings = self._evaluate()
+        self._raise_alerts(findings)
+        status = self._status(now, findings)
+        try:
+            fsio.atomic_write_bytes(
+                live_status_path(self.run_dir),
+                json.dumps(status, indent=1,
+                           default=str).encode("utf-8"))
+        except OSError as e:
+            vlog(1, "monitor: live_status.json write failed: %s", e)
+        return status
+
+    def _status(self, now: float,
+                findings: List[Dict[str, Any]]) -> Dict[str, Any]:
+        last_step: Dict[str, Any] = {}
+        records_seen: Dict[str, int] = {}
+        for wid, window in self._windows.items():
+            steps = [r.get("step") for r in window
+                     if r.get("kind") == "step" and r.get("step") is not None]
+            last_step[str(wid)] = steps[-1] if steps else None
+            records_seen[str(wid)] = len(window)
+        drops: Dict[str, int] = {}
+        for tail in self._tails.values():
+            for k, v in tail.drops.items():
+                drops[k] = drops.get(k, 0) + v
+        workers = {wid: list(w) for wid, w in self._windows.items() if w}
+        return {
+            "ts": now,
+            "run_dir": os.path.abspath(self.run_dir),
+            "polls": self.polls,
+            "workers": sorted(self._tails),
+            "last_step": last_step,
+            "window_records": records_seen,
+            "dropped": drops,
+            "healthy": not findings,
+            "findings": findings,
+            "alerts": self.alerts,
+            "straggler": straggler_stats(workers) if len(workers) > 1
+            else None,
+        }
+
+    # -- background-thread form --------------------------------------------
+    def start(self) -> "LiveAggregator":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="ptpu-live-aggregator", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll(force=True)
+            except Exception as e:  # the babysitter must outlive its rules
+                vlog(0, "monitor: live aggregation pass failed: %r", e)
+
+    def stop(self) -> None:
+        """Stop the thread and run one final forced poll, so the status
+        file reflects the stream tails at teardown."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            self.poll(force=True)
+        except Exception as e:  # noqa: swallow
+            vlog(1, "monitor: final poll failed: %r", e)

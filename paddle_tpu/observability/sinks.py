@@ -29,7 +29,7 @@ from ..framework.log import get_logger
 from ..utils import fsio
 
 __all__ = ["MetricsWriter", "StderrSummary", "PrometheusTextfile",
-           "metrics_dir", "default_interval"]
+           "render_prometheus", "metrics_dir", "default_interval"]
 
 INTERVAL_ENV = "PTPU_METRICS_INTERVAL"
 
@@ -206,6 +206,43 @@ def _prom_labels(labels: Dict[str, str], extra: Optional[Dict[str, Any]]
     return "{" + body + "}"
 
 
+def render_prometheus(registry) -> str:
+    """Every registered instrument in the Prometheus text exposition
+    format — shared by :class:`PrometheusTextfile` (written to disk for
+    node_exporter) and the live monitor's ``/metrics`` endpoint
+    (ISSUE 5), so a scrape and a textfile snapshot are byte-identical."""
+    lines = []
+    if registry is None:
+        return ""
+    typed = set()
+    for name, m in registry.snapshot().items():
+        pname, labels = _prom_parse(name)
+        lb = _prom_labels(labels)
+        if m["type"] == "counter":
+            if pname not in typed:
+                lines.append(f"# TYPE {pname} counter")
+                typed.add(pname)
+            lines.append(f"{pname}{lb} {m['value']:g}")
+        elif m["type"] == "gauge":
+            if m["value"] is None:
+                continue
+            if pname not in typed:
+                lines.append(f"# TYPE {pname} gauge")
+                typed.add(pname)
+            lines.append(f"{pname}{lb} {m['value']:g}")
+        else:  # histogram → summary (count/sum + quantile gauges)
+            if pname not in typed:
+                lines.append(f"# TYPE {pname} summary")
+                typed.add(pname)
+            for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                if m.get(key) is not None:
+                    qlb = _prom_labels(labels, {"quantile": str(q)})
+                    lines.append(f"{pname}{qlb} {m[key]:g}")
+            lines.append(f"{pname}_sum{lb} {m['sum']:g}")
+            lines.append(f"{pname}_count{lb} {m['count']:g}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class PrometheusTextfile:
     """Textfile-collector exporter: rewrites ``path`` atomically with a
     snapshot of every instrument, at most once per ``interval`` seconds
@@ -228,36 +265,7 @@ class PrometheusTextfile:
         self.flush()
 
     def render(self) -> str:
-        lines = []
-        if self._registry is None:
-            return ""
-        typed = set()
-        for name, m in self._registry.snapshot().items():
-            pname, labels = _prom_parse(name)
-            lb = _prom_labels(labels)
-            if m["type"] == "counter":
-                if pname not in typed:
-                    lines.append(f"# TYPE {pname} counter")
-                    typed.add(pname)
-                lines.append(f"{pname}{lb} {m['value']:g}")
-            elif m["type"] == "gauge":
-                if m["value"] is None:
-                    continue
-                if pname not in typed:
-                    lines.append(f"# TYPE {pname} gauge")
-                    typed.add(pname)
-                lines.append(f"{pname}{lb} {m['value']:g}")
-            else:  # histogram → summary (count/sum + quantile gauges)
-                if pname not in typed:
-                    lines.append(f"# TYPE {pname} summary")
-                    typed.add(pname)
-                for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
-                    if m.get(key) is not None:
-                        qlb = _prom_labels(labels, {"quantile": str(q)})
-                        lines.append(f"{pname}{qlb} {m[key]:g}")
-                lines.append(f"{pname}_sum{lb} {m['sum']:g}")
-                lines.append(f"{pname}_count{lb} {m['count']:g}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        return render_prometheus(self._registry)
 
     def flush(self) -> None:
         self._last = time.monotonic()

@@ -29,6 +29,11 @@ failed (budget exhausted: ``RollbackBudgetExceeded`` + report).
 Env knobs: ``PTPU_WATCHDOG_SECS`` (step deadline, default 300),
 ``PTPU_HEARTBEAT_SECS`` (beat interval, default 10),
 ``PTPU_ROLLBACK_BUDGET`` (restores before failing loudly, default 2).
+``begin_run`` also arms the live-monitoring layer (ISSUE 5): a
+per-worker status server when ``PTPU_MONITOR_PORT`` is set and a crash
+flight recorder (``PTPU_FLIGHT_BUFFER``) whose ring is dumped to
+``<run_dir>/flight/`` on any abnormal exit — see
+docs/ARCHITECTURE.md "Live monitoring".
 """
 from __future__ import annotations
 
@@ -114,6 +119,8 @@ class RunSupervisor:
         self._running = False
         self._loss_injectors: List[Callable[[int, float], float]] = []
         self._metrics_sink = None  # run-scoped JSONL writer (ISSUE 3)
+        self.status_server = None  # live monitor HTTP thread (ISSUE 5)
+        self.flight = None         # crash flight recorder (ISSUE 5)
 
     # -- lifecycle ---------------------------------------------------------
     def begin_run(self, initial_state: Any = None) -> "RunSupervisor":
@@ -137,6 +144,22 @@ class RunSupervisor:
             except OSError as e:
                 vlog(0, "supervisor: metrics sink under %s unavailable: "
                      "%s", self.run_dir, e)
+            # crash flight recorder (ISSUE 5): a bounded ring of the
+            # newest records, dumped on signals/atexit/this supervisor's
+            # fault path so a hard death keeps its last N events
+            try:
+                from ..observability.flight import FlightRecorder
+                self.flight = get_registry().add_sink(FlightRecorder(
+                    self.run_dir, worker_id=self.heartbeat.worker_id))
+                self.flight.install()
+            except Exception as e:
+                vlog(0, "supervisor: flight recorder unavailable: %r", e)
+                self.flight = None
+            # per-worker status server (ISSUE 5), when PTPU_MONITOR_PORT
+            # is set (base port + worker rank; 0 = ephemeral)
+            from ..observability.monitor import maybe_start_server
+            self.status_server = maybe_start_server(
+                supervisor=self, worker_id=self.heartbeat.worker_id)
             self.report.record("run_start", run_dir=self.run_dir,
                                worker=self.heartbeat.worker_id,
                                watchdog_secs=self.watchdog.timeout,
@@ -169,6 +192,19 @@ class RunSupervisor:
                            rollbacks=self.rollback.used,
                            timeouts=self.watchdog.timeouts,
                            bad_batches=self.guard.total_bad)
+        if self.flight is not None:
+            # the supervisor's own fault path: an abnormal end dumps the
+            # black box NOW (the signal/atexit hooks cover deaths that
+            # never reach end_run); a clean completion leaves no bundle
+            if status != "completed":
+                self.flight.dump(reason=f"end_run:{status}")
+            self.flight.uninstall()
+            from ..observability import get_registry
+            get_registry().remove_sink(self.flight)
+            self.flight = None
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None
         if self._metrics_sink is not None:
             from ..observability import get_registry
             get_registry().remove_sink(self._metrics_sink)  # flush+close

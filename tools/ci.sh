@@ -34,10 +34,51 @@ if (( SHARD == 0 )); then
     # sharding happens to place its files elsewhere
     python -m pytest -q -m faults tests/test_fault_tolerance.py \
         tests/test_supervisor.py
-    # telemetry tier (ISSUE 3/4): registry/tracing/sinks/aggregation +
-    # compile/memory/doctor diagnosis + the e2e records contracts
+    # telemetry tier (ISSUE 3/4/5): registry/tracing/sinks/aggregation +
+    # compile/memory/doctor diagnosis + live monitor/flight recorder +
+    # the e2e records contracts
     python -m pytest -q -m telemetry tests/test_observability.py \
-        tests/test_doctor.py
+        tests/test_doctor.py tests/test_monitor.py
+    # live-monitor smoke (ISSUE 5): a supervised run with the status
+    # server on an ephemeral port; scrape /healthz + /metrics mid-fit
+    # and assert a known instrument is exposed
+    MONITOR_TMP=$(mktemp -d)
+    PTPU_MONITOR_PORT=0 JAX_PLATFORMS=cpu python - "$MONITOR_TMP" <<'PYEOF'
+import json, sys, urllib.request
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.supervisor import RunSupervisor
+
+scraped = {}
+
+class Scraper(Callback):
+    def on_train_batch_end(self, step, logs=None):
+        sup = self.model._supervisor
+        if step == 2 and sup is not None and not scraped:
+            base = f"http://127.0.0.1:{sup.status_server.port}"
+            scraped["healthz"] = json.loads(
+                urllib.request.urlopen(base + "/healthz", timeout=5).read())
+            scraped["metrics"] = urllib.request.urlopen(
+                base + "/metrics", timeout=5).read().decode()
+
+net = pt.nn.Sequential(pt.nn.Linear(8, 4))
+model = pt.Model(net)
+model.prepare(optimizer=pt.optimizer.Adam(learning_rate=1e-3),
+              loss=pt.nn.CrossEntropyLoss())
+rng = np.random.RandomState(0)
+data = list(zip(rng.randn(32, 8).astype("float32"),
+                rng.randint(0, 4, (32,)).astype("int64")))
+sup = RunSupervisor(sys.argv[1] + "/run", worker_id=0,
+                    sigterm_handler=False)
+model.fit(data, batch_size=8, epochs=1, verbose=0, supervisor=sup,
+          callbacks=[Scraper()])
+assert scraped["healthz"]["ok"] is True, scraped["healthz"]
+assert "paddle_tpu_step_time_ms_count" in scraped["metrics"], \
+    "monitor smoke: step.time_ms instrument missing from /metrics"
+print("monitor smoke: /healthz ok, /metrics exposes step.time_ms")
+PYEOF
+    rm -rf "$MONITOR_TMP"
     # run-doctor smoke (ISSUE 4): diagnose the checked-in degraded
     # fixture run; fail on nonzero exit or an empty diagnosis
     DOCTOR_TMP=$(mktemp -d)
@@ -53,6 +94,6 @@ PYEOF
     rm -rf "$DOCTOR_TMP"
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     echo "api-guard + lints + faults tier + telemetry tier + doctor" \
-         "smoke + bench smoke ok"
+         "smoke + monitor smoke + bench smoke ok"
 fi
 echo "shard ${SHARD} green"
