@@ -1,0 +1,482 @@
+"""ServingEngine (ISSUE 6): the continuous-batching serving loop.
+
+The training side of this codebase drives a model with one jitted step
+over a fixed batch; serving traffic is the opposite shape — requests
+arrive at random times, with ragged prompts, and leave when *they* are
+done.  The engine turns that traffic into fixed-shape device work:
+
+    engine = ServingEngine(model, max_seqs=8, kv_block_size=16)
+    rid = engine.submit([1, 5, 9], max_new_tokens=32)
+    while engine.step():            # one prefill OR one decode batch
+        ...
+    out = engine.collect(rid)       # {"tokens": [...], "ttft_ms": ...}
+
+Pieces (all under ``paddle_tpu/inference/``):
+
+- ``kv_cache.PagedKVCache`` — block-pooled KV with per-sequence tables;
+- ``scheduler.ContinuousBatchingScheduler`` — admission by block
+  budget, newest-first preemption, prefill/decode interleaving;
+- ``paged_attention`` — the ragged decode kernel (lax fallback on CPU);
+- this module — the jitted step functions, sampling, SLO metrics, and
+  the submit/step/collect surface.
+
+Step shapes come from a closed set — decode is always
+``(max_seqs, 1)``; prefill is padded to power-of-two buckets — and each
+shape's jitted function is wrapped in the PR 4 compile tracker under its
+own name (``serve_decode``, ``serve_prefill_b<bucket>``), so a full
+serve run compiles **once per bucket** and any retrace is attributable.
+
+SLO telemetry rides the PR 3 registry: gauges ``serve.queue_depth`` /
+``serve.running`` / ``serve.waiting`` / ``serve.kv_occupancy``,
+histograms ``serve.ttft_ms`` / ``serve.tpot_ms``, counters
+``serve.tokens`` / ``serve.requests`` / ``serve.finished`` /
+``serve.preemptions``.  ``start_status_server()`` exposes them on the
+PR 5 monitor (``/statusz`` serving section; ``/healthz`` goes 503 when
+the admission queue exceeds ``PTPU_SHED_QUEUE_DEPTH`` — load shedding).
+
+Token callbacks (``submit(..., on_token=fn)``) are dispatched from a
+separate drain thread: a slow consumer (``testing/faults.slow_call``)
+delays its own stream, never the batch.
+
+Env knobs: ``PTPU_MAX_SEQS``, ``PTPU_KV_BLOCK_SIZE``,
+``PTPU_SHED_QUEUE_DEPTH``.  Single-host by design: the page scatter and
+the Pallas kernel are opaque to GSPMD (the engine enforces no mesh).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import enforce
+from ..observability.compilation import track_jit
+from .kv_cache import PagedKVCache, default_kv_block_size
+from .scheduler import (ContinuousBatchingScheduler, SequenceState,
+                        StepPlan)
+
+__all__ = ["MAX_SEQS_ENV", "SHED_QUEUE_DEPTH_ENV", "default_max_seqs",
+           "default_shed_queue_depth", "ServingEngine"]
+
+MAX_SEQS_ENV = "PTPU_MAX_SEQS"
+SHED_QUEUE_DEPTH_ENV = "PTPU_SHED_QUEUE_DEPTH"
+
+_PAD_SEQ = "__pad__"          # never a real request id
+
+
+def default_max_seqs() -> int:
+    return int(os.environ.get(MAX_SEQS_ENV, "8"))
+
+
+def default_shed_queue_depth() -> int:
+    return int(os.environ.get(SHED_QUEUE_DEPTH_ENV, "64"))
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching serving engine over a decoder model.
+
+    ``model`` must expose the ``GPTForCausalLM`` serving surface:
+    ``.config`` (num_layers / num_heads / head_dim /
+    max_position_embeddings / dtype), ``.state_dict()``, ``.eval()`` and
+    an ``apply(..., method="serving_step")`` entry point returning
+    ``(logits, new_caches)`` over ``PagedLayerCache`` lists.
+
+    ``temperature`` is engine-level (it is baked into the jitted step;
+    per-request temperatures would multiply the compile set).
+    ``capture_logits=True`` keeps every sampled position's logits row on
+    the host per request — the numerics-equality hook for tests.
+    """
+
+    def __init__(self, model, *, max_seqs: Optional[int] = None,
+                 kv_block_size: Optional[int] = None,
+                 num_kv_blocks: Optional[int] = None,
+                 max_model_len: Optional[int] = None,
+                 temperature: float = 0.0,
+                 capture_logits: bool = False,
+                 shed_queue_depth: Optional[int] = None,
+                 registry=None, seed: int = 0,
+                 clock: Callable[[], float] = time.time):
+        from ..distributed.topology import get_mesh
+        enforce(get_mesh() is None,
+                "ServingEngine is single-host (the paged path is opaque "
+                "to GSPMD) — run it outside fleet meshes")
+        cfg = model.config
+        self.model = model
+        model.eval()
+        self._params = model.state_dict()
+        self.max_seqs = int(max_seqs if max_seqs is not None
+                            else default_max_seqs())
+        self.max_model_len = int(max_model_len if max_model_len is not None
+                                 else cfg.max_position_embeddings)
+        enforce(self.max_model_len <= cfg.max_position_embeddings,
+                f"max_model_len {self.max_model_len} exceeds the model's "
+                f"{cfg.max_position_embeddings} positions")
+        block_size = (default_kv_block_size() if kv_block_size is None
+                      else int(kv_block_size))
+        blocks_per_seq = -(-self.max_model_len // block_size)
+        if num_kv_blocks is None:
+            # roomy default: every batch slot can hold a full-length
+            # sequence (tests pass tight pools to exercise preemption)
+            num_kv_blocks = self.max_seqs * blocks_per_seq
+        dtype = (jnp.dtype(cfg.dtype) if cfg.dtype != "float32"
+                 else jnp.float32)
+        self.cache = PagedKVCache(cfg.num_layers, cfg.num_heads,
+                                  cfg.head_dim, num_kv_blocks,
+                                  block_size=block_size, dtype=dtype)
+        self.sched = ContinuousBatchingScheduler(
+            self.cache, self.max_seqs, self.max_model_len, clock=clock)
+        self.temperature = float(temperature)
+        self.capture_logits = bool(capture_logits)
+        self.shed_queue_depth = int(
+            shed_queue_depth if shed_queue_depth is not None
+            else default_shed_queue_depth())
+        self._registry = registry
+        self.clock = clock
+        self._key = jax.random.PRNGKey(seed)
+        self._ids = itertools.count()
+        self.steps = 0
+        self.status_server = None
+        self._decode_tracked = None
+        self._prefill_tracked: Dict[int, Callable] = {}
+        self._cb_queue: Optional[queue.Queue] = None
+        self._cb_thread: Optional[threading.Thread] = None
+
+    # -- plumbing ----------------------------------------------------------
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..observability.registry import get_registry
+        return get_registry()
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- jitted step functions --------------------------------------------
+    def _build_step_fn(self):
+        model, temperature = self.model, self.temperature
+
+        def fn(params, ids, positions, last_index, caches, key):
+            logits, new_caches = model.apply(
+                params, ids, caches, positions, last_index,
+                method="serving_step")
+            logits = logits.astype(jnp.float32)
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1)
+            else:
+                nxt = jax.random.categorical(key, logits / temperature,
+                                             axis=-1)
+            return nxt.astype(jnp.int32), logits, new_caches
+
+        return jax.jit(fn)
+
+    def _decode_fn(self):
+        if self._decode_tracked is None:
+            self._jit_step = getattr(self, "_jit_step", None) \
+                or self._build_step_fn()
+            self._decode_tracked = track_jit(
+                self._jit_step, name="serve_decode",
+                arg_names=("params", "ids", "positions", "last_index",
+                           "caches", "key"))
+        return self._decode_tracked
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_tracked.get(bucket)
+        if fn is None:
+            # same underlying jitted callable (jax caches per shape);
+            # a per-bucket tracker name makes "one compile per bucket"
+            # directly observable and keeps retrace counts at zero
+            self._jit_step = getattr(self, "_jit_step", None) \
+                or self._build_step_fn()
+            fn = track_jit(self._jit_step, name=f"serve_prefill_b{bucket}",
+                           arg_names=("params", "ids", "positions",
+                                      "last_index", "caches", "key"))
+            self._prefill_tracked[bucket] = fn
+        return fn
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
+               request_id: Optional[str] = None,
+               eos_token_id: Optional[int] = None,
+               on_token: Optional[Callable] = None) -> str:
+        """Queue one request; returns its id.  ``on_token(request_id,
+        token, finished)`` — when given — is invoked from the callback
+        drain thread, decoupled from the step loop."""
+        rid = request_id or f"req-{next(self._ids)}"
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        seq = SequenceState(request_id=rid, prompt=prompt,
+                            max_new_tokens=int(max_new_tokens),
+                            eos_token_id=eos_token_id,
+                            arrival=float(self.clock()),
+                            on_token=on_token,
+                            capture_logits=self.capture_logits)
+        self.sched.submit(seq)
+        reg = self._reg()
+        reg.counter("serve.requests").inc()
+        reg.emit("serve.request", request_id=rid, prompt_len=len(prompt),
+                 max_new_tokens=seq.max_new_tokens)
+        self._update_gauges()
+        return rid
+
+    def should_shed(self) -> bool:
+        """Load-shed signal: the admission queue is past the knob —
+        ``/healthz`` turns 503 so the balancer drains elsewhere."""
+        return self.sched.queue_depth > self.shed_queue_depth
+
+    # -- the step ----------------------------------------------------------
+    def step(self) -> List[Dict[str, Any]]:
+        """Run one scheduler-chosen unit of work (one prefill or one
+        decode batch).  Returns the token events it produced; empty when
+        idle AND no queued work remains."""
+        plan = self.sched.schedule()
+        reg = self._reg()
+        for victim in plan.preempted:
+            reg.counter("serve.preemptions").inc()
+            reg.emit("serve.preempt", request_id=victim.request_id,
+                     generated=len(victim.output))
+        if plan.kind == "prefill":
+            events = self._run_prefill(plan)
+        elif plan.kind == "decode":
+            events = self._run_decode(plan)
+        else:
+            events = []
+        self.steps += 1
+        self._update_gauges()
+        return events
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Drive :meth:`step` until every submitted request finishes;
+        returns the number of steps taken."""
+        taken = 0
+        while self.sched.has_work():
+            self.step()
+            taken += 1
+            enforce(max_steps is None or taken <= max_steps,
+                    f"engine did not drain in {max_steps} steps")
+        return taken
+
+    # -- prefill / decode execution ---------------------------------------
+    def _run_prefill(self, plan: StepPlan) -> List[Dict[str, Any]]:
+        seq = plan.seqs[0]
+        ctx = seq.context()
+        L, bucket = len(ctx), plan.bucket
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :L] = ctx
+        tables = self.cache.table_array([seq.request_id],
+                                        self.sched.max_blocks_per_seq)
+        lens = np.asarray([L], np.int32)
+        slots = self.cache.slot_array([seq.request_id], [0], bucket)
+        caches = self.cache.layer_caches(tables, lens, slots)
+        nxt, logits, new_caches = self._prefill_fn(bucket)(
+            self._params, jnp.asarray(ids), jnp.zeros((1,), jnp.int32),
+            jnp.asarray(L - 1, jnp.int32), caches, self._next_key())
+        self.cache.update_pages(new_caches)
+        self.sched.mark_prefilled(seq)
+        self._reg().counter("serve.prefills").inc()
+        if seq.pending is not None:
+            # recompute prefill after preemption: the next token was
+            # already sampled (and streamed) before eviction — only the
+            # KV was rebuilt; nothing new to emit
+            return []
+        return [self._accept_token(seq, int(np.asarray(nxt)[0]),
+                                   logits[0], first=True)]
+
+    def _run_decode(self, plan: StepPlan) -> List[Dict[str, Any]]:
+        seqs = plan.seqs
+        B = self.max_seqs
+        enforce(len(seqs) <= B, f"{len(seqs)} decode rows > max_seqs {B}")
+        sids = [s.request_id for s in seqs] + \
+            [_PAD_SEQ] * (B - len(seqs))
+        ids = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        lens = np.zeros((B,), np.int32)
+        starts = [-1] * B
+        for i, s in enumerate(seqs):
+            enforce(s.pending is not None,
+                    f"{s.request_id}: decode row without a pending token")
+            ids[i, 0] = s.pending
+            positions[i] = s.computed_len
+            lens[i] = s.computed_len + 1      # includes the written token
+            starts[i] = s.computed_len
+        tables = self.cache.table_array(sids,
+                                        self.sched.max_blocks_per_seq)
+        slots = self.cache.slot_array(sids, starts, 1)
+        caches = self.cache.layer_caches(tables, lens, slots)
+        nxt, logits, new_caches = self._decode_fn()(
+            self._params, jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(0, jnp.int32), caches, self._next_key())
+        self.cache.update_pages(new_caches)
+        nxt_np = np.asarray(nxt)
+        reg = self._reg()
+        reg.counter("serve.decode_steps").inc()
+        reg.histogram("serve.decode_batch").observe(float(len(seqs)))
+        events = []
+        for i, s in enumerate(seqs):
+            self.sched.mark_decoded(s)
+            events.append(self._accept_token(s, int(nxt_np[i]), logits[i],
+                                             first=False))
+        return events
+
+    def _accept_token(self, seq: SequenceState, token: int, logits_row,
+                      first: bool) -> Dict[str, Any]:
+        now = float(self.clock())
+        seq.output.append(token)
+        seq.pending = token
+        reg = self._reg()
+        if first:
+            seq.first_token_time = now
+            reg.histogram("serve.ttft_ms").observe(
+                (now - seq.arrival) * 1e3)
+        elif seq.last_token_time is not None:
+            reg.histogram("serve.tpot_ms").observe(
+                (now - seq.last_token_time) * 1e3)
+        seq.last_token_time = now
+        reg.counter("serve.tokens").inc()
+        if seq.capture_logits:
+            seq.logits.append(np.asarray(logits_row))
+        reason = seq.should_finish()
+        if reason is not None:
+            self.sched.complete(seq, reason)
+            reg.counter("serve.finished").inc()
+            reg.emit("serve.finish", request_id=seq.request_id,
+                     reason=reason, generated=len(seq.output),
+                     preemptions=seq.preemptions)
+        event = {"request_id": seq.request_id, "token": token,
+                 "finished": reason is not None, "reason": reason}
+        if seq.on_token is not None:
+            self._dispatch_callback(seq.on_token, event)
+        return event
+
+    # -- decoupled token callbacks ----------------------------------------
+    def _dispatch_callback(self, cb: Callable,
+                           event: Dict[str, Any]) -> None:
+        if self._cb_queue is None:
+            self._cb_queue = queue.Queue()
+            self._cb_thread = threading.Thread(
+                target=self._cb_worker, name="ptpu-serve-callbacks",
+                daemon=True)
+            self._cb_thread.start()
+        self._cb_queue.put((cb, event))
+
+    def _cb_worker(self) -> None:
+        while True:
+            cb, event = self._cb_queue.get()
+            try:
+                cb(event["request_id"], event["token"], event["finished"])
+            except Exception as e:  # a consumer bug must not kill serving
+                from ..framework.log import vlog
+                vlog(0, "serving: on_token callback failed for %s: %r",
+                     event["request_id"], e)
+            finally:
+                self._cb_queue.task_done()
+
+    def drain_callbacks(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued on_token callback ran (tests); True
+        when drained."""
+        if self._cb_queue is None:
+            return True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._cb_queue.unfinished_tasks:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    # -- results ------------------------------------------------------------
+    def collect(self, request_id: str,
+                max_steps: Optional[int] = None) -> Dict[str, Any]:
+        """Drive the engine until ``request_id`` finishes; return its
+        result record."""
+        while request_id not in self.sched.finished:
+            enforce(self.sched.has_work(),
+                    f"{request_id}: unknown request (never submitted?)")
+            self.step()
+            if max_steps is not None:
+                max_steps -= 1
+                enforce(max_steps >= 0, f"{request_id}: step budget spent")
+        seq = self.sched.finished[request_id]
+        n = len(seq.output)
+        tpot = None
+        if (n > 1 and seq.first_token_time is not None
+                and seq.last_token_time is not None):
+            tpot = (seq.last_token_time - seq.first_token_time) / (n - 1)
+        out = {"request_id": request_id, "tokens": list(seq.output),
+               "finish_reason": seq.finish_reason,
+               "preemptions": seq.preemptions,
+               "ttft_ms": (None if seq.first_token_time is None else
+                           (seq.first_token_time - seq.arrival) * 1e3),
+               "tpot_ms": None if tpot is None else tpot * 1e3}
+        if seq.capture_logits:
+            out["logits"] = list(seq.logits)
+        return out
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Batch convenience: submit every prompt, drain, return the
+        generated token lists in submit order."""
+        rids = [self.submit(p, max_new_tokens=max_new_tokens,
+                            eos_token_id=eos_token_id) for p in prompts]
+        self.run()
+        return [self.collect(r)["tokens"] for r in rids]
+
+    # -- observability ------------------------------------------------------
+    def _update_gauges(self) -> None:
+        reg = self._reg()
+        c = self.sched.counts()
+        reg.gauge("serve.queue_depth").set(float(self.sched.queue_depth))
+        reg.gauge("serve.waiting").set(float(c["waiting"]))
+        reg.gauge("serve.running").set(float(c["running"]))
+        reg.gauge("serve.kv_occupancy").set(self.cache.occupancy())
+        reg.gauge("serve.kv_blocks_used").set(
+            float(self.cache.allocator.num_used))
+        reg.gauge("serve.shed").set(1.0 if self.should_shed() else 0.0)
+
+    def stats(self) -> Dict[str, Any]:
+        """Engine-state snapshot for ``/statusz`` (counts the registry
+        cannot derive: pool geometry, scheduler queues, shed state)."""
+        c = self.sched.counts()
+        return {
+            "steps": self.steps,
+            "queue_depth": self.sched.queue_depth,
+            "waiting": c["waiting"],
+            "running": c["running"],
+            "finished": c["finished"],
+            "preemptions": c["preemptions"],
+            "max_seqs": self.max_seqs,
+            "max_model_len": self.max_model_len,
+            "kv_block_size": self.cache.block_size,
+            "kv_blocks": {"total": self.cache.num_blocks,
+                          "used": self.cache.allocator.num_used,
+                          "occupancy": self.cache.occupancy()},
+            "load_shed": {"active": self.should_shed(),
+                          "queue_threshold": self.shed_queue_depth},
+        }
+
+    def defrag(self) -> bool:
+        """Compact the KV pool (see ``PagedKVCache.defrag``)."""
+        return self.cache.defrag()
+
+    def start_status_server(self, port: int = 0, host: str = "0.0.0.0"):
+        """Expose serving SLOs on the PR 5 monitor; returns the server
+        (``.port`` holds the bound port)."""
+        from ..observability.monitor import StatusServer
+        self.status_server = StatusServer(port=port, host=host,
+                                          registry=self._registry,
+                                          engine=self).start()
+        return self.status_server
+
+    def stop(self) -> None:
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None

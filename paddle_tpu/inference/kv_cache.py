@@ -1,0 +1,324 @@
+"""Block-allocated paged KV cache (ISSUE 6) — the serving-side memory
+manager.
+
+Training caches (``GPTForCausalLM.make_caches``) preallocate one dense
+``(batch, heads, max_len, head_dim)`` buffer per sequence, so a 32-way
+decode batch of mostly-short sequences wastes most of its HBM on padding.
+The paged design (PAPERS.md: *Ragged Paged Attention*, the TPU-native
+paged-KV layout) carves the cache into fixed-size **blocks** shared by
+every sequence: a sequence owns a *block table* (list of block ids), the
+attention kernel follows the table, and memory waste is bounded by one
+partial block per sequence.  That is what lets the continuous-batching
+scheduler (``inference/scheduler.py``) admit by a real byte budget and
+preempt by freeing a table.
+
+Three layers in this module:
+
+- :class:`BlockAllocator` — host-side free-list over ``num_blocks`` block
+  ids: ``alloc / free / defrag`` plus occupancy accounting.  Pure python,
+  no device traffic; the scheduler calls it every step.
+- :class:`PagedLayerCache` — the **device-side** view one decoder layer
+  sees inside a jitted step: flat ``(num_slots, heads, head_dim)`` key
+  and value page arrays plus the batch's ``block_tables`` /
+  ``seq_lens`` / ``slot_mapping`` int32 arrays.  It is a NamedTuple, so
+  it flows through ``jax.jit`` as a pytree with fixed structure — the
+  decode step never retraces on cache state.
+- :class:`PagedKVCache` — the whole-model container: per-layer page
+  arrays + the allocator + per-sequence tables, with the array-building
+  helpers the engine uses to assemble fixed-shape step inputs.
+
+Slots: block ``b`` owns flat rows ``[b*block_size, (b+1)*block_size)``
+of the page arrays; ``slot = block_table[pos // bs] * bs + pos % bs``.
+``SLOT_PAD`` (== ``num_slots``, deliberately out of bounds) marks padded
+positions — page writes use ``mode="drop"`` so padding never lands.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+import jax.tree_util as _tree_util
+
+from ..framework.errors import enforce
+
+__all__ = ["KV_BLOCK_SIZE_ENV", "default_kv_block_size", "BlockAllocator",
+           "PagedLayerCache", "PagedKVCache"]
+
+KV_BLOCK_SIZE_ENV = "PTPU_KV_BLOCK_SIZE"
+
+
+def default_kv_block_size() -> int:
+    return int(os.environ.get(KV_BLOCK_SIZE_ENV, "16"))
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` fixed-size KV blocks.
+
+    All-or-nothing ``alloc``: a request that cannot be fully satisfied
+    takes nothing (the scheduler preempts and retries instead of holding
+    partial grants across steps — partial holds deadlock a full pool).
+    Blocks are handed out lowest-id-first so a freshly started engine
+    stays dense without defrag.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        enforce(num_blocks > 0 and block_size > 0,
+                f"bad pool shape: {num_blocks} blocks x {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._live: set = set()
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.num_used / self.num_blocks
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` cache entries."""
+        return -(-max(0, int(num_tokens)) // self.block_size)
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    # -- alloc / free ------------------------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` blocks, or None (and take nothing) when the pool
+        cannot satisfy the whole request."""
+        if n < 0 or len(self._free) < n:
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._live.update(got)
+        return got
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            enforce(b in self._live, f"double/foreign free of block {b}")
+            self._live.discard(b)
+            self._free.append(b)
+        # keep lowest-id-first hand-out after churn
+        self._free.sort(reverse=True)
+
+    # -- defrag ------------------------------------------------------------
+    def defrag(self, tables: Dict[object, List[int]]
+               ) -> Optional[np.ndarray]:
+        """Compact live blocks to ids ``[0, num_used)``.
+
+        ``tables`` maps owner -> block-id list covering every live block;
+        tables are renumbered **in place**.  Returns ``perm`` with
+        ``perm[new_id] = old_id`` (length ``num_blocks``) for permuting
+        the device page arrays, or None when already compact (no device
+        traffic needed).  With fixed-size blocks there is no external
+        fragmentation — defrag exists to re-densify the pool after heavy
+        churn so long-lived pools keep locality (and so snapshots of the
+        used prefix stay small).
+        """
+        live = sorted(self._live)
+        referenced = sorted({b for t in tables.values() for b in t})
+        enforce(referenced == live,
+                f"defrag: tables cover {referenced} but live={live}")
+        if live == list(range(len(live))):
+            return None
+        mapping = {old: new for new, old in enumerate(live)}
+        for t in tables.values():
+            t[:] = [mapping[b] for b in t]
+        spare = [b for b in range(self.num_blocks) if b not in mapping]
+        perm = np.empty(self.num_blocks, np.int64)
+        for old, new in mapping.items():
+            perm[new] = old
+        perm[len(live):] = spare
+        self._live = set(range(len(live)))
+        self._free = list(range(self.num_blocks - 1, len(live) - 1, -1))
+        return perm
+
+
+class PagedLayerCache:
+    """One decoder layer's jit-visible paged-cache view.
+
+    ``k_pages`` / ``v_pages``: ``(num_slots + 1, heads, head_dim)`` flat
+    page arrays (the +1 row never holds data — the pad-slot sentinel
+    lands out of bounds and is dropped, reads never touch it).
+    ``block_tables``: ``(batch, max_blocks_per_seq)`` int32 block ids
+    (padded rows/entries are 0 — masked out by ``seq_lens``).
+    ``seq_lens``: ``(batch,)`` int32 context length *including* the
+    tokens written by this call (0 = padding row).
+    ``slot_mapping``: ``(batch, chunk)`` int32 flat write slot per new
+    token; ``num_slots`` (out of bounds) marks padding.
+
+    Registered as a pytree with ``block_size`` as static aux data, so a
+    jitted step sees the arrays as traced leaves but the page geometry
+    as a compile-time constant (the attention kernel's grid needs it).
+    """
+
+    __slots__ = ("k_pages", "v_pages", "block_tables", "seq_lens",
+                 "slot_mapping", "block_size")
+
+    def __init__(self, k_pages, v_pages, block_tables, seq_lens,
+                 slot_mapping, block_size: int):
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self.block_tables = block_tables
+        self.seq_lens = seq_lens
+        self.slot_mapping = slot_mapping
+        self.block_size = int(block_size)
+
+    def replace(self, **kw) -> "PagedLayerCache":
+        fields = {s: getattr(self, s) for s in self.__slots__}
+        fields.update(kw)
+        return PagedLayerCache(**fields)
+
+
+def _plc_flatten(c: PagedLayerCache):
+    return ((c.k_pages, c.v_pages, c.block_tables, c.seq_lens,
+             c.slot_mapping), c.block_size)
+
+
+def _plc_unflatten(block_size, children):
+    return PagedLayerCache(*children, block_size=block_size)
+
+
+_tree_util.register_pytree_node(PagedLayerCache, _plc_flatten,
+                                _plc_unflatten)
+
+
+class PagedKVCache:
+    """Whole-model paged KV store: per-layer page arrays + the allocator
+    + per-sequence block tables.
+
+    The engine owns one of these; the scheduler talks to ``allocator``
+    and the per-sequence helpers; the jitted step consumes the
+    fixed-shape arrays from :meth:`layer_caches` and hands back updated
+    page arrays through :meth:`update_pages`.
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int, block_size: Optional[int] = None,
+                 dtype=jnp.float32):
+        block_size = (default_kv_block_size() if block_size is None
+                      else int(block_size))
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = block_size
+        self.num_blocks = int(num_blocks)
+        self.num_slots = self.num_blocks * block_size
+        self.slot_pad = self.num_slots          # OOB sentinel, mode="drop"
+        self.dtype = jnp.dtype(dtype)
+        self.allocator = BlockAllocator(self.num_blocks, block_size)
+        self._tables: Dict[object, List[int]] = {}
+        shape = (self.num_slots + 1, self.num_heads, self.head_dim)
+        self._pages: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
+            (jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
+            for _ in range(self.num_layers)]
+
+    # -- per-sequence table management ------------------------------------
+    def table(self, seq_id) -> List[int]:
+        return self._tables.get(seq_id, [])
+
+    def live_seqs(self) -> List[object]:
+        return list(self._tables)
+
+    def ensure_capacity(self, seq_id, num_tokens: int) -> bool:
+        """Grow ``seq_id``'s table to cover ``num_tokens`` cache slots;
+        False (nothing taken) when the pool cannot supply the growth."""
+        table = self._tables.setdefault(seq_id, [])
+        need = self.allocator.blocks_for_tokens(num_tokens) - len(table)
+        if need <= 0:
+            return True
+        got = self.allocator.alloc(need)
+        if got is None:
+            if not table:
+                del self._tables[seq_id]
+            return False
+        table.extend(got)
+        return True
+
+    def free_seq(self, seq_id) -> None:
+        table = self._tables.pop(seq_id, None)
+        if table:
+            self.allocator.free(table)
+
+    def slot(self, seq_id, pos: int) -> int:
+        """Flat page slot of cache position ``pos`` for ``seq_id``."""
+        table = self._tables[seq_id]
+        block = pos // self.block_size
+        enforce(0 <= block < len(table),
+                f"pos {pos} outside {seq_id}'s {len(table)}-block table")
+        return table[block] * self.block_size + pos % self.block_size
+
+    def occupancy(self) -> float:
+        return self.allocator.occupancy()
+
+    # -- fixed-shape step inputs ------------------------------------------
+    def table_array(self, seq_ids: Sequence[object],
+                    max_blocks: int) -> np.ndarray:
+        """``(len(seq_ids), max_blocks)`` int32 block-table matrix; rows
+        of absent/short tables are 0-padded (masked by seq_lens)."""
+        out = np.zeros((len(seq_ids), max_blocks), np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = self._tables.get(sid, [])
+            enforce(len(t) <= max_blocks,
+                    f"{sid}: {len(t)} blocks > table width {max_blocks}")
+            out[i, :len(t)] = t
+        return out
+
+    def slot_array(self, seq_ids: Sequence[object],
+                   starts: Sequence[int], chunk: int) -> np.ndarray:
+        """``(len(seq_ids), chunk)`` write-slot matrix for tokens at
+        positions ``starts[i] .. starts[i]+chunk-1``; positions past the
+        sequence's table get the OOB pad sentinel."""
+        out = np.full((len(seq_ids), chunk), self.slot_pad, np.int32)
+        for i, (sid, start) in enumerate(zip(seq_ids, starts)):
+            if start < 0:        # padding row
+                continue
+            table = self._tables.get(sid, [])
+            cap = len(table) * self.block_size
+            for j in range(chunk):
+                pos = start + j
+                if pos < cap:
+                    out[i, j] = (table[pos // self.block_size]
+                                 * self.block_size
+                                 + pos % self.block_size)
+        return out
+
+    def layer_caches(self, block_tables: np.ndarray, seq_lens: np.ndarray,
+                     slot_mapping: np.ndarray) -> List[PagedLayerCache]:
+        bt = jnp.asarray(block_tables, jnp.int32)
+        sl = jnp.asarray(seq_lens, jnp.int32)
+        sm = jnp.asarray(slot_mapping, jnp.int32)
+        return [PagedLayerCache(k, v, bt, sl, sm,
+                                block_size=self.block_size)
+                for (k, v) in self._pages]
+
+    def update_pages(self, new_caches: Sequence[PagedLayerCache]) -> None:
+        enforce(len(new_caches) == self.num_layers,
+                f"{len(new_caches)} layer caches for {self.num_layers} "
+                "layers")
+        self._pages = [(c.k_pages, c.v_pages) for c in new_caches]
+
+    # -- defrag ------------------------------------------------------------
+    def defrag(self) -> bool:
+        """Compact the pool (see :meth:`BlockAllocator.defrag`) and
+        permute the device page arrays to match.  Returns True when a
+        permutation was applied."""
+        perm = self.allocator.defrag(self._tables)
+        if perm is None:
+            return False
+        slot_perm = (perm[:, None] * self.block_size
+                     + np.arange(self.block_size)[None, :]).reshape(-1)
+        # the sentinel row stays the sentinel row
+        slot_perm = np.concatenate([slot_perm, [self.num_slots]])
+        idx = jnp.asarray(slot_perm)
+        self._pages = [(jnp.take(k, idx, axis=0), jnp.take(v, idx, axis=0))
+                       for (k, v) in self._pages]
+        return True

@@ -1,0 +1,250 @@
+"""Continuous-batching scheduler (ISSUE 6).
+
+Static batching pads every request to the batch's slowest member; a
+serving engine under ragged traffic wastes most of its step time on
+finished or not-yet-started rows.  Continuous batching re-forms the
+batch **every step**: finished sequences leave immediately, waiting
+sequences are admitted the moment KV blocks free up, and the decode
+batch only ever contains live rows (PAPERS.md: *ClusterFusion++*'s
+per-step decode unit; the vLLM-style admit/evict loop on top).
+
+This module is the pure-host half: no jax, no device traffic — just
+sequence state machines and block accounting against
+``kv_cache.BlockAllocator``.  That makes every policy decision unit
+testable with a fake clock and a tiny pool (``tests/test_serving.py``).
+
+Sequence lifecycle::
+
+    WAITING --admit(prefill)--> RUNNING --eos/max_tokens--> FINISHED
+       ^                          |
+       +------- PREEMPTED <-- OOM on next-token block
+
+- **Admission** is by KV-block budget: a sequence is admitted only when
+  the allocator can hold its whole prefill context *now* (all-or-nothing
+  — partial holds deadlock a full pool).  Preempted sequences re-admit
+  ahead of new arrivals (front of queue) so preemption cannot starve a
+  request forever.
+- **Preemption** frees the victim's entire table (recompute-style: its
+  tokens so far become the new, longer prefill prompt).  Victims are
+  picked newest-admitted-first, so the oldest running sequence always
+  survives and finishes — the loop cannot livelock.
+- **Prefill/decode interleaving**: each ``schedule()`` returns either
+  ONE prefill (padded to a power-of-two bucket) or one decode batch over
+  all running sequences (fixed ``max_seqs`` × 1 shape).  Step shapes
+  therefore come from a small closed set, and the PR 4 compile tracker
+  sees exactly one compilation per bucket — no retrace storms from
+  ragged traffic.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+from ..framework.errors import enforce
+from .kv_cache import PagedKVCache
+
+__all__ = ["WAITING", "RUNNING", "PREEMPTED", "FINISHED", "SequenceState",
+           "StepPlan", "ContinuousBatchingScheduler", "prefill_bucket"]
+
+WAITING = "waiting"
+RUNNING = "running"
+PREEMPTED = "preempted"
+FINISHED = "finished"
+
+_MIN_BUCKET = 8
+
+
+def prefill_bucket(length: int, cap: int) -> int:
+    """Smallest power-of-two >= ``length`` (floor ``_MIN_BUCKET``),
+    capped at ``cap`` — the closed set of prefill step shapes."""
+    enforce(0 < length <= cap, f"prefill length {length} outside (0, {cap}]")
+    b = _MIN_BUCKET
+    while b < length:
+        b *= 2
+    return min(b, cap)
+
+
+@dataclasses.dataclass
+class SequenceState:
+    """One request's scheduling state.  Token bookkeeping:
+
+    - ``prompt``: the submitted prompt ids (never mutated);
+    - ``output``: every token generated so far (streamed to the caller);
+    - ``context()``: the tokens whose KV must be cached before the next
+      decode step — prompt + generated output *except* ``pending`` (the
+      newest sampled token, whose KV is written by the step that feeds
+      it back in);
+    - ``computed_len``: cache entries currently on device for this
+      sequence (0 after preemption — recompute rebuilds them).
+    """
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    arrival: float = 0.0
+    on_token: Optional[Callable] = None
+    capture_logits: bool = False
+
+    state: str = WAITING
+    output: List[int] = dataclasses.field(default_factory=list)
+    pending: Optional[int] = None       # sampled, KV not yet cached
+    computed_len: int = 0
+    logits: List = dataclasses.field(default_factory=list)
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+    finish_reason: Optional[str] = None
+    preemptions: int = 0
+
+    def context(self) -> List[int]:
+        """Tokens needing cached KV before the next decode step.
+        ``pending`` (invariantly ``output[-1]`` when set) is excluded:
+        its KV is written by the decode step that consumes it."""
+        toks = list(self.prompt) + list(self.output)
+        return toks[:-1] if self.pending is not None else toks
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+    def should_finish(self) -> Optional[str]:
+        if (self.eos_token_id is not None and self.output
+                and self.output[-1] == self.eos_token_id):
+            return "eos"
+        if len(self.output) >= self.max_new_tokens:
+            return "max_new_tokens"
+        return None
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """What the engine should run this step."""
+    kind: str                               # "prefill" | "decode" | "idle"
+    seqs: List[SequenceState]
+    bucket: int = 0                         # prefill pad length
+    preempted: List[SequenceState] = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatchingScheduler:
+    """Admission / preemption / interleaving policy over a
+    :class:`PagedKVCache`'s allocator.
+
+    The engine loop is ``plan = schedule(); run(plan); feedback via
+    mark_prefilled / mark_decoded / complete``.  The scheduler owns the
+    queues and the block accounting; it never touches device arrays.
+    """
+
+    def __init__(self, cache: PagedKVCache, max_seqs: int,
+                 max_model_len: int, clock: Callable[[], float] = time.time):
+        enforce(max_seqs >= 1, "max_seqs must be >= 1")
+        self.cache = cache
+        self.max_seqs = int(max_seqs)
+        self.max_model_len = int(max_model_len)
+        self.max_blocks_per_seq = cache.allocator.blocks_for_tokens(
+            self.max_model_len)
+        self.clock = clock
+        self.waiting: Deque[SequenceState] = deque()
+        self.running: List[SequenceState] = []
+        self.finished: Dict[str, SequenceState] = {}
+        self.preemptions = 0
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, seq: SequenceState) -> None:
+        worst = len(seq.prompt) + seq.max_new_tokens
+        enforce(worst <= self.max_model_len,
+                f"{seq.request_id}: prompt {len(seq.prompt)} + "
+                f"max_new {seq.max_new_tokens} exceeds max_model_len "
+                f"{self.max_model_len}")
+        enforce(self.cache.allocator.blocks_for_tokens(worst)
+                <= self.cache.num_blocks,
+                f"{seq.request_id}: needs more KV blocks than the whole "
+                f"pool holds ({self.cache.num_blocks})")
+        enforce(len(seq.prompt) >= 1, f"{seq.request_id}: empty prompt")
+        seq.state = WAITING
+        seq.arrival = seq.arrival or float(self.clock())
+        self.waiting.append(seq)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- the per-step decision ---------------------------------------------
+    def schedule(self) -> StepPlan:
+        """Pick this step's work: one prefill when a waiting sequence
+        fits the block budget and a batch slot, else one decode batch
+        over the running set (preempting on next-token OOM), else idle.
+        Prefill-first keeps TTFT low under load; decode throughput costs
+        at most one interleaved step per admission."""
+        plan_preempted: List[SequenceState] = []
+
+        if self.waiting and len(self.running) < self.max_seqs:
+            seq = self.waiting[0]
+            ctx = len(seq.context())
+            need = self.cache.allocator.blocks_for_tokens(ctx)
+            if (need <= self.max_blocks_per_seq
+                    and self.cache.ensure_capacity(seq.request_id, ctx)):
+                self.waiting.popleft()
+                seq.state = RUNNING
+                self.running.append(seq)
+                bucket = prefill_bucket(ctx, self.max_model_len)
+                return StepPlan("prefill", [seq], bucket=bucket)
+
+        if self.running:
+            survivors: List[SequenceState] = []
+            for seq in list(self.running):
+                if seq.state != RUNNING:
+                    continue      # already preempted as a victim above
+                # a decode step writes the pending token's KV at position
+                # computed_len — grow the table to cover it, preempting
+                # newest-admitted sequences on OOM
+                while not self.cache.ensure_capacity(
+                        seq.request_id, seq.computed_len + 1):
+                    victim = self.running[-1]
+                    self._preempt(victim)
+                    plan_preempted.append(victim)
+                    if victim is seq:
+                        break
+                else:
+                    survivors.append(seq)
+            if survivors:
+                return StepPlan("decode", survivors,
+                                preempted=plan_preempted)
+        return StepPlan("idle", [], preempted=plan_preempted)
+
+    def _preempt(self, seq: SequenceState) -> None:
+        self.running.remove(seq)
+        self.cache.free_seq(seq.request_id)
+        seq.computed_len = 0
+        seq.state = PREEMPTED
+        seq.preemptions += 1
+        self.preemptions += 1
+        # head of the queue: preempted work re-admits before new arrivals
+        self.waiting.appendleft(seq)
+
+    # -- engine feedback ---------------------------------------------------
+    def mark_prefilled(self, seq: SequenceState) -> None:
+        seq.computed_len = len(seq.context())
+
+    def mark_decoded(self, seq: SequenceState) -> None:
+        seq.computed_len += 1
+
+    def complete(self, seq: SequenceState, reason: str) -> None:
+        """Evict a finished sequence: free its blocks immediately so the
+        next schedule() can admit into the reclaimed space."""
+        if seq in self.running:
+            self.running.remove(seq)
+        self.cache.free_seq(seq.request_id)
+        seq.state = FINISHED
+        seq.finish_reason = reason
+        self.finished[seq.request_id] = seq
+
+    # -- introspection ------------------------------------------------------
+    def counts(self) -> Dict[str, int]:
+        return {"waiting": len(self.waiting),
+                "running": len(self.running),
+                "finished": len(self.finished),
+                "preemptions": self.preemptions}

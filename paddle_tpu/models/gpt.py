@@ -156,6 +156,17 @@ class GPTAttention(Layer):
         k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
         v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
         if cache is not None:
+            from ..inference.kv_cache import PagedLayerCache
+            if isinstance(cache, PagedLayerCache):
+                # serving path (ISSUE 6): KV lands in shared fixed-size
+                # blocks addressed by per-sequence tables; ragged decode
+                # batches ride the paged-attention kernel.  Single-host
+                # only (pallas_call / the page scatter are opaque to
+                # GSPMD) — the serving engine enforces that.
+                out, new_cache = self._paged_cache_forward(q, k, v, cache,
+                                                          b, s)
+                return self.resid_dropout(self.out_proj(out)), new_cache
+        if cache is not None:
             # fixed-shape cache (k_buf, v_buf, used): write the new chunk at
             # `used` and attend with an explicit causal+validity mask — no
             # shape growth, so the jitted decode step never retraces
@@ -219,6 +230,49 @@ class GPTAttention(Layer):
         out = shard_constraint(out, "dp", seq_ax, "mp", None)
         out = out.reshape(b, s, c.hidden_size)
         return self.resid_dropout(self.out_proj(out))
+
+    def _paged_cache_forward(self, q, k, v, cache, b, s):
+        """Paged-KV attention (ISSUE 6 serving path).
+
+        Writes this call's k/v into the shared page arrays at
+        ``cache.slot_mapping`` (padding slots are out of bounds and
+        dropped), then attends:
+
+        - ``s == 1`` (batched decode): ragged paged attention over the
+          block tables up to ``seq_lens`` — each row sees its own
+          context length (inference/paged_attention.py);
+        - ``s > 1`` (prefill chunk): the context IS the chunk (recompute
+          prefill after preemption included — the table was freed), so a
+          causal in-chunk mask with ``cols < seq_lens`` masking the pad
+          columns is exact.
+        """
+        from ..inference.paged_attention import paged_attention
+        c = self.config
+        new_k = k.transpose(0, 2, 1, 3).reshape(b * s, c.num_heads,
+                                                c.head_dim)
+        new_v = v.transpose(0, 2, 1, 3).reshape(b * s, c.num_heads,
+                                                c.head_dim)
+        slots = cache.slot_mapping.reshape(-1)
+        k_pages = cache.k_pages.at[slots].set(
+            new_k.astype(cache.k_pages.dtype), mode="drop")
+        v_pages = cache.v_pages.at[slots].set(
+            new_v.astype(cache.v_pages.dtype), mode="drop")
+        if s == 1:
+            o = paged_attention(q[:, :, 0, :], k_pages, v_pages,
+                                cache.block_tables, cache.seq_lens,
+                                block_size=cache.block_size)
+            out = o.astype(q.dtype).reshape(b, 1, c.hidden_size)
+        else:
+            rows = jnp.arange(s)
+            cols = jnp.arange(s)
+            causal = cols[None, :] <= rows[:, None]              # (s, s)
+            valid = cols[None, None, :] < cache.seq_lens[:, None, None]
+            bias = jnp.where(causal[None, :, :] & valid, 0.0, -1e9)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=bias[:, None].astype(q.dtype),
+                is_causal=False, dropout_p=0.0, training=False)
+            out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
+        return out, cache.replace(k_pages=k_pages, v_pages=v_pages)
 
 
 class GPTMLP(Layer):
@@ -319,8 +373,12 @@ class GPTModel(Layer):
         c = self.config
         b, s = input_ids.shape
         # traced-offset form: position_offset may be a traced scalar in the
-        # jitted decode step (jnp.arange(traced, ...) would fail)
-        pos = position_offset + jnp.arange(s)
+        # jitted decode step (jnp.arange(traced, ...) would fail); the
+        # serving engine passes a (b,) vector — every ragged-batch row
+        # decodes at its own position
+        off = jnp.asarray(position_offset)
+        pos = (off[:, None] + jnp.arange(s) if off.ndim
+               else off + jnp.arange(s))
         x = self.wte(input_ids) + self.wpe.value[pos]
         if c.dtype != "float32":
             x = x.astype(c.dtype)
@@ -403,6 +461,25 @@ class GPTForCausalLM(Layer):
             input_ids, position_offset=position_offset, caches=caches)
         table = self.gpt.wte.weight.value.astype(hidden.dtype)
         logits = jnp.einsum("bsh,vh->bsv", hidden[:, -1:], table)
+        return logits, new_caches
+
+    def serving_step(self, input_ids, caches, position_offset, last_index):
+        """One serving-engine step over paged caches (ISSUE 6): runs the
+        stack, gathers the hidden state at ``last_index`` per row (the
+        last *real* token of a padded prefill chunk; 0 for single-token
+        decode), and returns its tied-head logits.
+
+        Unlike :meth:`generate_step` this works for ragged padded
+        chunks — ``hidden[:, -1]`` of a padded prefill is a pad
+        position.  Returns ``(logits (b, vocab), new_caches)``.
+        """
+        hidden, new_caches = self.gpt(
+            input_ids, position_offset=position_offset, caches=caches)
+        b = hidden.shape[0]
+        idx = jnp.broadcast_to(jnp.asarray(last_index, jnp.int32), (b,))
+        h_last = hidden[jnp.arange(b), idx]              # (b, h)
+        table = self.gpt.wte.weight.value.astype(h_last.dtype)
+        logits = jnp.einsum("bh,vh->bv", h_last, table)
         return logits, new_caches
 
     def make_caches(self, batch_size: int, max_length: int):

@@ -60,16 +60,19 @@ def param_count(params: Any) -> int:
 def flops_per_token(n_params: int, num_layers: Optional[int] = None,
                     hidden_size: Optional[int] = None,
                     seq_len: Optional[int] = None,
-                    causal: bool = True) -> float:
+                    causal: bool = True, fwd_only: bool = False) -> float:
     """Train-step (fwd+bwd) FLOPs per token: 6N for the matmuls, plus the
     attention term ``12·L·h·S`` when the transformer shape is known
     (halved for causal masking).  With no shape info this degrades to
-    the plain 6N estimate — still the right order for MLPs/CNNs."""
+    the plain 6N estimate — still the right order for MLPs/CNNs.
+
+    ``fwd_only=True`` divides by 3 (2N + fwd attention) — the serving /
+    decode estimate ``bench_serve`` and the engine MFU line share."""
     total = 6.0 * float(n_params)
     if num_layers and hidden_size and seq_len:
         attn = 12.0 * num_layers * hidden_size * seq_len
         total += attn / 2.0 if causal else attn
-    return total
+    return total / 3.0 if fwd_only else total
 
 
 def mfu(tokens_per_sec: float, flops_token: float,

@@ -23,10 +23,17 @@ makes the same telemetry operable *while the chips burn*:
   timeline, so a retrace storm at step 40 is *named* at step ~41, not
   at teardown.
 
+The same server fronts the serving engine (ISSUE 6): construct with
+``engine=`` (or ``ServingEngine.start_status_server()``) and
+``/statusz`` gains a ``serving`` section — queue depth,
+running/waiting counts, TTFT/TPOT p50/p99, KV-cache occupancy — while
+``/healthz`` answers 503 the moment the admission queue passes
+``PTPU_SHED_QUEUE_DEPTH`` (load shedding).
+
 Env knobs: ``PTPU_MONITOR_PORT`` (status server; 0 = ephemeral),
 ``PTPU_MONITOR_INTERVAL`` (aggregation cadence, default 5s),
 ``PTPU_FLIGHT_BUFFER`` (see :mod:`flight`).  See docs/ARCHITECTURE.md
-"Live monitoring".
+"Live monitoring" and "Serving".
 """
 from __future__ import annotations
 
@@ -77,9 +84,10 @@ class StatusServer:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  registry=None, supervisor=None,
-                 worker_id: Optional[int] = None):
+                 worker_id: Optional[int] = None, engine=None):
         self._registry = registry
         self.supervisor = supervisor
+        self.engine = engine          # serving engine (ISSUE 6 SLOs)
         self.worker_id = worker_id
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -101,7 +109,17 @@ class StatusServer:
     def healthz(self):
         """(http_status, state_string) from supervisor state: 503 the
         moment the run is not something a load balancer / babysitter
-        should route to or wait on quietly."""
+        should route to or wait on quietly.  With a serving engine
+        attached, an admission queue past ``PTPU_SHED_QUEUE_DEPTH``
+        also answers 503 — the load-shedding signal a balancer drains
+        on (requests already queued still complete)."""
+        if self.engine is not None:
+            try:
+                if self.engine.should_shed():
+                    depth = self.engine.sched.queue_depth
+                    return 503, f"load-shed:queue_depth={depth}"
+            except Exception:  # noqa: swallow — health must answer
+                pass
         sup = self.supervisor
         if sup is None:
             return 200, "ok"          # standalone server: serving = alive
@@ -144,6 +162,25 @@ class StatusServer:
         }
         hs, state = self.healthz()
         status["health"] = {"ok": hs == 200, "state": state}
+        # serving SLOs (ISSUE 6): present whenever a serving engine is
+        # attached or serve.* instruments exist in the registry
+        serving: Dict[str, Any] = {}
+        if any(k.startswith("serve.") for k in snap):
+            serving = {
+                "queue_depth": gauge("serve.queue_depth"),
+                "waiting": gauge("serve.waiting"),
+                "running": gauge("serve.running"),
+                "kv_occupancy": gauge("serve.kv_occupancy"),
+                "kv_blocks_used": gauge("serve.kv_blocks_used"),
+                "ttft_ms": hist("serve.ttft_ms"),
+                "tpot_ms": hist("serve.tpot_ms"),
+            }
+        if self.engine is not None:
+            try:
+                serving.update(self.engine.stats())
+            except Exception:  # noqa: swallow — statusz must render
+                pass
+        status["serving"] = serving or None
         sup = self.supervisor
         if sup is not None:
             if status["step"] is None:

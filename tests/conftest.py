@@ -47,6 +47,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "telemetry: observability-layer tests (registry, "
         "tracing, sinks, aggregation; ci.sh runs this tier explicitly)")
+    config.addinivalue_line(
+        "markers", "serving: paged-KV serving engine tests (KV cache, "
+        "scheduler, ragged decode; ci.sh runs this tier explicitly)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,450 @@
+"""Serving subsystem (ISSUE 6): paged-KV cache invariants, scheduler
+policy under a tight block budget, ragged-vs-dense numerics, the compile
+contract, the slow-consumer fault drill, and the legacy facade routing."""
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.inference import (BlockAllocator, Config, PagedKVCache,
+                                  ServingEngine, create_predictor)
+from paddle_tpu.inference.paged_attention import (paged_attention_pallas,
+                                                  paged_attention_reference)
+from paddle_tpu.inference.scheduler import (ContinuousBatchingScheduler,
+                                            SequenceState, prefill_bucket)
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability.compilation import CompileTracker
+from paddle_tpu.observability.registry import MetricsRegistry
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.serving
+
+
+def tiny_model(max_pos=32):
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=2,
+                    num_heads=2, ffn_hidden_size=64,
+                    max_position_embeddings=max_pos, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def dense_continuation(model, prompt, max_new, eos=None):
+    out = model.generate(jnp.asarray([prompt], jnp.int32),
+                         max_new_tokens=max_new, temperature=0.0,
+                         eos_token_id=eos)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def assert_no_block_aliasing(cache: PagedKVCache):
+    seen = {}
+    for sid in cache.live_seqs():
+        for b in cache.table(sid):
+            assert b not in seen, \
+                f"block {b} aliased by {sid} and {seen[b]}"
+            seen[b] = sid
+
+
+# ---------------------------------------------------------------------------
+# KV block allocator
+# ---------------------------------------------------------------------------
+class TestBlockAllocator:
+    def test_alloc_free_reuse(self):
+        a = BlockAllocator(4, block_size=8)
+        g1 = a.alloc(3)
+        assert sorted(g1) == [0, 1, 2] and a.num_free == 1
+        assert a.alloc(2) is None          # all-or-nothing
+        assert a.num_free == 1             # the failed alloc took nothing
+        a.free(g1[:2])
+        g2 = a.alloc(3)
+        assert g2 is not None and a.num_free == 0
+        assert set(g2).isdisjoint({g1[2]})
+        assert a.occupancy() == 1.0
+
+    def test_double_free_rejected(self):
+        a = BlockAllocator(2, block_size=4)
+        g = a.alloc(1)
+        a.free(g)
+        with pytest.raises(Exception):
+            a.free(g)
+
+    def test_blocks_for_tokens(self):
+        a = BlockAllocator(8, block_size=4)
+        assert [a.blocks_for_tokens(n) for n in (0, 1, 4, 5, 8)] \
+            == [0, 1, 1, 2, 2]
+
+    def test_defrag_compacts_and_renumbers(self):
+        a = BlockAllocator(8, block_size=4)
+        t1 = a.alloc(2)
+        t2 = a.alloc(2)
+        t3 = a.alloc(2)
+        a.free(t1)
+        a.free(t3)
+        tables = {"s2": list(t2)}
+        perm = a.defrag(tables)
+        assert perm is not None
+        # live blocks now occupy the lowest ids and tables were rewritten
+        assert sorted(tables["s2"]) == [0, 1]
+        assert a.num_used == 2
+        # perm maps new -> old for the page permutation
+        assert [perm[n] for n in tables["s2"]] == t2 or \
+            sorted(perm[:2].tolist()) == sorted(t2)
+        # fresh allocs continue from the compacted prefix
+        assert sorted(a.alloc(6)) == [2, 3, 4, 5, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache
+# ---------------------------------------------------------------------------
+class TestPagedKVCache:
+    def make(self, blocks=6, bs=4):
+        return PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                            num_blocks=blocks, block_size=bs)
+
+    def test_capacity_growth_and_slots(self):
+        c = self.make()
+        assert c.ensure_capacity("a", 5)       # 2 blocks
+        assert len(c.table("a")) == 2
+        assert c.ensure_capacity("a", 8)       # still 2
+        assert len(c.table("a")) == 2
+        assert c.ensure_capacity("a", 9)       # grows to 3
+        t = c.table("a")
+        assert c.slot("a", 0) == t[0] * 4
+        assert c.slot("a", 6) == t[1] * 4 + 2
+        c.free_seq("a")
+        assert c.allocator.num_used == 0
+
+    def test_no_aliasing_across_live_seqs(self):
+        c = self.make(blocks=8)
+        for sid, n in (("a", 9), ("b", 5), ("c", 12)):
+            assert c.ensure_capacity(sid, n)
+        assert_no_block_aliasing(c)
+        c.free_seq("b")
+        assert c.ensure_capacity("d", 8)
+        assert_no_block_aliasing(c)
+
+    def test_oom_takes_nothing(self):
+        c = self.make(blocks=2)
+        assert c.ensure_capacity("a", 8)       # both blocks
+        assert not c.ensure_capacity("b", 5)   # needs 2, has 0
+        assert c.table("b") == []
+        assert c.allocator.num_used == 2
+
+    def test_defrag_preserves_page_data(self):
+        c = self.make(blocks=6, bs=4)
+        c.ensure_capacity("a", 8)
+        c.ensure_capacity("b", 8)
+        # write a recognizable value into b's first slot
+        slot_b = c.slot("b", 0)
+        k, v = c._pages[0]
+        c._pages[0] = (k.at[slot_b].set(7.5), v)
+        c.free_seq("a")
+        assert c.defrag() is True
+        # b's tables were renumbered to the compact prefix; its data moved
+        assert sorted(c.table("b")) == [0, 1]
+        new_slot = c.slot("b", 0)
+        assert float(c._pages[0][0][new_slot, 0, 0]) == 7.5
+        # idempotent when already compact
+        assert c.defrag() is False
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged attention numerics
+# ---------------------------------------------------------------------------
+class TestPagedAttention:
+    def test_pallas_matches_reference_incl_empty_rows(self):
+        rng = np.random.RandomState(0)
+        B, H, D, bs, nb, T = 4, 2, 8, 4, 12, 5
+        q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+        kp = jnp.asarray(rng.randn(nb * bs + 1, H, D).astype(np.float32))
+        vp = jnp.asarray(rng.randn(nb * bs + 1, H, D).astype(np.float32))
+        tbl = jnp.asarray(rng.randint(0, nb, (B, T)), jnp.int32)
+        lens = jnp.asarray([7, 0, 20, 1], jnp.int32)
+        ref = paged_attention_reference(q, kp, vp, tbl, lens, bs)
+        pal = paged_attention_pallas(q, kp, vp, tbl, lens, bs,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(pal),
+                                   atol=1e-5)
+        assert float(jnp.max(jnp.abs(ref[1]))) == 0.0   # len-0 row
+
+    def test_reference_matches_dense_gather(self):
+        rng = np.random.RandomState(1)
+        H, D, bs, nb = 3, 16, 4, 8
+        q = jnp.asarray(rng.randn(1, H, D).astype(np.float32))
+        kp = jnp.asarray(rng.randn(nb * bs + 1, H, D).astype(np.float32))
+        vp = jnp.asarray(rng.randn(nb * bs + 1, H, D).astype(np.float32))
+        tbl = jnp.asarray([[5, 2, 7, 0]], jnp.int32)
+        ln = 11
+        out = paged_attention_reference(q, kp, vp, tbl,
+                                        jnp.asarray([ln], jnp.int32), bs)
+        slots = (np.asarray(tbl[0])[:, None] * bs
+                 + np.arange(bs)).reshape(-1)[:ln]
+        k = np.asarray(kp)[slots]
+        v = np.asarray(vp)[slots]
+        s = np.einsum("hd,lhd->hl", np.asarray(q[0]), k) * D ** -0.5
+        p = np.exp(s - s.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        o = np.einsum("hl,lhd->hd", p, v)
+        np.testing.assert_allclose(np.asarray(out[0]), o, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler policy (pure host logic against a real cache)
+# ---------------------------------------------------------------------------
+class TestScheduler:
+    def make(self, blocks=4, bs=4, max_seqs=3, max_len=16):
+        cache = PagedKVCache(num_layers=1, num_heads=1, head_dim=4,
+                             num_blocks=blocks, block_size=bs)
+        return cache, ContinuousBatchingScheduler(cache, max_seqs, max_len)
+
+    @staticmethod
+    def seq(rid, prompt_len=4, max_new=4):
+        return SequenceState(request_id=rid,
+                             prompt=list(range(1, prompt_len + 1)),
+                             max_new_tokens=max_new)
+
+    def test_admission_is_block_budgeted(self):
+        cache, sch = self.make(blocks=2, bs=4, max_len=8)
+        a = self.seq("a", prompt_len=5, max_new=3)   # needs both blocks
+        b = self.seq("b", prompt_len=4, max_new=4)
+        sch.submit(a)
+        sch.submit(b)
+        plan = sch.schedule()
+        assert plan.kind == "prefill" and plan.seqs[0].request_id == "a"
+        sch.mark_prefilled(a)
+        a.output.append(9)
+        a.pending = 9
+        # "b" cannot be admitted while "a" holds the pool
+        plan2 = sch.schedule()
+        assert plan2.kind == "decode"
+        assert [s.request_id for s in plan2.seqs] == ["a"]
+        # finishing "a" frees the pool; "b" admits next step
+        sch.complete(a, "eos")
+        plan3 = sch.schedule()
+        assert plan3.kind == "prefill" and plan3.seqs[0].request_id == "b"
+
+    def test_preempt_newest_on_oom_and_requeue_front(self):
+        cache, sch = self.make(blocks=3, bs=2, max_seqs=3, max_len=6)
+        a, b = self.seq("a", 3, 3), self.seq("b", 2, 4)
+        for s in (a, b):
+            sch.submit(s)
+        p = sch.schedule()                 # prefill a: 2 blocks, 1 free
+        assert p.kind == "prefill" and p.seqs[0].request_id == "a"
+        sch.mark_prefilled(a)
+        a.output.append(5)
+        a.pending = 5
+        p = sch.schedule()                 # prefill b: 1 block, 0 free
+        assert p.kind == "prefill" and p.seqs[0].request_id == "b"
+        sch.mark_prefilled(b)
+        b.output.append(6)
+        b.pending = 6
+        # decode: a grows into its 2nd block's spare slot; b needs a 2nd
+        # block for position 2 and the pool is dry -> the NEWEST running
+        # sequence (b itself) is preempted, a (the oldest) survives
+        p = sch.schedule()
+        assert p.kind == "decode"
+        assert [s.request_id for s in p.seqs] == ["a"]
+        assert [s.request_id for s in p.preempted] == ["b"]
+        assert b.state == "preempted" and b.computed_len == 0
+        # preempted work requeues at the FRONT, ahead of new arrivals
+        c = self.seq("c", 2, 2)
+        sch.submit(c)
+        assert sch.waiting[0].request_id == "b"
+        # b's blocks all returned; its recompute context keeps the
+        # already-streamed token out (pending's KV is written on replay)
+        assert b.context() == b.prompt
+        assert_no_block_aliasing(cache)
+        # a finishing frees space; b re-admits before c
+        sch.complete(a, "eos")
+        p = sch.schedule()
+        assert p.kind == "prefill" and p.seqs[0].request_id == "b"
+
+    def test_prefill_bucket_shapes(self):
+        assert prefill_bucket(1, 64) == 8
+        assert prefill_bucket(8, 64) == 8
+        assert prefill_bucket(9, 64) == 16
+        assert prefill_bucket(33, 64) == 64
+        assert prefill_bucket(60, 64) == 64
+
+    def test_submit_rejects_impossible_requests(self):
+        cache, sch = self.make(blocks=2, bs=2, max_len=16)
+        with pytest.raises(Exception):
+            sch.submit(self.seq("x", prompt_len=10, max_new=10))  # > max_len
+        with pytest.raises(Exception):
+            # fits max_len but can never fit the whole pool
+            sch.submit(self.seq("y", prompt_len=6, max_new=2))
+
+
+# ---------------------------------------------------------------------------
+# Engine end-to-end
+# ---------------------------------------------------------------------------
+class TestServingEngine:
+    def test_ragged_decode_matches_dense_logits(self):
+        model = tiny_model()
+        prompts = [[1, 2, 3, 4, 5], [7, 8], [9, 10, 11, 12, 13, 14, 15]]
+        max_new = 5
+        dense = [dense_continuation(model, p, max_new) for p in prompts]
+        eng = ServingEngine(model, max_seqs=4, kv_block_size=4,
+                            capture_logits=True, registry=MetricsRegistry())
+        rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.run(max_steps=200)
+        for rid, p, want in zip(rids, prompts, dense):
+            r = eng.collect(rid)
+            assert r["tokens"] == want, (p, r["tokens"], want)
+            # logits through the paged path == dense no-cache forward
+            full = p + r["tokens"]
+            ref = np.asarray(model(jnp.asarray([full], jnp.int32)))[0]
+            for i, row in enumerate(r["logits"]):
+                np.testing.assert_allclose(
+                    row, ref[len(p) - 1 + i], atol=1e-4)
+
+    def test_tight_pool_preempts_but_stays_exact(self):
+        model = tiny_model()
+        prompts = [[1, 2, 3, 4], [5, 6, 7], [8, 9], [10, 11, 12, 13, 14]]
+        max_new = 6
+        dense = [dense_continuation(model, p, max_new) for p in prompts]
+        reg = MetricsRegistry()
+        # pool far too small for 4 concurrent sequences
+        eng = ServingEngine(model, max_seqs=4, kv_block_size=4,
+                            num_kv_blocks=5, registry=reg)
+        rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        while eng.has_work():
+            eng.step()
+            assert_no_block_aliasing(eng.cache)
+        assert eng.sched.preemptions > 0
+        for rid, want in zip(rids, dense):
+            assert eng.collect(rid)["tokens"] == want
+        # every block returned to the pool
+        assert eng.cache.allocator.num_used == 0
+        assert reg.counter("serve.preemptions").value > 0
+
+    def test_one_compile_per_bucket_no_storms(self):
+        model = tiny_model()
+        tracker = CompileTracker(registry=MetricsRegistry())
+        import paddle_tpu.observability.compilation as comp
+        eng = ServingEngine(model, max_seqs=3, kv_block_size=4,
+                            registry=MetricsRegistry())
+        # route this engine's track_jit through a private tracker
+        orig = comp.get_tracker
+        comp.get_tracker = lambda: tracker
+        try:
+            prompts = [[1, 2], [3, 4, 5, 6, 7, 8, 9], [1, 2, 3],
+                       [4, 5, 6, 7, 8, 9, 10, 11, 12]]
+            eng.generate(prompts, max_new_tokens=4)
+        finally:
+            comp.get_tracker = orig
+        names = [f for f in tracker.functions() if f.startswith("serve")]
+        assert "serve_decode" in names
+        assert "serve_prefill_b8" in names
+        assert "serve_prefill_b16" in names
+        for fn in names:
+            st = tracker.stats(fn)
+            assert st["traces"] == 1, (fn, st)      # one compile per shape
+            assert st["retraces"] == 0 and st["storms"] == 0, (fn, st)
+
+    def test_eos_stops_early_and_frees(self):
+        model = tiny_model()
+        eng = ServingEngine(model, max_seqs=2, kv_block_size=4,
+                            registry=MetricsRegistry())
+        # pick the model's own first greedy token as "eos" so it fires
+        probe = dense_continuation(model, [1, 2, 3], 1)[0]
+        rid = eng.submit([1, 2, 3], max_new_tokens=8, eos_token_id=probe)
+        out = eng.collect(rid, max_steps=50)
+        assert out["finish_reason"] == "eos"
+        assert out["tokens"][-1] == probe and len(out["tokens"]) < 8
+        assert eng.cache.allocator.num_used == 0
+
+    @pytest.mark.faults
+    def test_slow_consumer_does_not_stall_the_batch(self):
+        model = tiny_model()
+        eng = ServingEngine(model, max_seqs=4, kv_block_size=4,
+                            registry=MetricsRegistry())
+        # warm the compiles so the timed window measures scheduling only
+        eng.generate([[1, 2]], max_new_tokens=2)
+        delay, max_new = 0.15, 6
+        got = {"slow": [], "fast": []}
+        slow_cb = faults.slow_call(
+            lambda rid, tok, fin: got["slow"].append(tok), delay)
+        fast_cb = lambda rid, tok, fin: got["fast"].append(tok)  # noqa: E731
+        t0 = time.monotonic()
+        eng.submit([1, 2, 3], max_new_tokens=max_new, on_token=slow_cb)
+        r_fast = eng.submit([4, 5, 6], max_new_tokens=max_new,
+                            on_token=fast_cb)
+        eng.run(max_steps=100)
+        elapsed = time.monotonic() - t0
+        # the batch finished without serializing behind the slow consumer:
+        # its callbacks alone would take max_new * delay seconds
+        assert elapsed < max_new * delay * 0.8, elapsed
+        assert len(eng.collect(r_fast)["tokens"]) == max_new
+        assert eng.drain_callbacks(timeout=max_new * delay * 3 + 5)
+        assert len(got["slow"]) == max_new
+        assert len(got["fast"]) == max_new
+
+    def test_status_pages_and_load_shed(self):
+        model = tiny_model()
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, max_seqs=2, kv_block_size=4,
+                            shed_queue_depth=1, registry=reg)
+        from paddle_tpu.observability.monitor import StatusServer
+        srv = StatusServer(registry=reg, engine=eng)
+        for _ in range(2):
+            eng.submit([1, 2, 3], max_new_tokens=3)
+        for _ in range(3):
+            eng.step()
+        sz = srv.statusz()
+        serving = sz["serving"]
+        assert serving["ttft_ms"]["count"] >= 1
+        assert serving["ttft_ms"]["p50"] > 0
+        assert serving["kv_occupancy"] > 0
+        code, _state = srv.healthz()
+        assert code == 200
+        # flood past the shed threshold -> 503
+        for _ in range(4):
+            eng.submit([1, 2], max_new_tokens=2)
+        code, state = srv.healthz()
+        assert code == 503 and state.startswith("load-shed")
+        eng.run(max_steps=300)
+        code, _ = srv.healthz()
+        assert code == 200
+
+
+# ---------------------------------------------------------------------------
+# Legacy facade routing
+# ---------------------------------------------------------------------------
+class TestLegacyFacadeRouting:
+    def test_enable_continuous_batching_routes_to_engine(self):
+        model = tiny_model()
+        cfg = Config()
+        cfg.enable_continuous_batching(max_seqs=4, kv_block_size=4)
+        cfg.set_decoder_model(model, max_new_tokens=4, eos_token_id=None,
+                              pad_token_id=0)
+        pred = create_predictor(cfg)
+        assert type(pred).__name__ == "EnginePredictor"
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8]]
+        width = max(len(p) for p in prompts)
+        ids = np.zeros((2, width), np.int64)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
+        # reference call shapes: named input handle -> run -> output handle
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(ids)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        assert out.shape[0] == 2
+        for i, p in enumerate(prompts):
+            want = p + dense_continuation(model, p, 4)
+            assert out[i, :len(want)].tolist() == want
+
+    def test_plain_config_still_builds_plain_predictor(self, tmp_path):
+        cfg = Config(str(tmp_path))
+        assert not cfg.continuous_batching_enabled()
+        with pytest.raises(Exception):
+            # CB enabled without a decoder model is an explicit error
+            cfg2 = Config()
+            cfg2.enable_continuous_batching()
+            create_predictor(cfg2)

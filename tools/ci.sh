@@ -92,8 +92,47 @@ kinds = {f["kind"] for f in diag["findings"]}
 assert {"retrace_storm", "straggler"} <= kinds, f"doctor smoke: {kinds}"
 PYEOF
     rm -rf "$DOCTOR_TMP"
+    # serving tier (ISSUE 6): paged-KV cache invariants, scheduler policy,
+    # ragged-vs-dense numerics, compile contract, facade routing
+    python -m pytest -q -m serving tests/test_serving.py
+    # serve smoke: engine + status server on an ephemeral port, 8
+    # concurrent synthetic streams; /statusz must report nonzero TTFT
+    # percentiles and KV occupancy mid-flight
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, urllib.request
+import paddle_tpu as pt
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+pt.seed(0)
+cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+                ffn_hidden_size=64, max_position_embeddings=32,
+                hidden_dropout=0.0, attention_dropout=0.0)
+model = GPTForCausalLM(cfg)
+engine = ServingEngine(model, max_seqs=8, kv_block_size=4)
+srv = engine.start_status_server(port=0, host="127.0.0.1")
+for i in range(8):
+    engine.submit([1 + i % 4] * (2 + i % 5), max_new_tokens=6)
+# step until every stream produced its first token, then scrape mid-run
+while any(s.first_token_time is None
+          for s in engine.sched.running + list(engine.sched.waiting)):
+    engine.step()
+base = f"http://127.0.0.1:{srv.port}"
+sz = json.loads(urllib.request.urlopen(base + "/statusz", timeout=5).read())
+serving = sz["serving"]
+assert serving["ttft_ms"]["count"] >= 8, serving["ttft_ms"]
+assert serving["ttft_ms"]["p50"] > 0 and serving["ttft_ms"]["p99"] > 0
+assert serving["kv_occupancy"] > 0, serving
+hz = json.loads(urllib.request.urlopen(base + "/healthz", timeout=5).read())
+assert hz["ok"] is True, hz
+engine.run(max_steps=500)
+engine.stop()
+print("serve smoke: 8 streams, /statusz TTFT p50/p99 + KV occupancy ok")
+PYEOF
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
+    BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
     echo "api-guard + lints + faults tier + telemetry tier + doctor" \
-         "smoke + monitor smoke + bench smoke ok"
+         "smoke + monitor smoke + serving tier + serve smoke + bench" \
+         "smoke ok"
 fi
 echo "shard ${SHARD} green"
