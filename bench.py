@@ -276,6 +276,174 @@ def _bench_flash_ab(B=8, S=2048, steps=8, warmup=3):
     return rows
 
 
+def _xla_memory(jitted, *args):
+    """Compiled-program memory analysis (temp/argument/output bytes) for a
+    (possibly track_jit-wrapped) jitted step — the platform-independent
+    peak-HBM proxy behind the fused-op memory claims.  None when the
+    backend doesn't expose it."""
+    try:
+        fn = getattr(jitted, "__wrapped_fn__", jitted)
+        mem = fn.lower(*args).compile().memory_analysis()
+        return {"temp_bytes": int(mem.temp_size_in_bytes),
+                "argument_bytes": int(mem.argument_size_in_bytes),
+                "output_bytes": int(mem.output_size_in_bytes)}
+    except Exception as e:
+        print(f"[xla-memory] unavailable: {repr(e)[:80]}", file=sys.stderr,
+              flush=True)
+        return None
+
+
+def _ab_train_legs(legs, B, S, steps, warmup):
+    """Shared A/B harness (ISSUE 7): time each (tag, cfg) leg identically
+    via _build/_timed_steps, with a compile-tracker reset around each leg
+    so the artifact records the compile contract (exactly one compile per
+    step shape, zero retraces/storms) alongside the step time."""
+    from paddle_tpu.observability.compilation import get_tracker, \
+        reset_tracker
+    import gc
+    rows = {}
+    for tag, cfg in legs:
+        reset_tracker()
+        jitted, model, params, opt_state, ids, labels = _build(cfg, B, S)
+        mem = _xla_memory(jitted, params, opt_state, ids, labels,
+                          jax.random.key(0))
+        dt, loss, _ = _timed_steps(jitted, params, opt_state, ids, labels,
+                                   steps, warmup)
+        stats = get_tracker().stats("bench.gpt_step")
+        rows[tag] = {"step_ms": dt * 1e3, "tok_s": B * S / dt,
+                     "loss": loss, "memory": mem,
+                     "compiles": stats["traces"],
+                     "retraces": stats["retraces"],
+                     "storms": stats["storms"]}
+        print(f"[{tag}] step={dt * 1e3:.1f}ms tok/s={B * S / dt:.0f} "
+              f"compiles={stats['traces']} retraces={stats['retraces']} "
+              f"temp={mem['temp_bytes'] / 1e6:.1f}MB" if mem else
+              f"[{tag}] step={dt * 1e3:.1f}ms tok/s={B * S / dt:.0f} "
+              f"compiles={stats['traces']} retraces={stats['retraces']}",
+              file=sys.stderr, flush=True)
+        del jitted, model, params, opt_state, ids, labels
+        gc.collect()
+    reset_tracker()
+    return rows
+
+
+def _bench_fused_block_ab(B=8, S=2048, steps=8, warmup=3, cfg_factory=None,
+                          dropout=0.1, artifact=True):
+    """Fused-block vs unfused A/B on the same config (ISSUE 7 acceptance):
+    GPTConfig.use_fused_block routes the whole block through
+    ops/fused_block.py; both paths timed identically on the realistic
+    training config (dropout on — the fused path's counter-hash dropout
+    replaces three threefry mask draws per layer).  Artifact:
+    benchmarks/fused_block_ab.json, including the compile contract (one
+    compile per shape, zero retraces/storms) for the fused leg."""
+    if cfg_factory is None:
+        from paddle_tpu.models import gpt_125m
+        cfg_factory = lambda **kw: gpt_125m(  # noqa: E731
+            dtype="bfloat16", use_pallas_attention=True,
+            max_position_embeddings=S, **kw)
+    legs = [(tag, cfg_factory(hidden_dropout=dropout,
+                              attention_dropout=dropout,
+                              use_fused_block=fused))
+            for tag, fused in (("fused_block", True), ("unfused", False))]
+    rows = _ab_train_legs(legs, B, S, steps, warmup)
+    rows["speedup_fused_over_unfused"] = (rows["unfused"]["step_ms"]
+                                          / rows["fused_block"]["step_ms"])
+    _emit_diag("fused_block_ab",
+               fused_step_ms=rows["fused_block"]["step_ms"],
+               unfused_step_ms=rows["unfused"]["step_ms"],
+               speedup=rows["speedup_fused_over_unfused"],
+               fused_retraces=rows["fused_block"]["retraces"])
+    if artifact:
+        _write_artifact("fused_block_ab.json", rows)
+    return rows
+
+
+def _bench_fused_ce_ab(B=8, S=2048, steps=8, warmup=3, cfg_factory=None,
+                       artifact=True, op_memory=True):
+    """Fused vs unfused LM-loss A/B (ISSUE 7 satellite): the
+    linear_softmax_cross_entropy memory claim in ops/fused.py's module
+    note, backed by a checked-in artifact — step time plus the compiled
+    program's temp-allocation bytes (the [B, S, V] logits the fused path
+    never materializes).  Artifact: benchmarks/fused_ce_ab.json."""
+    if cfg_factory is None:
+        from paddle_tpu.models import gpt_125m
+        cfg_factory = lambda **kw: gpt_125m(  # noqa: E731
+            dtype="bfloat16", use_pallas_attention=True,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            max_position_embeddings=S, **kw)
+    legs = [(tag, cfg_factory(fused_lm_loss=fused))
+            for tag, fused in (("fused_ce", True), ("unfused", False))]
+    rows = _ab_train_legs(legs, B, S, steps, warmup)
+    if op_memory:
+        rows["op_level"] = _fused_ce_op_memory()
+    rows["speedup_fused_over_unfused"] = (rows["unfused"]["step_ms"]
+                                          / rows["fused_ce"]["step_ms"])
+    if (rows["fused_ce"]["memory"] and rows["unfused"]["memory"]):
+        rows["temp_bytes_saved"] = (
+            rows["unfused"]["memory"]["temp_bytes"]
+            - rows["fused_ce"]["memory"]["temp_bytes"])
+    _emit_diag("fused_ce_ab",
+               fused_step_ms=rows["fused_ce"]["step_ms"],
+               unfused_step_ms=rows["unfused"]["step_ms"],
+               temp_saved=rows.get("temp_bytes_saved"))
+    if artifact:
+        _write_artifact("fused_ce_ab.json", rows)
+    return rows
+
+
+# smoke-model shapes for the fused A/Bs (shared by main()'s CPU branch and
+# the ci.sh kernels-tier smoke so both measure the same thing): big enough
+# that the deltas clear timer noise on a dev box, small enough for CI
+def _smoke_block_cfg(**kw):
+    from paddle_tpu.models import gpt_tiny
+    return gpt_tiny(hidden_size=256, num_heads=8, num_layers=4,
+                    max_position_embeddings=256, **kw)
+
+
+def _smoke_ce_cfg(**kw):
+    from paddle_tpu.models import gpt_tiny
+    return gpt_tiny(vocab_size=8192, max_position_embeddings=256,
+                    hidden_dropout=0.0, attention_dropout=0.0, **kw)
+
+
+_SMOKE_FUSED_BLOCK_AB = dict(B=4, S=256, steps=6, warmup=2,
+                             cfg_factory=_smoke_block_cfg)
+_SMOKE_FUSED_CE_AB = dict(B=4, S=256, steps=6, warmup=2,
+                          cfg_factory=_smoke_ce_cfg)
+
+
+def _fused_ce_op_memory(B=2, S=512, H=256, V=50304, chunk=128):
+    """Op-level rendering of the fused-CE memory claim: loss+grad of
+    linear_softmax_cross_entropy at a chunk < S (the scan engages) vs the
+    materialized-logits composition, compared by compiled temp bytes.
+    The model-level smoke legs can degenerate to one chunk == the whole
+    sequence, which hides exactly the [B, S, V] temps this op exists to
+    avoid — this measurement pins them."""
+    from paddle_tpu.ops.fused import linear_softmax_cross_entropy
+    from paddle_tpu.distributed.mp_ops import parallel_cross_entropy
+    rng = np.random.RandomState(0)
+    hidden = jnp.asarray(rng.randn(B, S, H) * 0.3, jnp.float32)
+    table = jnp.asarray(rng.randn(V, H) * 0.3, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+
+    def fused(h, t):
+        return linear_softmax_cross_entropy(h, t, labels, seq_chunk=chunk)
+
+    def unfused(h, t):
+        logits = jnp.einsum("bsh,vh->bsv", h, t).astype(jnp.float32)
+        return parallel_cross_entropy(logits, labels, reduction="mean")
+
+    out = {"batch": B, "seqlen": S, "hidden": H, "vocab": V,
+           "seq_chunk": chunk}
+    for tag, fn in (("fused", fused), ("unfused", unfused)):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1)))
+        out[tag] = _xla_memory(g, hidden, table)
+    if out["fused"] and out["unfused"]:
+        out["temp_bytes_saved"] = (out["unfused"]["temp_bytes"]
+                                   - out["fused"]["temp_bytes"])
+    return out
+
+
 def _bench_6p7b_slice(S=2048, B=1):
     """GPT-6.7B half of BASELINE row #4 (single-chip evidence): the full
     32-layer h=4096 model cannot fit one 16GB chip even with SGD (params
@@ -604,6 +772,14 @@ def main():
             except Exception as e:
                 print(f"[flash-ab] failed: {e!r}", file=sys.stderr)
             try:
+                _bench_fused_block_ab()
+            except Exception as e:
+                print(f"[fused-block-ab] failed: {e!r}", file=sys.stderr)
+            try:
+                _bench_fused_ce_ab()
+            except Exception as e:
+                print(f"[fused-ce-ab] failed: {e!r}", file=sys.stderr)
+            try:
                 _sweep_block_sizes()
             except Exception as e:
                 print(f"[block-sweep] failed: {e!r}", file=sys.stderr)
@@ -635,6 +811,20 @@ def main():
         cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
         tok_s, mfu = _bench_config(cfg, B=2, S=128, steps=3, warmup=1,
                                    tag="smoke")
+        skip_diag = (os.environ.get("BENCH_SKIP_DIAGNOSTICS", "0") == "1"
+                     or os.environ.get("BENCH_SKIP_SLICE", "0") == "1")
+        if not skip_diag:
+            # smoke-model renderings of the fused A/Bs (the TPU branch runs
+            # the 125M configs); the CPU platform gate in _write_artifact
+            # governs whether evidence is recorded
+            try:
+                _bench_fused_block_ab(**_SMOKE_FUSED_BLOCK_AB)
+            except Exception as e:
+                print(f"[fused-block-ab] failed: {e!r}", file=sys.stderr)
+            try:
+                _bench_fused_ce_ab(**_SMOKE_FUSED_CE_AB)
+            except Exception as e:
+                print(f"[fused-ce-ab] failed: {e!r}", file=sys.stderr)
 
     _emit_diag("headline", metric="gpt_tokens_per_sec_per_chip",
                tok_s=tok_s, mfu=mfu, vs_target=mfu / 0.45)

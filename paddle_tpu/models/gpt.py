@@ -70,6 +70,13 @@ class GPTConfig:
     use_recompute: bool = False
     recompute_policy: Optional[str] = None
     use_pallas_attention: bool = False   # flash-attention kernel (ops/)
+    # block-level fused execution (ISSUE 7, ops/fused_block.py): routes the
+    # whole pre-LN block — LN→QKV→attention→out-proj epilogue and
+    # LN→GEMM→gelu→GEMM→residual — through the fused kernel surfaces (Pallas
+    # on TPU, the jnp composition elsewhere; PTPU_FUSED_BLOCK forces a
+    # route).  Train, fixed-shape decode, and paged serving paths all honor
+    # it; MoE layers and sp/cp configs stay on the unfused path.
+    use_fused_block: bool = False
     dtype: str = "float32"               # activation dtype ("bfloat16" on TPU)
     # long-sequence parallelism over the 'sp' mesh axis (additive TPU-native
     # capability; the reference has none — SURVEY §5):
@@ -274,6 +281,26 @@ class GPTAttention(Layer):
             out = out.transpose(0, 2, 1, 3).reshape(b, s, c.hidden_size)
         return out, cache.replace(k_pages=k_pages, v_pages=v_pages)
 
+    def fused_paged_forward(self, x, ln, cache):
+        """Fused-epilogue serving step (ISSUE 7): LN→QKV as one fused
+        kernel pass, the PR 6 paged attention in the middle, out-proj +
+        residual as the fused epilogue.  Returns the residual-added block
+        output (the caller skips its own ``x + attn(ln(x))``)."""
+        from ..ops.fused_block import fused_linear_residual, fused_ln_linear
+        c = self.config
+        b, s, _ = x.shape
+        qkv = fused_ln_linear(x, self.qkv_proj.weight, self.qkv_proj.bias,
+                              ln.weight, ln.bias, epsilon=ln.epsilon)
+        qkv = qkv.reshape(b, s, c.num_heads, 3, c.head_dim)
+        q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)
+        k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
+        out, new_cache = self._paged_cache_forward(q, k, v, cache, b, s)
+        y = fused_linear_residual(out, self.out_proj.weight,
+                                  self.out_proj.bias, x,
+                                  dropout_p=0.0, training=False)
+        return y, new_cache
+
 
 class GPTMLP(Layer):
     """h → 4h → h, gelu; TP column/row split (reference
@@ -303,6 +330,7 @@ class GPTDecoderLayer(Layer):
     def __init__(self, config: GPTConfig, index: int = 0):
         super().__init__()
         c = config
+        self.config = c
         self.ln_1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
         self.attn = GPTAttention(c)
         self.ln_2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
@@ -322,10 +350,72 @@ class GPTDecoderLayer(Layer):
         self._use_recompute = c.use_recompute
         self._recompute_policy = c.recompute_policy
 
+    def _fused_block_ok(self) -> bool:
+        """use_fused_block eligibility: the fused ops are single-program
+        (a pallas_call is opaque to GSPMD — same gating as the flash
+        decode kernel) and cover the dense pre-LN block only."""
+        c = self.config
+        if not c.use_fused_block or self._is_moe:
+            return False
+        if c.sequence_parallel or c.context_parallel:
+            return False
+        from ..distributed.topology import get_mesh
+        return get_mesh() is None
+
+    def _block_fused(self, x):
+        """ISSUE 7 hot path: the two halves of the block as fused ops
+        (ops/fused_block.py) — Pallas kernels on TPU, the jnp composition
+        as the CPU default and interpret oracle."""
+        from ..ops.fused_block import fused_attention_block, fused_ffn_block
+        c = self.config
+        a = self.attn
+        x = fused_attention_block(
+            x, a.qkv_proj.weight, a.qkv_proj.bias, a.out_proj.weight,
+            a.out_proj.bias, self.ln_1.weight, self.ln_1.bias,
+            num_heads=c.num_heads, causal=True,
+            epsilon=c.layer_norm_epsilon, attn_dropout=c.attention_dropout,
+            hidden_dropout=c.hidden_dropout, training=self.training)
+        m = self.mlp
+        x = fused_ffn_block(
+            x, m.fc_in.weight, m.fc_in.bias, m.fc_out.weight, m.fc_out.bias,
+            self.ln_2.weight, self.ln_2.bias, activation="gelu",
+            dropout2=c.hidden_dropout, epsilon=c.layer_norm_epsilon,
+            training=self.training)
+        return x, jnp.zeros((), jnp.float32)
+
+    def _fused_cache_forward(self, x, cache):
+        """Fused decode step (ISSUE 7): covers both the fixed-shape
+        (k_buf, v_buf, used) cache and the PR 6 paged cache."""
+        from ..inference.kv_cache import PagedLayerCache
+        from ..ops.fused_block import (fused_attention_block_kvcache,
+                                       fused_ffn_block)
+        c = self.config
+        if isinstance(cache, PagedLayerCache):
+            x, new_cache = self.attn.fused_paged_forward(x, self.ln_1,
+                                                         cache)
+        else:
+            k_buf, v_buf, used = cache
+            a = self.attn
+            x, k_buf, v_buf = fused_attention_block_kvcache(
+                x, a.qkv_proj.weight, a.qkv_proj.bias, a.out_proj.weight,
+                a.out_proj.bias, self.ln_1.weight, self.ln_1.bias,
+                k_buf, v_buf, used, num_heads=c.num_heads,
+                epsilon=c.layer_norm_epsilon)
+            new_cache = (k_buf, v_buf, used + x.shape[1])
+        m = self.mlp
+        x = fused_ffn_block(
+            x, m.fc_in.weight, m.fc_in.bias, m.fc_out.weight, m.fc_out.bias,
+            self.ln_2.weight, self.ln_2.bias, activation="gelu",
+            dropout2=c.hidden_dropout, epsilon=c.layer_norm_epsilon,
+            training=self.training)
+        return x, new_cache
+
     def _block(self, x):
         """Returns (x, aux): MoE aux losses are collected INSIDE so they
         cross the jax.checkpoint boundary as a real remat output instead of
         leaking a tracer through the thread-local side channel."""
+        if self._fused_block_ok():
+            return self._block_fused(x)
         from ..distributed.moe import collect_aux_losses
         with collect_aux_losses() as aux_items:
             x = x + self.attn(self.ln_1(x))
@@ -336,6 +426,8 @@ class GPTDecoderLayer(Layer):
     def forward(self, x, cache=None):
         from ..distributed.moe import _record_aux
         if cache is not None:
+            if self._fused_block_ok():
+                return self._fused_cache_forward(x, cache)
             h, new_cache = self.attn(self.ln_1(x), cache=cache)
             x = x + h
             x = x + self.mlp(self.ln_2(x))

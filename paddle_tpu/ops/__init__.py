@@ -16,10 +16,16 @@ from .flash_attention import (flash_attention,  # noqa: F401
                               flash_attention_kvcache)
 from .fused import (fused_bias_dropout_residual_layer_norm,  # noqa: F401
                     fused_feedforward, rotary_position_embedding)
+from .fused_block import (fused_attention_block,  # noqa: F401
+                          fused_attention_block_kvcache, fused_block_route,
+                          fused_ffn_block, fused_linear_residual,
+                          fused_ln_linear)
 
 __all__ = ["flash_attention", "fused_bias_dropout_residual_layer_norm",
            "fused_feedforward", "rotary_position_embedding",
-           "pallas_enabled"]
+           "fused_attention_block", "fused_attention_block_kvcache",
+           "fused_ffn_block", "fused_ln_linear", "fused_linear_residual",
+           "fused_block_route", "pallas_enabled"]
 
 
 def pallas_enabled() -> bool:

@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework import random as fw_random
 from ..nn import functional as F
@@ -82,19 +83,46 @@ def fused_feedforward(x, w1, b1, w2, b2, ln_scale=None, ln_bias=None,
     return out
 
 
+@functools.lru_cache(maxsize=64)
+def _rope_tables(seq_len: int, head_dim: int, base: float):
+    """Host-side cache of the rope cos/sin tables per (seq_len, head_dim,
+    base) — computed ONCE (eagerly, same f32 jnp expressions the inline
+    path used, so numerics are identical) and embedded as trace constants
+    thereafter.  Before this cache every layer of every traced step
+    rebuilt inv_freq/cos/sin from scratch; now per-layer rope cost is the
+    two multiplies (ISSUE 7 satellite)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    angles = (jnp.arange(seq_len, dtype=jnp.float32)[:, None]
+              * inv_freq)                                # (s, d/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
 def rotary_position_embedding(q, k, position_ids=None, base: float = 10000.0):
     """GPT-NeoX-style rotary embedding on (batch, heads, seq, head_dim)
-    q/k; rotates the first/second halves of head_dim."""
+    q/k; rotates the first/second halves of head_dim.  cos/sin come from
+    the per-(seq_len, head_dim, base) lru cache when positions are the
+    default arange or concrete ids; only traced position_ids fall back to
+    the on-the-fly computation."""
     q, k = _arr(q), _arr(k)
     b, h, s, d = q.shape
-    if position_ids is None:
-        pos = jnp.arange(s)[None, :]                     # (1, s)
+    ids = _arr(position_ids) if position_ids is not None else None
+    if ids is None:
+        cos_t, sin_t = _rope_tables(s, d, float(base))
+        cos = cos_t[None, None, :, :]                    # (1, 1, s, d/2)
+        sin = sin_t[None, None, :, :]
+    elif not isinstance(ids, jax.core.Tracer):
+        pos = np.asarray(ids)
+        cos_t, sin_t = _rope_tables(int(pos.max()) + 1, d, float(base))
+        cos = cos_t[pos][:, None, :, :]                  # (b|1, 1, s, d/2)
+        sin = sin_t[pos][:, None, :, :]
     else:
-        pos = _arr(position_ids)
-    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = pos[..., None].astype(jnp.float32) * inv_freq  # (b|1, s, d/2)
-    cos = jnp.cos(angles)[:, None, :, :]                 # (b|1, 1, s, d/2)
-    sin = jnp.sin(angles)[:, None, :, :]
+        pos = ids
+        inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2,
+                                              dtype=jnp.float32) / d))
+        angles = pos[..., None].astype(jnp.float32) * inv_freq
+        cos = jnp.cos(angles)[:, None, :, :]             # (b|1, 1, s, d/2)
+        sin = jnp.sin(angles)[:, None, :, :]
 
     def rot(x):
         x1, x2 = x[..., : d // 2], x[..., d // 2:]

@@ -50,6 +50,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: paged-KV serving engine tests (KV cache, "
         "scheduler, ragged decode; ci.sh runs this tier explicitly)")
+    config.addinivalue_line(
+        "markers", "kernels: Pallas kernel / fused-op parity tests "
+        "(flash attention, fused block, fused CE; ci.sh runs this tier "
+        "explicitly)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -70,6 +70,42 @@ def test_bench_slice_estimate_smoke():
     assert _artifact_mtimes() == before
 
 
+def test_bench_fused_block_ab_smoke():
+    """ISSUE 7: the fused-block A/B helper runs on tiny CPU shapes, the
+    fused leg honors the compile contract, and no artifact is written."""
+    from paddle_tpu.models import gpt_tiny
+    before = _artifact_mtimes()
+    rows = bench._bench_fused_block_ab(
+        B=2, S=64, steps=2, warmup=1, artifact=False,
+        cfg_factory=lambda **kw: gpt_tiny(max_position_embeddings=64, **kw))
+    assert rows["fused_block"]["step_ms"] > 0
+    assert rows["fused_block"]["compiles"] == 1
+    assert rows["fused_block"]["retraces"] == 0
+    assert rows["fused_block"]["storms"] == 0
+    assert _artifact_mtimes() == before
+
+
+def test_bench_fused_ce_ab_smoke():
+    from paddle_tpu.models import gpt_tiny
+    before = _artifact_mtimes()
+    rows = bench._bench_fused_ce_ab(
+        B=2, S=128, steps=2, warmup=1, artifact=False, op_memory=False,
+        cfg_factory=lambda **kw: gpt_tiny(max_position_embeddings=128,
+                                          hidden_dropout=0.0,
+                                          attention_dropout=0.0, **kw))
+    assert rows["fused_ce"]["step_ms"] > 0
+    assert _artifact_mtimes() == before
+
+
+def test_fused_ce_op_memory_smoke():
+    """The op-level memory measurement must show the fused CE saving
+    temp bytes once the chunked scan engages (small-shape rendering of
+    the fused_ce_ab.json evidence)."""
+    out = bench._fused_ce_op_memory(B=1, S=256, H=64, V=4096, chunk=128)
+    if out["fused"] and out["unfused"]:       # memory analysis available
+        assert out["temp_bytes_saved"] > 0, out
+
+
 @pytest.mark.slow
 def test_bench_gpt_smoke():
     """The headline path main() takes on CPU (gpt_tiny smoke)."""

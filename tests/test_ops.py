@@ -12,6 +12,8 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.framework import flags
 from paddle_tpu import ops
 
+pytestmark = pytest.mark.kernels
+
 
 def _sdpa_ref(q, k, v, causal):
     # straight einsum reference (no pallas routing)
@@ -150,6 +152,51 @@ class TestRope:
         pos = jnp.zeros((1, 4), jnp.int32)
         qr, kr = ops.rotary_position_embedding(q, k, position_ids=pos)
         np.testing.assert_allclose(np.asarray(qr), np.asarray(q), rtol=1e-6)
+
+    def test_cached_tables_numerics_identical(self):
+        """ISSUE 7 satellite: the lru-cached cos/sin tables must be
+        numerically IDENTICAL to the from-scratch computation (same f32
+        jnp expressions, evaluated once instead of per layer per call)."""
+        from paddle_tpu.ops.fused import _rope_tables
+        q, k, _ = _rand_qkv(s=48, d=32)
+        b, h, s, d = q.shape
+
+        def scratch(q, k, pos):
+            # the pre-cache implementation, verbatim
+            inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, d, 2,
+                                                     jnp.float32) / d))
+            ang = pos[..., None].astype(jnp.float32) * inv_freq
+            cos, sin = jnp.cos(ang)[:, None], jnp.sin(ang)[:, None]
+
+            def rot(x):
+                x1, x2 = x[..., :d // 2], x[..., d // 2:]
+                f1, f2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+                return jnp.concatenate(
+                    [f1 * cos - f2 * sin, f2 * cos + f1 * sin],
+                    -1).astype(x.dtype)
+
+            return rot(q), rot(k)
+
+        hits0 = _rope_tables.cache_info().hits
+        got_q, got_k = ops.rotary_position_embedding(q, k)
+        ref_q, ref_k = scratch(q, k, jnp.arange(s)[None, :])
+        np.testing.assert_array_equal(np.asarray(got_q), np.asarray(ref_q))
+        np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
+        # second call is served from the cache (two multiplies, no
+        # inv_freq/cos/sin recomputation)
+        ops.rotary_position_embedding(q, k)
+        assert _rope_tables.cache_info().hits > hits0
+        # concrete position_ids gather from the cached table, same numbers
+        pos = jnp.arange(s)[None, :] + 3
+        got_q2, _ = ops.rotary_position_embedding(q, k, position_ids=pos)
+        ref_q2, _ = scratch(q, k, pos)
+        np.testing.assert_array_equal(np.asarray(got_q2),
+                                      np.asarray(ref_q2))
+        # traced ids still work (on-the-fly fallback)
+        f = jax.jit(lambda p: ops.rotary_position_embedding(
+            q, k, position_ids=p)[0])
+        np.testing.assert_allclose(np.asarray(f(pos)), np.asarray(ref_q2),
+                                   rtol=1e-6, atol=1e-6)
 
     def test_relative_phase(self):
         # attention scores depend only on relative positions after rope

@@ -129,10 +129,31 @@ engine.run(max_steps=500)
 engine.stop()
 print("serve smoke: 8 streams, /statusz TTFT p50/p99 + KV occupancy ok")
 PYEOF
+    # kernels tier (ISSUE 7): Pallas/fused-op parity — flash attention,
+    # fused block (both routes), fused CE, rope cache
+    python -m pytest -q -m kernels tests/test_ops.py tests/test_fused_block.py
+    # fused-block A/B smoke: the fused path must show a step-time win on
+    # the smoke model and must not retrace (one compile per shape, storm
+    # records empty — the ISSUE 7 compile contract)
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
+force_virtual_cpu_mesh(1)
+import bench
+rows = bench._bench_fused_block_ab(artifact=False,
+                                   **bench._SMOKE_FUSED_BLOCK_AB)
+fused = rows["fused_block"]
+assert fused["compiles"] == 1, f"fused step compiled {fused['compiles']}x"
+assert fused["retraces"] == 0, f"fused step retraced: {fused}"
+assert fused["storms"] == 0, f"retrace storm on the fused path: {fused}"
+speedup = rows["speedup_fused_over_unfused"]
+assert speedup > 1.0, f"fused block lost the A/B: {speedup:.2f}x"
+print(f"fused-block smoke: {speedup:.2f}x over unfused, "
+      "1 compile, 0 retraces, 0 storms")
+PYEOF
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
     echo "api-guard + lints + faults tier + telemetry tier + doctor" \
-         "smoke + monitor smoke + serving tier + serve smoke + bench" \
-         "smoke ok"
+         "smoke + monitor smoke + serving tier + serve smoke + kernels" \
+         "tier + fused-block smoke + bench smoke ok"
 fi
 echo "shard ${SHARD} green"

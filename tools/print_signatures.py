@@ -41,6 +41,7 @@ _MODULES = [
     "paddle_tpu.vision.models",
     "paddle_tpu.vision.ops",
     "paddle_tpu.models",
+    "paddle_tpu.ops",
     "paddle_tpu.hapi",
     "paddle_tpu.profiler",
     "paddle_tpu.quantization",
