@@ -293,18 +293,24 @@ def _xla_memory(jitted, *args):
         return None
 
 
-def _ab_train_legs(legs, B, S, steps, warmup):
+def _ab_train_legs(legs, B, S, steps, warmup, build=None):
     """Shared A/B harness (ISSUE 7): time each (tag, cfg) leg identically
     via _build/_timed_steps, with a compile-tracker reset around each leg
     so the artifact records the compile contract (exactly one compile per
-    step shape, zero retraces/storms) alongside the step time."""
+    step shape, zero retraces/storms) alongside the step time.
+
+    ``build`` (ISSUE 8): per-leg builder with _build's return contract
+    ``(jitted, model, params, opt_state, ids, labels)`` — the dp-comm A/B
+    passes one that closes over a leg's gradient-sync mode; the default
+    is the single-chip GPT step builder."""
     from paddle_tpu.observability.compilation import get_tracker, \
         reset_tracker
     import gc
+    build = build or _build
     rows = {}
     for tag, cfg in legs:
         reset_tracker()
-        jitted, model, params, opt_state, ids, labels = _build(cfg, B, S)
+        jitted, model, params, opt_state, ids, labels = build(cfg, B, S)
         mem = _xla_memory(jitted, params, opt_state, ids, labels,
                           jax.random.key(0))
         dt, loss, _ = _timed_steps(jitted, params, opt_state, ids, labels,
@@ -391,6 +397,189 @@ def _bench_fused_ce_ab(B=8, S=2048, steps=8, warmup=3, cfg_factory=None,
     return rows
 
 
+def _build_comm_leg(leg, B, S, lr=1e-3):
+    """_build-contract builder for one dp-comm leg (ISSUE 8): the whole
+    device set becomes a dp mesh and the leg decides how gradients move —
+
+    - ``fp32``:    exact all-reduce gradient sync, replicated Adam;
+    - ``int8_ef``: blockwise-int8 two-phase sync with error feedback
+                   (the residual rides the opt_state bundle, stacked
+                   along dp so each rank keeps its own);
+    - ``zero1``:   ShardedOptimizer — reduce-scatter grads, 1/dp-shard
+                   Adam update, all-gather params.
+
+    ``leg`` is ``{"mode": ..., "cfg": GPTConfig}``; returns _build's
+    ``(jitted, model, params, opt_state, ids, labels)`` so the shared
+    _ab_train_legs harness times every leg identically."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import random as fw_random
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.distributed import comm as comm_mod
+    from paddle_tpu.distributed.comm import CommConfig
+    from paddle_tpu.observability.compilation import track_jit
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mode, cfg = leg["mode"], leg["cfg"]
+    n = jax.device_count()
+    assert B % n == 0, f"batch {B} not divisible by dp={n}"
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    pt.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    params = model.state_dict()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def local_grads(p, ids, labels, key):
+        def loss_fn(p):
+            with fw_random.key_scope(key):
+                loss, _ = model.apply(p, ids, labels=labels)
+            return loss
+        return jax.value_and_grad(loss_fn)(p)
+
+    data_spec = P("dp", None)
+    if mode == "zero1":
+        opt = comm_mod.ShardedOptimizer(pt.optimizer.Adam(learning_rate=lr),
+                                        axis="dp", num_shards=n)
+        state_specs = opt.state_sharding_specs()
+
+        def step(p, state, ids, labels, key):
+            loss, grads = local_grads(p, ids, labels, key)
+            new_p, new_state = opt.apply_gradients(grads, p, state)
+            return lax.pmean(loss, "dp"), new_p, new_state
+
+        smapped = shard_map(step, mesh=mesh,
+                            in_specs=(P(), state_specs, data_spec,
+                                      data_spec, P()),
+                            out_specs=(P(), P(), state_specs),
+                            check_rep=False)
+        opt_state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                                      out_specs=state_specs,
+                                      check_rep=False))(params)
+    else:
+        ccfg = (CommConfig(dtype="int8", error_feedback=True)
+                if mode == "int8_ef" else CommConfig())
+        opt = pt.optimizer.Adam(learning_rate=lr)
+        bundle = {"opt": opt.init(params)}
+        bundle_specs = {"opt": jax.tree_util.tree_map(lambda _: P(),
+                                                      bundle["opt"])}
+        if ccfg.error_feedback:
+            # per-rank residuals: global leaves are the n per-rank
+            # param-shaped residuals concatenated along dim 0
+            bundle["resid"] = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((n * p.shape[0],) + tuple(p.shape[1:]),
+                                    jnp.float32), params)
+            bundle_specs["resid"] = comm_mod.stacked_specs(params)
+
+        def step(p, bundle, ids, labels, key):
+            loss, grads = local_grads(p, ids, labels, key)
+            synced, resid = comm_mod.sync_gradients(
+                grads, config=ccfg, group="dp",
+                residual=bundle.get("resid"), op="avg")
+            new_p, new_os = opt.apply_gradients(synced, p, bundle["opt"])
+            out = {"opt": new_os}
+            if resid is not None:
+                out["resid"] = resid
+            return lax.pmean(loss, "dp"), new_p, out
+
+        smapped = shard_map(step, mesh=mesh,
+                            in_specs=(P(), bundle_specs, data_spec,
+                                      data_spec, P()),
+                            out_specs=(P(), P(), bundle_specs),
+                            check_rep=False)
+        opt_state = bundle
+    jitted = track_jit(jax.jit(smapped, donate_argnums=(0, 1)),
+                       name="bench.gpt_step",
+                       arg_names=("params", "opt_state", "inputs",
+                                  "labels", "key"))
+    return jitted, model, params, opt_state, ids, labels
+
+
+def _opt_state_bytes_per_replica(opt_state, mode, n) -> int:
+    """Optimizer-state footprint one replica actually holds — the
+    ZeRO-1 claim in numbers (flat master + slots are 1/n per replica)."""
+    total = sum(leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(opt_state)
+                if hasattr(leaf, "size"))
+    return total // n if mode == "zero1" else total
+
+
+def _bench_comm_ab(B=8, S=2048, steps=8, warmup=3, cfg_factory=None,
+                   artifact=True):
+    """dp-comm A/B (ISSUE 8): fp32 all-reduce vs int8+error-feedback vs
+    ZeRO-1 on the same model/data/step-count over a dp mesh spanning all
+    local devices.  One row per leg via the shared _ab_train_legs
+    harness: step time, final loss, the compile contract, bytes-on-wire
+    per device-step from the comm package's trace-time accounting
+    (``comm.bytes`` = what the exact schedule would ship,
+    ``comm.compressed_bytes`` = what this leg ships), and the per-replica
+    optimizer-state footprint.  Artifact: benchmarks/comm_ab.json."""
+    from paddle_tpu.observability import get_registry
+    n = jax.device_count()
+    if n < 2:
+        print("[comm-ab] skipped: needs >=2 devices for a dp axis "
+              f"(have {n})", file=sys.stderr, flush=True)
+        return None
+    B = -(-B // n) * n          # global batch divisible by dp
+    if cfg_factory is None:
+        from paddle_tpu.models import gpt_125m
+        cfg_factory = lambda **kw: gpt_125m(  # noqa: E731
+            hidden_dropout=0.0, attention_dropout=0.0,
+            max_position_embeddings=S, **kw)
+    cfg = cfg_factory()
+    reg = get_registry()
+    rows = {}
+    for mode in ("fp32", "int8_ef", "zero1"):
+        raw0 = reg.counter("comm.bytes").value
+        wire0 = reg.counter("comm.compressed_bytes").value
+        leg_rows = _ab_train_legs([(mode, {"mode": mode, "cfg": cfg})],
+                                  B, S, steps, warmup,
+                                  build=_build_comm_leg)
+        row = leg_rows[mode]
+        # trace-time accounting: one compile per leg (asserted by the
+        # compile contract) => the delta IS the per-device-step bill
+        raw = reg.counter("comm.bytes").value - raw0
+        wire = reg.counter("comm.compressed_bytes").value - wire0
+        row["bytes_on_wire"] = int(wire)
+        row["bytes_exact_equiv"] = int(raw)
+        row["compress_ratio"] = (raw / wire) if wire else None
+        row["opt_state_bytes_per_replica"] = None
+        rows[mode] = row
+        print(f"[comm-ab {mode}] wire={wire / 1e6:.2f}MB/step "
+              f"(exact-equiv {raw / 1e6:.2f}MB, "
+              f"ratio {row['compress_ratio']:.2f}x)",
+              file=sys.stderr, flush=True)
+    # per-replica optimizer-state footprint (rebuild cheaply: state
+    # shapes only depend on the param tree)
+    for mode in ("fp32", "zero1"):
+        _, _, _, opt_state, _, _ = _build_comm_leg(
+            {"mode": mode, "cfg": cfg}, B, S)
+        rows[mode]["opt_state_bytes_per_replica"] = \
+            _opt_state_bytes_per_replica(opt_state, mode, n)
+    rows["int8_ef"]["opt_state_bytes_per_replica"] = \
+        rows["fp32"]["opt_state_bytes_per_replica"]
+    rows["dp_degree"] = n
+    rows["int8_vs_fp32_loss_rel"] = (
+        abs(rows["int8_ef"]["loss"] - rows["fp32"]["loss"])
+        / max(1e-9, abs(rows["fp32"]["loss"])))
+    rows["zero1_vs_fp32_loss_rel"] = (
+        abs(rows["zero1"]["loss"] - rows["fp32"]["loss"])
+        / max(1e-9, abs(rows["fp32"]["loss"])))
+    _emit_diag("comm_ab", dp=n,
+               fp32_step_ms=rows["fp32"]["step_ms"],
+               int8_step_ms=rows["int8_ef"]["step_ms"],
+               zero1_step_ms=rows["zero1"]["step_ms"],
+               int8_compress_ratio=rows["int8_ef"]["compress_ratio"],
+               int8_loss_rel=rows["int8_vs_fp32_loss_rel"],
+               zero1_loss_rel=rows["zero1_vs_fp32_loss_rel"])
+    if artifact:
+        _write_artifact("comm_ab.json", rows)
+    return rows
+
+
 # smoke-model shapes for the fused A/Bs (shared by main()'s CPU branch and
 # the ci.sh kernels-tier smoke so both measure the same thing): big enough
 # that the deltas clear timer noise on a dev box, small enough for CI
@@ -410,6 +599,18 @@ _SMOKE_FUSED_BLOCK_AB = dict(B=4, S=256, steps=6, warmup=2,
                              cfg_factory=_smoke_block_cfg)
 _SMOKE_FUSED_CE_AB = dict(B=4, S=256, steps=6, warmup=2,
                           cfg_factory=_smoke_ce_cfg)
+
+
+def _smoke_comm_cfg(**kw):
+    from paddle_tpu.models import gpt_tiny
+    return gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                    max_position_embeddings=128, **kw)
+
+
+# 30 steps is the ISSUE 8 acceptance length: enough for the int8+EF leg's
+# loss trajectory to visibly track (or visibly diverge from) fp32
+_SMOKE_COMM_AB = dict(B=8, S=128, steps=30, warmup=2,
+                      cfg_factory=_smoke_comm_cfg)
 
 
 def _fused_ce_op_memory(B=2, S=512, H=256, V=50304, chunk=128):
@@ -733,7 +934,9 @@ def _tpu_reachable(timeout_s: int = 420) -> bool:
 def main():
     if os.environ.get("BENCH_CPU", "0") == "1":  # local smoke, no TPU probe
         from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
-        force_virtual_cpu_mesh(1)
+        # BENCH_CPU_DEVICES>1 fakes a dp mesh so the comm A/B has an axis
+        # to span (the ci.sh comm smoke runs with 8)
+        force_virtual_cpu_mesh(int(os.environ.get("BENCH_CPU_DEVICES", "1")))
     elif not _tpu_reachable():
         print("[tpu unreachable after probe timeout — falling back to the "
               "CPU smoke so the bench still reports]", file=sys.stderr,
@@ -780,6 +983,12 @@ def main():
             except Exception as e:
                 print(f"[fused-ce-ab] failed: {e!r}", file=sys.stderr)
             try:
+                # dp-comm A/B (ISSUE 8): needs >=2 local devices for a dp
+                # axis; single-chip runs print the skip note and move on
+                _bench_comm_ab()
+            except Exception as e:
+                print(f"[comm-ab] failed: {e!r}", file=sys.stderr)
+            try:
                 _sweep_block_sizes()
             except Exception as e:
                 print(f"[block-sweep] failed: {e!r}", file=sys.stderr)
@@ -825,6 +1034,10 @@ def main():
                 _bench_fused_ce_ab(**_SMOKE_FUSED_CE_AB)
             except Exception as e:
                 print(f"[fused-ce-ab] failed: {e!r}", file=sys.stderr)
+            try:
+                _bench_comm_ab(**_SMOKE_COMM_AB)
+            except Exception as e:
+                print(f"[comm-ab] failed: {e!r}", file=sys.stderr)
 
     _emit_diag("headline", metric="gpt_tokens_per_sec_per_chip",
                tok_s=tok_s, mfu=mfu, vs_target=mfu / 0.45)

@@ -31,9 +31,18 @@ def main():
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
                                "pp_degree": 1}
+    # ISSUE 8: ZERO1=1 is the one-config-line switch — the fleet
+    # optimizer becomes a ZeRO-1 ShardedOptimizer (reduce-scatter grads,
+    # 1/dp of the Adam state per replica, all-gather updated params);
+    # the loss trajectory is identical to the replicated run
+    if os.environ.get("ZERO1", "0") == "1":
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1,
+                                     "shard_weight_update": True}
     fleet.init(is_collective=True, strategy=strategy)
     print(f"mesh: dp={dp} mp={mp} on {n_dev} {jax.devices()[0].platform} "
-          f"device(s)")
+          f"device(s)"
+          + (" (ZeRO-1 weight-update sharding)" if strategy.sharding else ""))
 
     pt.seed(0)
     model = GPTForCausalLM(gpt_tiny())

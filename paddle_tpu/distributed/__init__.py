@@ -15,10 +15,14 @@ rewrites, this package expresses as ONE SPMD program over a named
 - sharding.py   — ZeRO ≙ sharding stage 1/2/3 (opt-state PartitionSpecs)
 - moe.py        — EP ≙ global_scatter/gather all-to-all dispatch
 - checkpoint.py — sharded save/load ≙ auto_parallel dist_saver/converter
+- comm/         — compressed collectives + ZeRO-1 weight-update sharding
+                  (ISSUE 8: CommConfig, int8/bf16 gradient sync with
+                  error feedback, ShardedOptimizer)
 """
 from __future__ import annotations
 
 from . import fleet  # noqa: F401
+from . import comm  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
@@ -47,7 +51,7 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
                        set_hybrid_communicate_group)
 
 __all__ = [
-    "fleet", "ReduceOp", "all_gather", "all_reduce",
+    "fleet", "comm", "ReduceOp", "all_gather", "all_reduce",
     "all_reduce_quantized", "all_to_all", "barrier", "spawn",
     "broadcast", "p2p_push", "reduce", "reduce_scatter", "scatter",
     "send_recv_permute", "split", "ColumnParallelLinear", "RowParallelLinear",

@@ -1,0 +1,265 @@
+"""Compressed collectives: the same mesh-axis semantics as
+``distributed.collective``, with a CommConfig deciding what the wire
+carries.
+
+int8 schedule (the part the old ``all_reduce_quantized`` stub could not
+do — a stock psum cannot carry int8 without cross-lane overflow): a
+ring all-reduce is a reduce-scatter followed by an all-gather, and BOTH
+halves compress independently:
+
+    quantize(x + residual)  →  all_to_all int8 codes + f32 scales
+    local dequant + sum     →  each rank owns 1/n of the reduced vector
+    requantize own chunk    →  all_gather int8 codes + f32 scales
+    dequant                 →  full reduced vector everywhere
+
+Wire bytes per device: 2·(N + 4·N/block_size) versus the exact
+schedule's 2·4·N — ≈3.9× compression at block_size=256 (bf16 cast is
+the same shape with 2-byte payloads: 2×).
+
+Error feedback (EF-SGD): the residual a worker's quantizer dropped,
+``(x+e) - dequant(quantize(x+e))``, is returned to the caller and added
+back in before the next sync.  :func:`sync_gradients` threads that
+residual pytree for a whole gradient tree.
+
+Byte accounting rides the PR 3 registry at trace time (shapes are
+static): ``comm.bytes`` counts the exact-fp32 schedule,
+``comm.compressed_bytes`` what this call ships, and
+``comm.compress_ratio`` the running ratio.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import tree_flatten, tree_map, tree_unflatten
+
+from ...framework.errors import enforce
+from ..collective import (ReduceOp, _arr, _in_axis, _observed,
+                          bound_axis_size)
+from ..collective import all_reduce as _exact_all_reduce
+from ..collective import reduce_scatter as _exact_reduce_scatter
+from .compress import (dequantize_blockwise, pad_to_multiple,
+                       quantize_blockwise)
+from .config import CommConfig, resolve_comm_config
+
+__all__ = ["all_reduce", "reduce_scatter", "sync_gradients",
+           "stacked_specs", "wire_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# byte accounting (trace-time; see module docstring)
+# ---------------------------------------------------------------------------
+def wire_bytes(n_elements: int, cfg: CommConfig, rounds: int = 2) -> int:
+    """Bytes a ``rounds``-round schedule ships per device for an
+    ``n_elements`` payload under ``cfg`` (2 rounds = all-reduce's
+    reduce-scatter + all-gather; 1 = a lone reduce-scatter or
+    all-gather)."""
+    if cfg.dtype == "int8":
+        n_scales = -(-n_elements // cfg.block_size)   # ceil
+        return rounds * (n_elements + 4 * n_scales)
+    itemsize = 2 if cfg.dtype == "bfloat16" else 4
+    return rounds * n_elements * itemsize
+
+
+def _account(n_elements: int, cfg: CommConfig, rounds: int = 2) -> None:
+    from ...observability import get_registry
+    raw = wire_bytes(n_elements, CommConfig(), rounds)
+    wire = wire_bytes(n_elements, cfg, rounds)
+    reg = get_registry()
+    reg.counter("comm.bytes").inc(raw)
+    reg.counter("comm.compressed_bytes").inc(wire)
+    if wire:
+        reg.gauge("comm.compress_ratio").set(raw / wire)
+
+
+# ---------------------------------------------------------------------------
+# compressed cores (flat f32 vectors, inside a bound axis)
+# ---------------------------------------------------------------------------
+def _avg(x, op: str, n: int):
+    return x / n if op == ReduceOp.AVG else x
+
+
+def _int8_reduce_scatter_flat(flat, group: str, cfg: CommConfig,
+                              op: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Phase 1: flat f32[(n·chunk,)] → (my reduced chunk f32[(chunk,)],
+    own transmitted value f32 like ``flat`` — the dequantized payload
+    this rank shipped, for error feedback).  ``flat`` must already be
+    padded to n·block_size."""
+    n = bound_axis_size(group)
+    bs = int(cfg.block_size)
+    nb = flat.shape[0] // bs
+    enforce(nb % n == 0, "flat length must divide n*block_size")
+    codes, scale = quantize_blockwise(flat, cfg.bits, bs)
+    own = dequantize_blockwise(codes, scale, cfg.bits)
+    # destination-major: row j of (n, nb/n, bs) is rank j's chunk
+    codes = codes.reshape(n, nb // n, bs)
+    scale = scale.reshape(n, nb // n)
+    codes_r = lax.all_to_all(codes, group, split_axis=0, concat_axis=0,
+                             tiled=True)
+    scale_r = lax.all_to_all(scale, group, split_axis=0, concat_axis=0,
+                             tiled=True)
+    qmax = float(2 ** (cfg.bits - 1) - 1)
+    contrib = codes_r.astype(jnp.float32) * (scale_r[..., None] / qmax)
+    reduced = _avg(jnp.sum(contrib, axis=0), op, n).reshape(-1)
+    return reduced, own
+
+
+def _int8_all_gather_flat(chunk, group: str, cfg: CommConfig
+                          ) -> jnp.ndarray:
+    """Phase 2: requantize my reduced chunk and all-gather — returns the
+    full vector (n·chunk,) on every rank."""
+    codes, scale = quantize_blockwise(chunk, cfg.bits, cfg.block_size)
+    codes_g = lax.all_gather(codes, group, axis=0, tiled=True)
+    scale_g = lax.all_gather(scale, group, axis=0, tiled=True)
+    return dequantize_blockwise(codes_g, scale_g, cfg.bits)
+
+
+def _compressed_all_reduce(x, op: str, group: str, cfg: CommConfig
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(reduced, own-transmitted-value), both shaped/typed like ``x``.
+    ``own`` is what error feedback subtracts; exact paths return x."""
+    n = bound_axis_size(group)
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    size = flat.shape[0]
+    if cfg.dtype == "bfloat16":
+        _account(size, cfg, rounds=2)
+        sent = flat.astype(jnp.bfloat16)
+        own = sent.astype(jnp.float32)
+        out = _avg(lax.psum(sent, group).astype(jnp.float32), op, n)
+        return (out.reshape(shape).astype(dtype),
+                own.reshape(shape).astype(dtype))
+    flat, pad = pad_to_multiple(flat, n * cfg.block_size)
+    _account(flat.shape[0], cfg, rounds=2)
+    chunk, own = _int8_reduce_scatter_flat(flat, group, cfg, op)
+    full = _int8_all_gather_flat(chunk, group, cfg)
+    if pad:
+        full = full[:-pad]
+        own = own[:-pad]
+    return (full.reshape(shape).astype(dtype),
+            own.reshape(shape).astype(dtype))
+
+
+def _should_compress(x, cfg: CommConfig, op: str) -> bool:
+    # compression only makes sense for linear reductions; MAX/MIN/PROD
+    # and sub-threshold payloads stay exact
+    return (cfg.compressed and op in (ReduceOp.SUM, ReduceOp.AVG)
+            and x.size >= cfg.min_size_to_compress
+            and jnp.issubdtype(x.dtype, jnp.floating))
+
+
+# ---------------------------------------------------------------------------
+# public surface
+# ---------------------------------------------------------------------------
+@_observed
+def all_reduce(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
+               config=None):
+    """Drop-in ``collective.all_reduce`` with a CommConfig deciding the
+    wire format.  Exact (fp32 / non-sum ops / small payloads / no
+    config) delegates to the lax path; identity outside a bound axis,
+    like every collective here."""
+    cfg = resolve_comm_config(config)
+    x = _arr(x)
+    if not _in_axis(group if isinstance(group, str) else (group or [None])[0]):
+        return x
+    if not _should_compress(x, cfg, op):
+        _account(x.size, CommConfig(), rounds=2)   # exact: raw == wire
+        return _exact_all_reduce(x, op, group)
+    out, _own = _compressed_all_reduce(x, op, group, cfg)
+    return out
+
+
+@_observed
+def reduce_scatter(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
+                   axis: int = 0, config=None):
+    """Compressed ``collective.reduce_scatter``.  The compressed path is
+    defined for flat (1-D, axis 0) payloads — the gradient-sync shape
+    ZeRO uses; anything else takes the exact path."""
+    cfg = resolve_comm_config(config)
+    x = _arr(x)
+    if not _in_axis(group):
+        return x
+    if (not _should_compress(x, cfg, op) or x.ndim != 1 or axis != 0
+            or cfg.dtype == "bfloat16"):
+        if cfg.dtype == "bfloat16" and _should_compress(x, cfg, op):
+            n = bound_axis_size(group)
+            _account(x.size, cfg, rounds=1)
+            out = lax.psum_scatter(x.astype(jnp.bfloat16), group,
+                                   scatter_dimension=axis, tiled=True)
+            return _avg(out.astype(jnp.float32), op, n).astype(x.dtype)
+        _account(x.size, CommConfig(), rounds=1)
+        # the legacy exact surface only sums (reference c_reducescatter);
+        # honor AVG here so compressed and exact paths agree on semantics
+        out = _exact_reduce_scatter(x, ReduceOp.SUM, group, axis=axis)
+        return _avg(out, op, bound_axis_size(group))
+    n = bound_axis_size(group)
+    shape_ok = x.shape[0] % (n * cfg.block_size) == 0
+    enforce(shape_ok,
+            f"compressed reduce_scatter needs length divisible by "
+            f"group·block_size ({n}·{cfg.block_size}); pad first "
+            f"(got {x.shape[0]})")
+    _account(x.shape[0], cfg, rounds=1)
+    dtype = x.dtype
+    chunk, _own = _int8_reduce_scatter_flat(
+        x.astype(jnp.float32), group, cfg, op)
+    return chunk.astype(dtype)
+
+
+def sync_gradients(grads, config=None, group: Optional[str] = "dp",
+                   residual=None, op: str = ReduceOp.AVG):
+    """Synchronize a gradient pytree across ``group`` — the dp gradient
+    all-reduce with optional compression and error feedback.
+
+    Returns ``(synced, new_residual)``; ``new_residual`` is ``None``
+    unless the config asks for error feedback, in which case pass it
+    back in on the next call (a ``None`` residual starts at zero).
+    Leaves below ``min_size_to_compress`` sync exactly and keep a zero
+    residual.  Outside a bound axis this is the identity (world size 1).
+    """
+    cfg = resolve_comm_config(config)
+    leaves, treedef = tree_flatten(grads)
+    if not _in_axis(group):
+        return grads, (tree_map(jnp.zeros_like, grads)
+                       if cfg.error_feedback else None)
+    res_leaves = (treedef.flatten_up_to(residual)
+                  if residual is not None else [None] * len(leaves))
+    out, new_res = [], []
+    for g, e in zip(leaves, res_leaves):
+        if g is None:
+            out.append(None)
+            new_res.append(None)
+            continue
+        g = _arr(g)
+        if not _should_compress(g, cfg, op):
+            _account(g.size, CommConfig(), rounds=2)  # exact: raw == wire
+            out.append(_exact_all_reduce(g, op, group))
+            new_res.append(jnp.zeros_like(g) if cfg.error_feedback
+                           else None)
+            continue
+        xe = (g + e.astype(g.dtype)) if (cfg.error_feedback
+                                         and e is not None) else g
+        synced, own = _compressed_all_reduce(xe, op, group, cfg)
+        out.append(synced)
+        new_res.append((xe - own) if cfg.error_feedback else None)
+    synced_tree = tree_unflatten(treedef, out)
+    if not cfg.error_feedback:
+        return synced_tree, None
+    return synced_tree, tree_unflatten(treedef, new_res)
+
+
+def stacked_specs(tree, axis: str = "dp"):
+    """PartitionSpecs that stack per-rank state (e.g. error-feedback
+    residuals) along ``axis`` dim 0 — the out_specs/in_specs a
+    ``shard_map`` needs to carry rank-private pytrees across steps.
+    Leaves must be at least 1-D (reshape scalars to ``(1,)``)."""
+    def _spec(leaf):
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is None:
+            ndim = jnp.asarray(leaf).ndim
+        enforce(ndim >= 1,
+                "stacked_specs: scalar leaves cannot stack along an "
+                "axis; reshape to (1,)")
+        return P(axis, *([None] * (ndim - 1)))
+    return tree_map(_spec, tree)

@@ -1,0 +1,70 @@
+"""Block-wise quantization primitives for compressed collectives.
+
+The scheme is EQuARX's (PAPERS.md): per-block absmax scales, symmetric
+round-to-nearest integer codes in an int8 container.  Unlike the late
+``all_reduce_quantized`` stub (which pmax-agreed scales so int payloads
+could accumulate in int16 on the wire), scales here travel *with* the
+payload — each worker quantizes against its own data's range, which
+halves the worst-case error and is what makes the two-phase
+all-to-all/all-gather schedule in :mod:`collectives` carry true int8.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ...framework.errors import enforce
+
+__all__ = ["quantize_blockwise", "dequantize_blockwise",
+           "quantization_error_bound", "pad_to_multiple"]
+
+_SCALE_FLOOR = 1e-30     # all-zero blocks divide by this, decode to 0
+
+
+def qmax_for_bits(bits: int) -> float:
+    return float(2 ** (int(bits) - 1) - 1)
+
+
+def pad_to_multiple(flat, multiple: int) -> Tuple[jnp.ndarray, int]:
+    """Zero-pad a 1-D array so its length divides ``multiple``; returns
+    (padded, pad).  Zero padding is exact for sum/avg reductions and
+    quantizes to code 0."""
+    pad = (-flat.shape[0]) % int(multiple)
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), flat.dtype)])
+    return flat, pad
+
+
+def quantize_blockwise(flat, bits: int = 8, block_size: int = 256
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """f32[(k*block_size,)] → (codes int8[k, block_size], scales f32[k]).
+
+    Symmetric absmax: code = round(x / scale * qmax) ∈ [-qmax, qmax], so
+    dequantization error per element is bounded by scale/(2·qmax) — see
+    :func:`quantization_error_bound`.
+    """
+    enforce(flat.ndim == 1, "quantize_blockwise takes a flat vector")
+    enforce(flat.shape[0] % int(block_size) == 0,
+            f"length {flat.shape[0]} not a multiple of block_size "
+            f"{block_size} (pad_to_multiple first)")
+    qmax = qmax_for_bits(bits)
+    blocks = flat.astype(jnp.float32).reshape(-1, int(block_size))
+    scale = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1), _SCALE_FLOOR)
+    q = jnp.clip(jnp.round(blocks / scale[:, None] * qmax), -qmax, qmax)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_blockwise(codes, scale, bits: int = 8) -> jnp.ndarray:
+    """(codes int8[k, bs], scales f32[k]) → f32[(k*bs,)]."""
+    qmax = qmax_for_bits(bits)
+    return (codes.astype(jnp.float32)
+            * (scale[:, None] / qmax)).reshape(-1)
+
+
+def quantization_error_bound(scale, bits: int = 8) -> jnp.ndarray:
+    """Per-block worst-case |x - dequant(quant(x))|: half a code step,
+    scale/(2·qmax).  The round-trip tests pin the implementation to this
+    bound per block size."""
+    return scale / (2.0 * qmax_for_bits(bits))

@@ -1,0 +1,110 @@
+"""CommConfig: the per-collective compression contract.
+
+One frozen config object decides how a gradient-sync collective moves
+bytes; it is hashable so it can ride jit closures without retraces, and
+a process-wide default (installed by ``fleet.init`` from
+``DistributedStrategy.comm_configs``) lets a whole training script flip
+to compressed sync with one config line.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Union
+
+from ...framework.errors import enforce
+
+__all__ = ["CommConfig", "get_default_comm_config",
+           "set_default_comm_config", "resolve_comm_config"]
+
+_DTYPES = ("float32", "bfloat16", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommConfig:
+    """How a collective ships its payload.
+
+    dtype:
+        "float32" — exact (the lax collective untouched).
+        "bfloat16" — cast on the wire, 2× compression.
+        "int8" — block-wise absmax quantization (``bits`` wide, stored
+        int8) with per-block fp32 scales, ~4× compression.
+    bits:
+        quantization width for the int8 path (2..8; narrower bits reuse
+        the int8 container but quantize coarser).
+    block_size:
+        elements per scale block; smaller blocks mean tighter error and
+        proportionally more scale bytes on the wire.
+    error_feedback:
+        keep each worker's quantization residual and add it back into
+        the next sync (EF-SGD); needs a residual state threaded through
+        :func:`collectives.sync_gradients`.
+    min_size_to_compress:
+        tensors below this many elements always take the exact path —
+        small payloads are latency-bound, not bandwidth-bound, and
+        per-block scales would dominate their wire cost.
+    """
+
+    dtype: str = "float32"
+    bits: int = 8
+    block_size: int = 256
+    error_feedback: bool = False
+    min_size_to_compress: int = 2048
+
+    def __post_init__(self):
+        enforce(self.dtype in _DTYPES,
+                f"CommConfig.dtype must be one of {_DTYPES}, "
+                f"got {self.dtype!r}")
+        enforce(2 <= int(self.bits) <= 8,
+                f"CommConfig.bits supports 2..8 (int8 container), "
+                f"got {self.bits}")
+        enforce(int(self.block_size) > 0, "block_size must be positive")
+        enforce(int(self.min_size_to_compress) >= 0,
+                "min_size_to_compress must be >= 0")
+
+    @property
+    def compressed(self) -> bool:
+        return self.dtype != "float32"
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CommConfig":
+        """Build from a strategy-style dict; unknown keys rejected so a
+        typo'd knob fails loudly instead of silently staying exact."""
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        enforce(not unknown,
+                f"unknown CommConfig key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        return cls(**d)
+
+
+_default = CommConfig()
+
+
+def set_default_comm_config(config: Union[CommConfig, Dict[str, Any], None]
+                            ) -> CommConfig:
+    """Install the process-wide default (``None`` resets to exact
+    fp32).  Returns the installed config."""
+    global _default
+    if config is None:
+        _default = CommConfig()
+    elif isinstance(config, CommConfig):
+        _default = config
+    else:
+        _default = CommConfig.from_dict(config)
+    return _default
+
+
+def get_default_comm_config() -> CommConfig:
+    return _default
+
+
+def resolve_comm_config(config: Union[CommConfig, Dict[str, Any], None]
+                        ) -> CommConfig:
+    """Per-call override → config object; ``None`` → the process-wide
+    default."""
+    if config is None:
+        return _default
+    if isinstance(config, CommConfig):
+        return config
+    return CommConfig.from_dict(config)

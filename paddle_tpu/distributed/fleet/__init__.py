@@ -55,6 +55,12 @@ class DistributedStrategy:
         }
         self.gradient_merge = False
         self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        # ISSUE 8: how gradient-sync collectives ship their payload —
+        # CommConfig fields (dtype/bits/block_size/error_feedback/
+        # min_size_to_compress); installed as the process-wide default by
+        # fleet.init so comm.all_reduce/sync_gradients compress without
+        # per-call plumbing.  Empty dict = exact fp32.
+        self.comm_configs: Dict[str, Any] = {}
 
     def __repr__(self):
         return f"DistributedStrategy(hybrid={self.hybrid_configs})"
@@ -117,6 +123,11 @@ def init(role_maker=None, is_collective: bool = True,
             dcn[name] = d
     set_hybrid_communicate_group(
         HybridCommunicateGroup(topo, dcn_dims=dcn or None))
+    # ISSUE 8: strategy.comm_configs → the process-wide CommConfig, so a
+    # training script flips to compressed gradient sync with one line
+    # (`strategy.comm_configs = {"dtype": "int8", "error_feedback": True}`)
+    from ..comm.config import set_default_comm_config
+    set_default_comm_config(_strategy.comm_configs or None)
 
 
 def fleet_initialized() -> bool:

@@ -28,6 +28,11 @@ from ..topology import get_mesh
 __all__ = ["HybridParallelOptimizer"]
 
 
+def _stage(sharding_configs) -> int:
+    from . import _sharding_stage
+    return _sharding_stage(sharding_configs)
+
+
 def _tree_where(pred, a, b):
     return jax.tree_util.tree_map(
         lambda x, y: jnp.where(pred, x, y), a, b)
@@ -50,6 +55,21 @@ class HybridParallelOptimizer:
     """
 
     def __init__(self, inner, strategy, model=None):
+        sh_cfg = dict(strategy.sharding_configs or {})
+        self._zero1 = bool(strategy.sharding
+                           and _stage(sh_cfg) == 1
+                           and sh_cfg.get("shard_weight_update"))
+        if self._zero1:
+            # ISSUE 8: ZeRO-1 weight-update sharding — the inner
+            # optimizer becomes a ShardedOptimizer (reduce-scatter grads,
+            # 1/n-shard update, all-gather params; state placement is the
+            # wrapper's own job, so the PartitionSpec pass below is off)
+            from ..comm.zero import ShardedOptimizer
+            if not isinstance(inner, ShardedOptimizer):
+                inner = ShardedOptimizer(
+                    inner, axis=sh_cfg.get("axis"),
+                    comm=sh_cfg.get("comm"),
+                    grad_op=sh_cfg.get("grad_op", "avg"))
         self._inner = inner
         self._strategy = strategy
         self._model = model
@@ -72,7 +92,7 @@ class HybridParallelOptimizer:
         self._k = int(gm_cfg.get("k_steps", 1)) \
             if strategy.gradient_merge else 1
         self._gm_avg = bool(gm_cfg.get("avg", True))
-        self._shard = bool(strategy.sharding)
+        self._shard = bool(strategy.sharding) and not self._zero1
 
     # -- delegation ---------------------------------------------------------
     @property

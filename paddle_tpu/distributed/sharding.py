@@ -21,6 +21,20 @@ on their largest evenly-divisible unsharded dim; GSPMD then:
 
 The reference's bucketing, hooks, and offload logic have no analog to write:
 the compiler schedules the collectives.
+
+Two forms of ZeRO-1 live here (ISSUE 8):
+
+- the *declarative* form below (:func:`shard_optimizer_state`): leave the
+  optimizer untouched, PartitionSpec the slots over dp, and let GSPMD
+  derive the reduce-scatter + sharded update;
+- the *explicit* form, :class:`~paddle_tpu.distributed.comm.zero.
+  ShardedOptimizer` (re-exported here): a wrapper that owns the flat
+  fp32 master + 1/n slot shards, issues the reduce-scatter / all-gather
+  itself (compressible via CommConfig), works inside ``shard_map``, and
+  is what ``DistributedStrategy.sharding_configs["shard_weight_update"]``
+  turns on.  Prefer it when the gradient sync itself must change (int8
+  compression, explicit-collective drills); prefer the declarative form
+  when placement alone is enough.
 """
 from __future__ import annotations
 
@@ -31,11 +45,13 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.errors import enforce
+from .comm.zero import ShardedOptimizer  # noqa: F401  (public re-export)
 from .mp_layers import _clean_spec, param_sharding
 from .topology import get_mesh
 
 __all__ = ["shard_spec_for_leaf", "shard_optimizer_state",
-           "shard_params_stage3", "group_sharded_parallel"]
+           "shard_params_stage3", "group_sharded_parallel",
+           "ShardedOptimizer"]
 
 
 def shard_spec_for_leaf(leaf, base_spec: Optional[P], axis: str, axis_size: int

@@ -63,6 +63,12 @@ class Model:
         raises ``FloatingPointError``.  ``None`` (default) keeps the
         historical behavior: the update applies whatever the loss."""
         self._optimizer = optimizer
+        # ISSUE 8: a ZeRO-1 ShardedOptimizer (or a fleet wrapper over
+        # one) resolves its mesh/axis/shard-count binding NOW, so the
+        # fleet mesh active at prepare time is the one the jitted step's
+        # sharding constraints are laid out against
+        if hasattr(optimizer, "bind_mesh"):
+            optimizer.bind_mesh()
         self._loss = loss
         self._metrics = _tuplify(metrics) if metrics is not None else []
         self._nonfinite_budget = (None if nonfinite_skip_budget is None

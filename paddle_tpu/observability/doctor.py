@@ -24,6 +24,12 @@ evidence lines):
                        worker's final ``metrics.snapshot`` record (a
                        straggler computes while its peers wait in the
                        collective).
+- ``comm_bound``     — a ``collective.<op>.ms`` histogram's p50 exceeds
+                       a configurable fraction (``PTPU_COMM_BOUND_FRAC``,
+                       default 0.25) of the p50 step time: the run pays
+                       more for moving bytes than the overlap can hide —
+                       compress the dp sync or shard the weight update
+                       (``distributed/comm``, ISSUE 8).
 - ``data_starved``   — data-wait dominates the step-time breakdown.
 - ``unstable``       — the supervisor logged rollbacks / watchdog
                        timeouts / step failures (corroborating context,
@@ -48,7 +54,7 @@ from .sinks import metrics_dir
 
 __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_memory", "check_straggler", "check_data_starved",
-           "check_supervisor"]
+           "check_comm_bound", "check_supervisor"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -56,6 +62,8 @@ HBM_NEAR_LIMIT = 0.92       # peak/limit utilization
 HBM_CREEP_FRAC = 0.05       # in_use growth first→last sample, fraction
 STRAGGLER_REL_SPREAD = 0.2  # p99 spread / median step time
 DATA_STARVED_FRAC = 0.3     # data_ms / step_time_ms
+COMM_BOUND_FRAC = 0.25      # collective.<op>.ms p50 / step p50 (override
+                            # with PTPU_COMM_BOUND_FRAC)
 
 
 def _finding(kind: str, severity: float, title: str,
@@ -326,6 +334,60 @@ def check_data_starved(workers) -> List[Dict[str, Any]]:
          f"{len(step_ms)} steps"], fraction=frac)]
 
 
+def check_comm_bound(workers, frac: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
+    """ISSUE 8: a collective whose p50 latency eats more than ``frac``
+    of the p50 step time makes the run *communication-bound*.  Works on
+    any window of records (live monitor included): step p50 comes from
+    ``step`` records in the window, falling back to the ``step.time_ms``
+    histogram in the final ``metrics.snapshot``; collective p50s come
+    from the snapshot's ``collective.<op>.ms`` histograms."""
+    if frac is None:
+        frac = float(os.environ.get("PTPU_COMM_BOUND_FRAC",
+                                    COMM_BOUND_FRAC))
+    findings = []
+    worst: Dict[str, Dict[str, Any]] = {}
+    for wid, records in workers.items():
+        step_ms = sorted(float(r["step_time_ms"]) for r in records
+                         if r.get("kind") == "step"
+                         and r.get("step_time_ms"))
+        snap = next((r for r in reversed(records)
+                     if r.get("kind") == "metrics.snapshot"), None)
+        snapshot = (snap or {}).get("snapshot") or {}
+        step_p50 = (step_ms[len(step_ms) // 2] if step_ms
+                    else (snapshot.get("step.time_ms") or {}).get("p50"))
+        if not step_p50:
+            continue
+        for name, m in snapshot.items():
+            if not (name.startswith("collective.") and name.endswith(".ms")
+                    and isinstance(m, dict) and m.get("count")):
+                continue
+            p50 = m.get("p50")
+            if p50 is None or p50 < frac * step_p50:
+                continue
+            op = name[len("collective."):-len(".ms")]
+            cur = worst.get(op)
+            if cur is None or p50 / step_p50 > cur["ratio"]:
+                worst[op] = {"worker": wid, "p50_ms": p50,
+                             "step_p50_ms": step_p50,
+                             "ratio": p50 / step_p50,
+                             "count": int(m["count"])}
+    for op, info in sorted(worst.items(), key=lambda kv: -kv[1]["ratio"]):
+        findings.append(_finding(
+            "comm_bound", 45 + 45 * min(1.0, info["ratio"]),
+            f"communication-bound: {op} p50 is {info['ratio']:.0%} of "
+            f"the step time",
+            [f"collective.{op}.ms p50 {info['p50_ms']:.1f}ms vs step "
+             f"p50 {info['step_p50_ms']:.1f}ms on worker "
+             f"{info['worker']} ({info['count']} calls; threshold "
+             f"{frac:.0%})",
+             "compress the dp gradient sync (CommConfig dtype=int8/"
+             "bfloat16) or shard the weight update (ShardedOptimizer) — "
+             "see docs/ARCHITECTURE.md 'Communication'"],
+            op=op, **{k: v for k, v in info.items() if k != "op"}))
+    return findings
+
+
 def check_supervisor(events) -> List[Dict[str, Any]]:
     if not events:
         return []
@@ -369,6 +431,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_compilation(workers)
     findings += check_straggler(workers, summary)
     findings += check_data_starved(workers)
+    findings += check_comm_bound(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

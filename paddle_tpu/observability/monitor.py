@@ -405,6 +405,7 @@ class LiveAggregator:
         findings += doctor.check_compilation(workers)
         findings += doctor.check_straggler(workers)
         findings += doctor.check_data_starved(workers)
+        findings += doctor.check_comm_bound(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

@@ -54,6 +54,10 @@ def pytest_configure(config):
         "markers", "kernels: Pallas kernel / fused-op parity tests "
         "(flash attention, fused block, fused CE; ci.sh runs this tier "
         "explicitly)")
+    config.addinivalue_line(
+        "markers", "comm: communication-subsystem tests (compressed "
+        "collectives, error feedback, ZeRO-1 sharded optimizer; ci.sh "
+        "runs this tier explicitly)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,656 @@
+"""Communication subsystem tests (ISSUE 8, marker ``comm``): blockwise
+quantization error bounds, compressed collectives vs the exact lax path,
+error-feedback gradient sync tracking the fp32 loss trajectory, ZeRO-1
+ShardedOptimizer parity with replicated Adam on the 8-device virtual dp
+mesh (the MULTICHIP-style correctness drill), fleet/strategy wiring, the
+deprecation alias over the old ``all_reduce_quantized`` stub, byte
+accounting, and the doctor's ``comm_bound`` verdict."""
+import inspect
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import comm
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.comm import (CommConfig, ShardedOptimizer,
+                                         dequantize_blockwise,
+                                         quantization_error_bound,
+                                         quantize_blockwise, sync_gradients,
+                                         wire_bytes)
+from paddle_tpu.distributed.comm.compress import pad_to_multiple
+from paddle_tpu.distributed.comm.config import set_default_comm_config
+from paddle_tpu.framework.errors import EnforceNotMet
+
+pytestmark = [pytest.mark.comm, pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device CPU mesh")]
+
+N_DEV = 8
+
+
+def make_mesh():
+    return Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
+
+
+def smap(f, mesh, in_specs, out_specs):
+    """shard_map with the replication check off (collective outputs are
+    value-replicated but VMA-typed device-varying; kwarg renamed across
+    jax versions)."""
+    params = inspect.signature(shard_map).parameters
+    kw = {("check_vma" if "check_vma" in params else "check_rep"): False}
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_comm_state():
+    set_default_comm_config(None)
+    dist.set_hybrid_communicate_group(None)
+    yield
+    set_default_comm_config(None)
+    dist.set_hybrid_communicate_group(None)
+
+
+# ---------------------------------------------------------------------------
+# quantization primitives
+# ---------------------------------------------------------------------------
+class TestCompress:
+    @pytest.mark.parametrize("block_size", [32, 64, 256])
+    @pytest.mark.parametrize("bits", [4, 8])
+    def test_round_trip_error_within_bound(self, block_size, bits):
+        """The implementation is pinned to the analytic per-block bound:
+        |x - dq(q(x))| <= scale / (2·qmax), per block size and width."""
+        rng = np.random.RandomState(0)
+        flat = jnp.asarray(rng.randn(block_size * 16) * 3.0, jnp.float32)
+        codes, scale = quantize_blockwise(flat, bits=bits,
+                                          block_size=block_size)
+        back = dequantize_blockwise(codes, scale, bits=bits)
+        err = np.abs(np.asarray(back - flat)).reshape(-1, block_size)
+        bound = np.asarray(quantization_error_bound(scale, bits=bits))
+        assert (err.max(axis=1) <= bound + 1e-7).all(), \
+            (err.max(axis=1) - bound).max()
+        # the bound is tight-ish: the observed max error is within 2x of
+        # the half-step bound for a dense gaussian block
+        assert err.max() > 0.05 * bound.max()
+
+    def test_smaller_blocks_tighter_error(self):
+        rng = np.random.RandomState(1)
+        # heavy-tailed data: one outlier per big block inflates its scale
+        flat = jnp.asarray(rng.standard_cauchy(4096), jnp.float32)
+        errs = {}
+        for bs in (32, 256):
+            codes, scale = quantize_blockwise(flat, block_size=bs)
+            back = dequantize_blockwise(codes, scale)
+            errs[bs] = float(jnp.mean(jnp.abs(back - flat)))
+        assert errs[32] < errs[256]
+
+    def test_zero_block_decodes_to_zero(self):
+        flat = jnp.zeros((512,), jnp.float32)
+        codes, scale = quantize_blockwise(flat)
+        assert float(jnp.abs(dequantize_blockwise(codes, scale)).max()) == 0.0
+
+    def test_pad_to_multiple(self):
+        flat = jnp.ones((33,), jnp.float32)
+        padded, pad = pad_to_multiple(flat, 256)
+        assert padded.shape == (256,) and pad == 223
+        assert float(padded[33:].max()) == 0.0
+        same, pad0 = pad_to_multiple(jnp.ones((256,)), 256)
+        assert pad0 == 0 and same.shape == (256,)
+
+    def test_rejects_non_flat_and_ragged(self):
+        with pytest.raises(EnforceNotMet):
+            quantize_blockwise(jnp.ones((4, 4)))
+        with pytest.raises(EnforceNotMet):
+            quantize_blockwise(jnp.ones((100,)), block_size=64)
+
+
+# ---------------------------------------------------------------------------
+# compressed collectives
+# ---------------------------------------------------------------------------
+class TestCompressedCollectives:
+    def _all_reduce(self, x, cfg, op="sum"):
+        mesh = make_mesh()
+        return smap(lambda v: comm.all_reduce(v, op=op, group="dp",
+                                              config=cfg),
+                    mesh, P("dp", None), P("dp", None))(x)
+
+    def test_int8_all_reduce_close_to_exact(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 4096), jnp.float32)
+        exact = np.asarray(self._all_reduce(x, None))
+        quant = np.asarray(self._all_reduce(
+            x, CommConfig(dtype="int8", min_size_to_compress=0)))
+        scale = np.abs(exact).max()
+        assert np.abs(quant - exact).max() / scale < 0.05
+
+    def test_bf16_all_reduce_close_to_exact(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 4096), jnp.float32)
+        exact = np.asarray(self._all_reduce(x, None, op="avg"), np.float32)
+        bf = np.asarray(self._all_reduce(
+            x, CommConfig(dtype="bfloat16", min_size_to_compress=0),
+            op="avg"), np.float32)
+        assert np.abs(bf - exact).max() / np.abs(exact).max() < 0.02
+
+    def test_small_payload_stays_exact(self):
+        """Below min_size_to_compress the int8 config must take the
+        bitwise-exact lax path."""
+        x = jnp.asarray(np.random.RandomState(2).randn(8, 64), jnp.float32)
+        exact = np.asarray(self._all_reduce(x, None))
+        cfg = CommConfig(dtype="int8", min_size_to_compress=4096)
+        np.testing.assert_array_equal(
+            np.asarray(self._all_reduce(x, cfg)), exact)
+
+    def test_max_op_stays_exact(self):
+        x = jnp.asarray(np.random.RandomState(3).randn(8, 4096), jnp.float32)
+        cfg = CommConfig(dtype="int8", min_size_to_compress=0)
+        exact = np.asarray(self._all_reduce(x, None, op="max"))
+        np.testing.assert_array_equal(
+            np.asarray(self._all_reduce(x, cfg, op="max")), exact)
+
+    def test_identity_outside_mesh(self):
+        x = jnp.asarray(np.random.RandomState(4).randn(128), jnp.float32)
+        out = comm.all_reduce(x, config=CommConfig(dtype="int8"))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_int8_reduce_scatter_close_to_exact(self):
+        mesh = make_mesh()
+        rng = np.random.RandomState(5)
+        # flat length divisible by n*block_size (the ZeRO shape)
+        x = jnp.asarray(rng.randn(8 * 256 * 2), jnp.float32)
+        cfg = CommConfig(dtype="int8", min_size_to_compress=0)
+        exact = smap(lambda v: comm.reduce_scatter(v, op="avg", group="dp"),
+                     mesh, P(None), P("dp"))(x)
+        quant = smap(lambda v: comm.reduce_scatter(v, op="avg", group="dp",
+                                                   config=cfg),
+                     mesh, P(None), P("dp"))(x)
+        scale = float(np.abs(np.asarray(exact)).max())
+        assert np.abs(np.asarray(quant) - np.asarray(exact)).max() \
+            / scale < 0.05
+
+    def test_reduce_scatter_rejects_ragged_compressed_shape(self):
+        mesh = make_mesh()
+        cfg = CommConfig(dtype="int8", min_size_to_compress=0,
+                         block_size=256)
+        with pytest.raises(EnforceNotMet):
+            smap(lambda v: comm.reduce_scatter(v, group="dp", config=cfg),
+                 mesh, P(None), P("dp"))(jnp.ones((8 * 300,), jnp.float32))
+
+    def test_config_validation(self):
+        with pytest.raises(EnforceNotMet):
+            CommConfig(dtype="fp8")
+        with pytest.raises(EnforceNotMet):
+            CommConfig(bits=16)
+        with pytest.raises(EnforceNotMet):
+            CommConfig.from_dict({"dtyp": "int8"})  # typo'd knob is loud
+        assert CommConfig.from_dict(None) == CommConfig()
+        assert CommConfig(dtype="int8").compressed
+        assert not CommConfig().compressed
+
+
+# ---------------------------------------------------------------------------
+# gradient sync + error feedback
+# ---------------------------------------------------------------------------
+class TestSyncGradients:
+    def test_exact_sync_matches_psum_mean(self):
+        mesh = make_mesh()
+        rng = np.random.RandomState(0)
+        g = jnp.asarray(rng.randn(8, 4096), jnp.float32)
+
+        def f(v):
+            synced, res = sync_gradients({"w": v.reshape(-1)}, group="dp")
+            assert res is None
+            return synced["w"]
+
+        out = np.asarray(smap(f, mesh, P("dp", None), P(None))(g))
+        np.testing.assert_allclose(out, np.asarray(g).mean(0), rtol=1e-6)
+
+    def test_error_feedback_residual_reinjects(self):
+        """The residual is exactly what the quantizer dropped, and adding
+        it back next step shrinks the accumulated quantization bias:
+        after two EF steps the summed sync error is smaller than two
+        independent (EF-off) sync errors."""
+        mesh = make_mesh()
+        rng = np.random.RandomState(1)
+        g = jnp.asarray(rng.randn(8, 4096), jnp.float32)
+        cfg_ef = CommConfig(dtype="int8", min_size_to_compress=0,
+                            error_feedback=True)
+        cfg_no = CommConfig(dtype="int8", min_size_to_compress=0)
+
+        def two_steps_ef(v):
+            tree = {"w": v.reshape(-1)}
+            s1, r1 = sync_gradients(tree, config=cfg_ef, group="dp")
+            s2, r2 = sync_gradients(tree, config=cfg_ef, group="dp",
+                                    residual=r1)
+            return s1["w"] + s2["w"], r2["w"]
+
+        def two_steps_no(v):
+            tree = {"w": v.reshape(-1)}
+            s1, _ = sync_gradients(tree, config=cfg_no, group="dp")
+            s2, _ = sync_gradients(tree, config=cfg_no, group="dp")
+            return s1["w"] + s2["w"]
+
+        want = 2 * np.asarray(g).mean(0).reshape(-1)
+        got_ef, resid = smap(two_steps_ef, mesh, P("dp", None),
+                             (P(None), P("dp")))(g)
+        got_no = smap(two_steps_no, mesh, P("dp", None), P(None))(g)
+        err_ef = np.abs(np.asarray(got_ef) - want).mean()
+        err_no = np.abs(np.asarray(got_no) - want).mean()
+        assert err_ef < err_no, (err_ef, err_no)
+        assert np.abs(np.asarray(resid)).max() > 0  # residual is real
+
+    def test_small_leaves_get_zero_residual(self):
+        mesh = make_mesh()
+        cfg = CommConfig(dtype="int8", error_feedback=True,
+                         min_size_to_compress=10_000)
+
+        def f(v):
+            synced, res = sync_gradients({"w": v}, config=cfg, group="dp")
+            return synced["w"], res["w"]
+
+        g = jnp.asarray(np.random.RandomState(2).randn(8, 64), jnp.float32)
+        out, res = smap(f, mesh, P("dp", None), (P(None), P("dp", None)))(g)
+        np.testing.assert_allclose(np.asarray(out)[0], np.asarray(g).mean(0),
+                                   rtol=1e-6)
+        assert float(np.abs(np.asarray(res)).max()) == 0.0
+
+    def test_none_leaves_pass_through(self):
+        mesh = make_mesh()
+
+        def f(v):
+            synced, _ = sync_gradients({"w": v, "frozen": None}, group="dp")
+            assert synced["frozen"] is None
+            return synced["w"]
+
+        g = jnp.asarray(np.ones((8, 32)), jnp.float32)
+        out = smap(f, mesh, P("dp", None), P(None))(g)
+        np.testing.assert_allclose(np.asarray(out)[0], np.ones(32),
+                                   rtol=1e-6)
+
+    def test_int8_ef_training_tracks_fp32_loss(self):
+        """ISSUE 8 acceptance shape at test scale: 30 data-parallel SGD
+        steps on a least-squares model; the int8+error-feedback leg's
+        final loss must land within 1% of the fp32 leg's."""
+        mesh = make_mesh()
+        rng = np.random.RandomState(0)
+        Xs = jnp.asarray(rng.randn(8, 4, 16), jnp.float32)   # per-rank data
+        W_true = rng.randn(16, 8).astype(np.float32)
+        Ys = jnp.asarray(np.einsum("rbi,io->rbo", np.asarray(Xs), W_true)
+                         + 0.01 * rng.randn(8, 4, 8).astype(np.float32))
+        w0 = jnp.zeros((16, 8), jnp.float32)
+        cfg = CommConfig(dtype="int8", error_feedback=True, block_size=32,
+                         min_size_to_compress=0)
+
+        def run(ccfg):
+            def loop(x, y):
+                def body(carry, _):
+                    w, res = carry
+                    loss, g = jax.value_and_grad(
+                        lambda w: jnp.mean((x @ w - y) ** 2))(w)
+                    synced, new_res = sync_gradients(
+                        {"w": g}, config=ccfg, group="dp", residual=res)
+                    return (w - 0.05 * synced["w"], new_res), loss
+                res0 = ({"w": jnp.zeros_like(w0)}
+                        if ccfg is not None and ccfg.error_feedback
+                        else None)
+                (w, _), losses = lax.scan(body, (w0, res0), None, length=30)
+                final = jnp.mean((x @ w - y) ** 2)
+                return lax.pmean(final, "dp")
+            out = smap(loop, mesh, (P("dp", None, None),
+                                    P("dp", None, None)), P())(Xs, Ys)
+            return float(np.asarray(out).reshape(-1)[0])
+
+        loss_fp32 = run(None)
+        loss_int8 = run(cfg)
+        assert abs(loss_int8 - loss_fp32) / abs(loss_fp32) < 0.01, \
+            (loss_int8, loss_fp32)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 ShardedOptimizer
+# ---------------------------------------------------------------------------
+def _uneven_params():
+    """Param tree exercising every packing edge: total float count not
+    divisible by dp=8, a scalar leaf, mixed float dtypes, and a non-float
+    leaf that must pass through untouched."""
+    rng = np.random.RandomState(0)
+    return {
+        "w": jnp.asarray(rng.randn(13, 7), jnp.float32),      # 91 elems
+        "b": jnp.asarray(rng.randn(5), jnp.float32),          # 5
+        "scale": jnp.asarray(1.5, jnp.float32),               # scalar
+        "h": jnp.asarray(rng.randn(3, 3), jnp.bfloat16),      # mixed dtype
+        "steps": jnp.asarray(7, jnp.int32),                   # non-float
+    }
+
+
+def _like_grads(params, seed=1):
+    rng = np.random.RandomState(seed)
+
+    def g(p):
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            return None
+        return jnp.asarray(rng.randn(*p.shape) if p.ndim else rng.randn(),
+                           jnp.float32).astype(p.dtype)
+    return {k: g(v) for k, v in params.items()}
+
+
+class TestShardedOptimizer:
+    def _parity(self, make_inner, steps=3, comm_cfg=None, atol=3e-6):
+        """shard_map drill on the 8-device dp mesh: the sharded update's
+        unpacked params must match the replicated inner optimizer's
+        within dtype tolerance (default: a few f32 ulps — the flat-pack
+        reduce order differs from the per-leaf order)."""
+        mesh = make_mesh()
+        params = _uneven_params()
+        zo = ShardedOptimizer(make_inner(), axis="dp", num_shards=N_DEV,
+                              comm=comm_cfg)
+        specs = zo.state_sharding_specs()
+
+        def init(p):
+            return zo.init(p)
+
+        def step_fn(p, state, g):
+            new_p, new_s = zo.apply_gradients(g, p, state)
+            return new_p, new_s
+
+        state = jax.jit(smap(init, mesh, (P(),), specs))(params)
+        step = jax.jit(smap(step_fn, mesh, (P(), specs, P()),
+                            (P(), specs)))
+        ref = make_inner()
+        ref_state = ref.init(params)
+        p_sharded, p_ref = params, params
+        for i in range(steps):
+            grads = _like_grads(params, seed=i + 1)
+            # replicated grads: every rank supplies the same local grad,
+            # so avg(local) == the replicated gradient
+            p_sharded, state = step(p_sharded, state, grads)
+            p_ref, ref_state = ref.apply_gradients(grads, p_ref, ref_state)
+        for k in ("w", "b", "scale", "h"):
+            a = np.asarray(p_sharded[k], np.float32)
+            b = np.asarray(p_ref[k], np.float32)
+            # bf16 leaves tolerate one ulp: a sub-ulp f32 master diff can
+            # land on a rounding boundary
+            tol = max(atol, 0.01) if p_sharded[k].dtype == jnp.bfloat16 \
+                else atol
+            np.testing.assert_allclose(a, b, atol=tol, rtol=0,
+                                       err_msg=f"leaf {k}")
+        assert int(p_sharded["steps"]) == int(params["steps"])
+        return p_sharded, p_ref
+
+    def test_parity_adam_uneven_shapes(self):
+        self._parity(lambda: pt.optimizer.Adam(learning_rate=1e-2))
+
+    def test_parity_adamw_decoupled_decay(self):
+        self._parity(lambda: pt.optimizer.AdamW(learning_rate=1e-2,
+                                                weight_decay=0.1))
+
+    def test_parity_momentum_coupled_decay(self):
+        self._parity(lambda: pt.optimizer.Momentum(
+            learning_rate=1e-2, momentum=0.9, weight_decay=0.05))
+
+    def test_parity_global_norm_clip(self):
+        from paddle_tpu.optimizer import ClipGradByGlobalNorm
+        self._parity(lambda: pt.optimizer.Adam(
+            learning_rate=1e-2, grad_clip=ClipGradByGlobalNorm(0.5)))
+
+    def test_int8_compressed_reduce_scatter_stays_close(self):
+        """ZeRO with an int8-compressed gradient reduce-scatter: not
+        bitwise, but within the quantization error of replicated Adam."""
+        p_sh, p_ref = self._parity(
+            lambda: pt.optimizer.Adam(learning_rate=1e-2), steps=2,
+            comm_cfg=CommConfig(dtype="int8", block_size=32,
+                                min_size_to_compress=0),
+            atol=5e-3)
+
+    def test_gspmd_mode_parity(self):
+        """hapi/GSPMD form: mesh installed via fleet, axis unbound, the
+        state carries sharding constraints; numerics must still match
+        replicated Adam bitwise."""
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        params = _uneven_params()
+        zo = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-2))
+        assert zo.num_shards == 8 and zo.axis == "dp"
+        state = zo.init(params)
+        assert "dp" in tuple(state["flat"].sharding.spec)
+        grads = _like_grads(params)
+        new_p, state = jax.jit(zo.apply_gradients)(grads, params, state)
+        ref = pt.optimizer.Adam(learning_rate=1e-2)
+        rp, _ = ref.apply_gradients(grads, params, ref.init(params))
+        for k in ("w", "b", "scale", "h"):
+            np.testing.assert_allclose(np.asarray(new_p[k], np.float32),
+                                       np.asarray(rp[k], np.float32),
+                                       atol=0, rtol=0, err_msg=k)
+
+    def test_no_mesh_single_replica_identical(self):
+        params = _uneven_params()
+        zo = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-2))
+        assert zo.num_shards == 1
+        state = zo.init(params)
+        grads = _like_grads(params)
+        new_p, _ = zo.apply_gradients(grads, params, state)
+        ref = pt.optimizer.Adam(learning_rate=1e-2)
+        rp, _ = ref.apply_gradients(grads, params, ref.init(params))
+        for k in ("w", "b", "scale", "h"):
+            np.testing.assert_allclose(np.asarray(new_p[k], np.float32),
+                                       np.asarray(rp[k], np.float32),
+                                       atol=0, rtol=0)
+
+    def test_init_packs_tp_placed_params_exactly(self):
+        """Regression: eagerly concatenating a TP-placed model's leaves
+        (mixed PartitionSpecs on a dp×mp mesh) miscompiled on this stack
+        — replicated LN weights came back summed across devices (1.0 →
+        16.0) in the flat master.  init must round-trip placed params
+        bit-exactly."""
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        pt.seed(0)
+        model = GPTForCausalLM(gpt_tiny(num_layers=1))
+        model = fleet.distributed_model(model)
+        params = model.state_dict()
+        zo = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3))
+        meta = zo._meta(params)
+        leaves = meta.treedef.flatten_up_to(params)
+        flat = np.asarray(zo._pack_flat(leaves, meta))
+        for info in meta.packed:
+            seg = flat[info.offset:info.offset + info.size]
+            want = np.ravel(np.asarray(leaves[info.index], np.float32))
+            np.testing.assert_array_equal(seg, want, err_msg=info.path)
+
+    def test_rejects_non_elementwise_and_bad_comm(self):
+        from paddle_tpu.optimizer import Lamb
+        with pytest.raises(EnforceNotMet):
+            ShardedOptimizer(Lamb(learning_rate=1e-2))
+        with pytest.raises(EnforceNotMet):
+            ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-2),
+                             comm=CommConfig(dtype="int8",
+                                             error_feedback=True))
+        with pytest.raises(EnforceNotMet):
+            ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-2),
+                             comm=CommConfig(dtype="bfloat16"))
+
+
+# ---------------------------------------------------------------------------
+# fleet / strategy wiring
+# ---------------------------------------------------------------------------
+class TestFleetWiring:
+    def test_comm_configs_install_process_default(self):
+        from paddle_tpu.distributed.comm import get_default_comm_config
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1}
+        strategy.comm_configs = {"dtype": "int8", "error_feedback": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        cfg = get_default_comm_config()
+        assert cfg.dtype == "int8" and cfg.error_feedback
+        # re-init with an empty dict resets to exact
+        strategy.comm_configs = {}
+        fleet.init(is_collective=True, strategy=strategy)
+        assert get_default_comm_config() == CommConfig()
+
+    def test_shard_weight_update_one_config_line(self):
+        """The GPT-pretrain switch: sharding_configs["shard_weight_update"]
+        routes the fleet optimizer through ZeRO-1, bitwise-matching the
+        replicated update under jit on the dp mesh."""
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1}
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1, "shard_weight_update": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = fleet.distributed_optimizer(
+            pt.optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01),
+            strategy)
+        assert isinstance(opt.inner, ShardedOptimizer)
+        params = {"w": jnp.asarray(np.random.RandomState(0).randn(16, 32),
+                                   jnp.float32)}
+        state = opt.init(params)
+        assert "dp" in tuple(state["inner"]["flat"].sharding.spec)
+        grads = {"w": jnp.full((16, 32), 0.1, jnp.float32)}
+        new_p, _ = jax.jit(opt.apply_gradients)(grads, params, state)
+        ref = pt.optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01)
+        rp, _ = ref.apply_gradients(grads, params, ref.init(params))
+        np.testing.assert_allclose(np.asarray(new_p["w"]),
+                                   np.asarray(rp["w"]), atol=0, rtol=0)
+
+    def test_stage1_without_flag_keeps_placement_form(self):
+        from paddle_tpu.distributed.fleet.optimizer import \
+            HybridParallelOptimizer
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1}
+        strategy.sharding = True
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = fleet.distributed_optimizer(
+            pt.optimizer.Adam(learning_rate=1e-3), strategy)
+        assert isinstance(opt, HybridParallelOptimizer)
+        assert not isinstance(opt.inner, ShardedOptimizer)
+        st = opt.init({"w": jnp.ones((16, 32), jnp.float32)})
+        assert "slots" in st["inner"]  # per-param layout, not flat
+
+    def test_hapi_prepare_binds_mesh(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        zo = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3))
+        assert zo.num_shards == 8           # resolved against this mesh
+        dist.set_hybrid_communicate_group(None)
+        zo.bind_mesh()                       # hapi.prepare's hook
+        assert zo.num_shards == 1            # re-resolved: mesh gone
+
+
+# ---------------------------------------------------------------------------
+# deprecation alias + byte accounting
+# ---------------------------------------------------------------------------
+class TestAliasAndAccounting:
+    def test_all_reduce_quantized_alias_warns_and_matches(self):
+        mesh = make_mesh()
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 512), jnp.float32)
+        exact = smap(lambda v: dist.all_reduce(v, group="dp"),
+                     mesh, P("dp", None), P("dp", None))(x)
+        with pytest.warns(DeprecationWarning):
+            quant = smap(lambda v: dist.all_reduce_quantized(v, group="dp"),
+                         mesh, P("dp", None), P("dp", None))(x)
+        scale = float(np.abs(np.asarray(exact)).max())
+        assert np.abs(np.asarray(quant) - np.asarray(exact)).max() \
+            / scale < 0.05
+
+    def test_wire_bytes_formulas(self):
+        exact = CommConfig()
+        assert wire_bytes(1024, exact, rounds=2) == 2 * 4 * 1024
+        bf16 = CommConfig(dtype="bfloat16")
+        assert wire_bytes(1024, bf16, rounds=2) == 2 * 2 * 1024
+        int8 = CommConfig(dtype="int8", block_size=256)
+        assert wire_bytes(1024, int8, rounds=2) == 2 * (1024 + 4 * 4)
+        # ~3.9x at block_size=256
+        ratio = wire_bytes(2 ** 20, exact) / wire_bytes(2 ** 20, int8)
+        assert ratio > 3.9
+
+    def test_counters_advance_and_ratio(self):
+        from paddle_tpu.observability import get_registry
+        reg = get_registry()
+        raw0 = reg.counter("comm.bytes").value
+        wire0 = reg.counter("comm.compressed_bytes").value
+        mesh = make_mesh()
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 8192), jnp.float32)
+        cfg = CommConfig(dtype="int8", min_size_to_compress=0)
+        smap(lambda v: comm.all_reduce(v.reshape(-1), group="dp",
+                                       config=cfg),
+             mesh, P("dp", None), P(None))(x)
+        raw = reg.counter("comm.bytes").value - raw0
+        wire = reg.counter("comm.compressed_bytes").value - wire0
+        assert raw > 0 and wire > 0
+        assert raw / wire >= 3.0, raw / wire
+        assert reg.gauge("comm.compress_ratio").value >= 3.0
+
+
+# ---------------------------------------------------------------------------
+# doctor: comm_bound verdict
+# ---------------------------------------------------------------------------
+def _window(coll_p50, step_p50, n_steps=8, op="all_reduce"):
+    recs = [{"kind": "step", "step_time_ms": step_p50, "ts": float(i)}
+            for i in range(n_steps)]
+    recs.append({"kind": "metrics.snapshot", "ts": float(n_steps),
+                 "snapshot": {
+                     f"collective.{op}.ms": {
+                         "type": "histogram", "count": 50,
+                         "sum": coll_p50 * 50, "p50": coll_p50},
+                     "step.time_ms": {"type": "histogram", "count": n_steps,
+                                      "sum": step_p50 * n_steps,
+                                      "p50": step_p50}}})
+    return {0: recs}
+
+
+class TestDoctorCommBound:
+    def test_flags_dominant_collective(self):
+        from paddle_tpu.observability import doctor
+        findings = doctor.check_comm_bound(_window(40.0, 100.0))
+        assert len(findings) == 1
+        f = findings[0]
+        assert f["kind"] == "comm_bound"
+        assert f["data"]["op"] == "all_reduce"
+        assert f["data"]["worker"] == 0
+        assert abs(f["data"]["ratio"] - 0.4) < 1e-6
+        assert any("all_reduce" in e for e in f["evidence"])
+
+    def test_quiet_below_threshold(self):
+        from paddle_tpu.observability import doctor
+        assert doctor.check_comm_bound(_window(10.0, 100.0)) == []
+
+    def test_fraction_configurable(self):
+        from paddle_tpu.observability import doctor
+        w = _window(10.0, 100.0)
+        assert doctor.check_comm_bound(w, frac=0.05)
+        assert doctor.check_comm_bound(w, frac=0.5) == []
+
+    def test_step_p50_falls_back_to_snapshot(self):
+        from paddle_tpu.observability import doctor
+        w = _window(40.0, 100.0)
+        w[0] = [r for r in w[0] if r["kind"] != "step"]  # snapshot only
+        findings = doctor.check_comm_bound(w)
+        assert findings and findings[0]["data"]["step_p50_ms"] == 100.0
+
+    def test_diagnose_surfaces_comm_bound(self, tmp_path):
+        """End-to-end: a run dir whose worker stream carries the synthetic
+        window gets a ranked comm_bound finding from diagnose()."""
+        import json
+        from paddle_tpu.observability import doctor
+        from paddle_tpu.observability.aggregate import SCHEMA_VERSION
+        mdir = tmp_path / "metrics"
+        mdir.mkdir()
+        recs = _window(60.0, 100.0)[0]
+        with open(mdir / "worker-0.jsonl", "w") as fh:
+            for r in recs:
+                fh.write(json.dumps({"schema_version": SCHEMA_VERSION,
+                                     **r}) + "\n")
+        diag = doctor.diagnose(str(tmp_path))
+        kinds = {f["kind"] for f in diag["findings"]}
+        assert "comm_bound" in kinds, kinds
