@@ -1,0 +1,192 @@
+"""Elastic-fleet training worker (ISSUE 9) — the script the launcher's
+reconciliation loop drives:
+
+    python -m paddle_tpu.distributed.launch --nnodes 2 --elastic 1:2 \
+        --run_dir runs/elastic examples/train_elastic.py -- --steps 40
+
+Each worker:
+
+- joins the world published in ``<run_dir>/world.json`` and fences its
+  checkpoint commits against the live generation
+  (``ElasticTrainState.bind_world``);
+- trains a tiny deterministic full-batch model — every member computes
+  the identical update for a given global step, so the loss trajectory
+  is width-independent by construction (the zero-communication rendering
+  of replicated data parallelism: this container's CPU backend cannot
+  run cross-process collectives, and the drill's parity claim must not
+  depend on them);
+- beats its heartbeat (generation-stamped) every step;
+- the chief (lowest member id) commits a checkpoint every
+  ``--save-interval`` steps and finalizes at the end;
+- on a generation bump it either exits (retired from the world) or
+  **rewinds to last_good_step()** and continues at the new width — one
+  checkpoint interval lost, recorded as an ``elastic.rewind`` event;
+- a respawned worker restores from the chief's committed chain, which is
+  the cross-process state handoff the drill asserts.
+
+Fault hook: ``PTPU_TEST_SIGKILL_STEP`` / ``PTPU_TEST_SIGKILL_RANK``
+SIGKILL the matching rank at that step in generation 0 (see
+``testing.faults.sigkill_at``) — the mid-run preemption the SIGKILL
+drill injects.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import elastic as el
+from paddle_tpu.supervisor.heartbeat import HeartbeatWriter
+from paddle_tpu.supervisor.report import SupervisorReport
+from paddle_tpu.testing import faults
+from paddle_tpu.utils import fsio
+
+DIM = 8
+
+
+def make_batch(step: int):
+    """Deterministic full-batch data for a global step — identical on
+    every member, so the update (and therefore the loss trajectory) is
+    independent of the world width."""
+    rng = np.random.RandomState(10_000 + step)
+    x = rng.randn(16, DIM).astype(np.float32)
+    w_true = np.linspace(-1.0, 1.0, DIM).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(16).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@jax.jit
+def train_step(w, x, y, lr):
+    def loss_fn(w):
+        err = x @ w - y
+        return jnp.mean(err * err)
+    loss, grad = jax.value_and_grad(loss_fn)(w)
+    return w - lr * grad, loss
+
+
+def wait_for_membership(run_dir: str, worker: int, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        world = el.read_world(run_dir)
+        if world and worker in world["members"]:
+            return world
+        time.sleep(0.05)
+    raise SystemExit(f"worker {worker}: never became a member of "
+                     f"{el.world_path(run_dir)}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--save-interval", type=int, default=8)
+    p.add_argument("--step-time", type=float, default=0.05,
+                   help="simulated per-step wall time (keeps the run "
+                        "alive long enough to lose a worker mid-run)")
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args(argv)
+
+    worker = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    run_dir = os.environ.get("PTPU_RUN_DIR")
+    if not run_dir:
+        raise SystemExit("train_elastic.py needs PTPU_RUN_DIR (run it "
+                         "under `launch --elastic --run_dir ...`)")
+
+    os.makedirs(os.path.join(run_dir, "reports"), exist_ok=True)
+    report = SupervisorReport(
+        os.path.join(run_dir, "reports", f"worker-{worker}.json"))
+    world = wait_for_membership(run_dir, worker)
+    generation = int(world["generation"])
+
+    mgr = el.ElasticTrainState(
+        os.path.join(run_dir, "checkpoints"),
+        save_interval_steps=args.save_interval,
+        install_sigterm_handler=False, event_sink=report.record)
+    mgr.bind_world(run_dir, generation=generation, worker_id=worker)
+
+    heartbeat = HeartbeatWriter(run_dir, worker_id=worker)
+    heartbeat.generation = generation
+    heartbeat.start()
+    kill_fault = faults.sigkill_at.from_env(worker)
+
+    state, start = mgr.restore_or(
+        lambda: {"w": jnp.zeros((DIM,), jnp.float32)},
+        lambda: {"w": jnp.zeros((DIM,), jnp.float32)})
+    report.record("worker_start", worker=worker, generation=generation,
+                  start_step=start, members=world["members"])
+
+    losses = {}
+    rewinds = 0
+    generations_seen = [generation]
+    step = start
+    while step < args.steps:
+        world = el.read_world(run_dir) or world
+        if int(world["generation"]) > generation:
+            generation = int(world["generation"])
+            generations_seen.append(generation)
+            heartbeat.generation = generation
+            mgr.set_generation(generation)
+            if worker not in world["members"]:
+                report.record("worker_retired", worker=worker,
+                              generation=generation, step=step)
+                heartbeat.stop()
+                return 0
+            # membership changed: the run re-forms from the last
+            # committed step — one checkpoint interval lost, not the job
+            try:
+                mgr.wait()
+            except (el.StaleGeneration, OSError) as e:
+                report.record("pending_save_dropped", error=str(e))
+            state, new_start = mgr.restore_or(
+                lambda: {"w": jnp.zeros((DIM,), jnp.float32)},
+                lambda: {"w": jnp.zeros((DIM,), jnp.float32)})
+            report.record("elastic.rewind", worker=worker,
+                          generation=generation, from_step=step,
+                          to_step=new_start,
+                          world_size=world["world_size"])
+            rewinds += 1
+            step = new_start
+            continue
+
+        kill_fault(step, generation)
+        x, y = make_batch(step)
+        new_w, loss = train_step(state["w"], x, y, args.lr)
+        state = {"w": new_w}
+        losses[str(step)] = float(loss)
+        heartbeat.maybe_beat(step)
+        chief = min(world["members"])
+        if worker == chief:
+            try:
+                mgr.maybe_save(step, state)
+            except el.StaleGeneration:
+                continue  # the poll at loop top will pick up the world
+        if args.step_time:
+            time.sleep(args.step_time)
+        step += 1
+
+    chief = min((el.read_world(run_dir) or world)["members"])
+    if worker == chief:
+        try:
+            mgr.finalize(args.steps, state)
+        except el.StaleGeneration:
+            pass
+    heartbeat.beat(step)
+    heartbeat.stop()
+    result = {"worker": worker, "final_step": step,
+              "final_loss": losses.get(str(args.steps - 1)),
+              "rewinds": rewinds, "generations": generations_seen,
+              "losses": losses}
+    fsio.atomic_write_bytes(
+        os.path.join(run_dir, f"result-worker-{worker}.json"),
+        json.dumps(result, indent=1).encode("utf-8"))
+    report.record("worker_done", **{k: v for k, v in result.items()
+                                    if k != "losses"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

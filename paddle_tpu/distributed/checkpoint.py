@@ -320,7 +320,8 @@ def _read_window(leaf_dir: str, entry: Dict[str, Any], window) -> np.ndarray:
     return out
 
 
-def load_sharded(path: str, template=None, *, strict: bool = True):
+def load_sharded(path: str, template=None, *, strict: bool = True,
+                 mismatch=None):
     """Load a sharded checkpoint.
 
     ``template``: a pytree matching the saved structure whose leaves carry
@@ -329,6 +330,14 @@ def load_sharded(path: str, template=None, *, strict: bool = True):
     sharding, reading only the slices every device needs (resharding-on-load;
     ≙ auto_parallel converter).  With ``template=None`` returns a nested
     dict of host numpy arrays (names split on '/').
+
+    ``mismatch``: optional ``fn(name, saved_np, template_leaf) -> array``
+    called for leaves whose saved GLOBAL shape differs from the
+    template's — the elastic-resize relayout hook (ISSUE 9): a ZeRO-1
+    flat master padded for one dp width re-packs to another, and
+    rank-private error-feedback state resets.  The full saved array is
+    assembled on host and handed over; the returned leaf is used as-is.
+    Without it a shape mismatch is an error, as before.
 
     Integrity: with a v2 manifest every referenced shard file is verified
     (existence, byte size, CRC32) BEFORE any array is materialized; a
@@ -388,6 +397,11 @@ def load_sharded(path: str, template=None, *, strict: bool = True):
             # mesh-sharded arrays in one jitted computation
             sharding = None
         tshape = tuple(getattr(tpl, "shape", shape))
+        if tshape != shape and mismatch is not None:
+            full = _read_window(d, entry,
+                                tuple(slice(0, s) for s in shape))
+            restored[name] = mismatch(name, full, tpl)
+            continue
         enforce(tshape == shape,
                 f"{name}: template shape {tshape} != saved {shape}")
         if sharding is None:

@@ -47,11 +47,12 @@ from .compress import (dequantize_blockwise, quantize_blockwise,  # noqa: F401
                        quantization_error_bound)
 from .collectives import (all_reduce, reduce_scatter,  # noqa: F401
                           sync_gradients, stacked_specs, wire_bytes)
-from .zero import ShardedOptimizer  # noqa: F401
+from .zero import ShardedOptimizer, repack_flat  # noqa: F401
 
 __all__ = [
     "CommConfig", "get_default_comm_config", "set_default_comm_config",
     "resolve_comm_config", "quantize_blockwise", "dequantize_blockwise",
     "quantization_error_bound", "all_reduce", "reduce_scatter",
     "sync_gradients", "stacked_specs", "wire_bytes", "ShardedOptimizer",
+    "repack_flat",
 ]

@@ -48,7 +48,37 @@ from ..topology import get_mesh
 from .collectives import _account, _int8_reduce_scatter_flat
 from .config import CommConfig, resolve_comm_config
 
-__all__ = ["ShardedOptimizer"]
+__all__ = ["ShardedOptimizer", "repack_flat"]
+
+
+def repack_flat(saved, target_len: int) -> np.ndarray:
+    """Re-pad a zero-padded flat pack (the ZeRO-1 master / slot layout)
+    from one shard count's alignment to another's — the elastic-resize
+    relayout (ISSUE 9).
+
+    The pack invariant makes this exact: real elements occupy
+    ``[0, total)`` and everything past ``total`` is zeros, so moving
+    between ``padded_old`` and ``padded_new`` (both ≥ total) only drops
+    or adds zero padding — the real elements are preserved **bitwise**.
+    Dropping a nonzero tail is refused loudly: that would mean the
+    target was packed for different params, not a different width.
+    """
+    saved = np.asarray(saved)
+    enforce(saved.ndim == 1,
+            f"repack_flat wants a flat (1-D) pack, got {saved.shape}")
+    n = saved.shape[0]
+    target_len = int(target_len)
+    if target_len == n:
+        return saved
+    if target_len < n:
+        tail = saved[target_len:]
+        enforce(not np.any(tail),
+                f"repack_flat would drop {int(np.count_nonzero(tail))} "
+                f"nonzero element(s) truncating {n} -> {target_len}; the "
+                f"saved pack belongs to different params")
+        return np.ascontiguousarray(saved[:target_len])
+    return np.concatenate(
+        [saved, np.zeros((target_len - n,), saved.dtype)])
 
 
 class _LeafInfo(NamedTuple):
@@ -280,6 +310,36 @@ class ShardedOptimizer:
             state["slots"] = jax.tree_util.tree_map(
                 lambda s: jax.device_put(s, shard), state["slots"])
         return state
+
+    def relayout_state(self, state, params):
+        """Re-pack a (host or globally-gathered) ZeRO-1 state built for a
+        DIFFERENT shard count onto this optimizer's currently-resolved
+        mesh/axis/shard-count binding — the elastic dp-resize path
+        (ISSUE 9).  ``state`` leaves must be the full ``(padded_old,)``
+        vectors (what a checkpoint restore without a sharded template
+        yields); returns the state placed for the current mesh.  Values
+        are preserved bitwise (only zero padding moves)."""
+        mesh, axis, n = self._resolve()
+        meta = self._meta(params)
+
+        def _repack(leaf):
+            leaf = np.asarray(leaf)
+            if leaf.ndim != 1:
+                return jnp.asarray(leaf)      # "step" scalar passthrough
+            enforce(leaf.shape[0] >= meta.total,
+                    f"flat state of {leaf.shape[0]} elements cannot hold "
+                    f"{meta.total} packed params — wrong checkpoint?")
+            return jnp.asarray(repack_flat(leaf, meta.padded))
+
+        out = {"step": jnp.asarray(np.asarray(state["step"]), jnp.int32),
+               "flat": _repack(state["flat"]),
+               "slots": jax.tree_util.tree_map(_repack, state["slots"])}
+        if mesh is not None and n > 1 and axis in mesh.axis_names:
+            shard = NamedSharding(mesh, P(axis))
+            out["flat"] = jax.device_put(out["flat"], shard)
+            out["slots"] = jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, shard), out["slots"])
+        return out
 
     def state_sharding_specs(self, params=None):
         """PartitionSpecs for the state pytree — the out_specs/in_specs

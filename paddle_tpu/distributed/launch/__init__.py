@@ -102,6 +102,16 @@ def launch(argv: Optional[List[str]] = None) -> int:
                    help="supervised run directory: the launcher monitors "
                         "<run_dir>/heartbeats and logs/records run-state "
                         "transitions (healthy/degraded/lost-worker)")
+    p.add_argument("--elastic", default=os.environ.get("PTPU_ELASTIC"),
+                   metavar="MIN:MAX",
+                   help="elastic fleet mode (ISSUE 9): reconcile the "
+                        "worker set between MIN and MAX instead of dying "
+                        "with the first lost worker — publishes a "
+                        "generation-stamped <run_dir>/world.json, shrinks "
+                        "the world when a worker dies, respawns it after "
+                        "PTPU_ELASTIC_RESPAWN_SECS and re-expands; every "
+                        "transition is an elastic.resize event in "
+                        "launcher_report.json (requires --run_dir)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -112,6 +122,9 @@ def launch(argv: Optional[List[str]] = None) -> int:
         env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
         env["PADDLE_TRAINER_ID"] = str(rank)
         return env
+
+    if args.elastic:
+        return _reconcile_elastic(args)
 
     if args.nnodes <= 1:
         sys.argv = [args.script] + list(args.script_args)
@@ -163,6 +176,179 @@ def launch(argv: Optional[List[str]] = None) -> int:
     if args.run_dir:
         _aggregate_metrics(args.run_dir)
     return rc
+
+
+def _parse_elastic(spec: str, nnodes: int):
+    """``MIN:MAX`` (or ``MIN``) → (min, max); the launch width must sit
+    inside the range."""
+    lo, _, hi = str(spec).partition(":")
+    min_n = int(lo)
+    max_n = int(hi) if hi else max(nnodes, min_n)
+    if not (1 <= min_n <= nnodes <= max_n):
+        raise SystemExit(
+            f"--elastic {spec!r}: need 1 <= MIN <= --nnodes <= MAX "
+            f"(got min={min_n} nnodes={nnodes} max={max_n})")
+    return min_n, max_n
+
+
+def _reconcile_elastic(args) -> int:
+    """The elastic fleet's control loop (ISSUE 9) — the launcher-side
+    half of the reference ElasticManager's watch cycle.
+
+    The launcher is the single writer of ``<run_dir>/world.json``.  Every
+    membership change bumps the world generation, which (a) tells the
+    surviving workers to rewind to ``last_good_step()`` and re-form at
+    the new width, and (b) fences the departed worker: if its process is
+    somehow still alive (zombie, GC pause), its checkpoint commits are
+    refused against the newer generation.
+
+    Workers are spawned as plain script processes (NOT through the
+    ``--node_rank`` re-exec, which would initialize a fixed-size
+    ``jax.distributed`` world — on a real TPU pod the runtime re-forms
+    the SPMD world per relaunch; membership is the launcher's job).
+
+    Env knobs: ``PTPU_ELASTIC_RESPAWN_SECS`` (delay before a lost rank
+    is retried, default 5), ``PTPU_ELASTIC_MAX_RESPAWNS`` (retries per
+    rank, default 2).
+    """
+    import time
+
+    from ...supervisor.heartbeat import HeartbeatMonitor, default_interval
+    from ...supervisor.report import SupervisorReport
+    from ..elastic import write_world
+
+    if not args.run_dir:
+        raise SystemExit("--elastic requires --run_dir (the world "
+                         "descriptor and heartbeats live there)")
+    min_n, max_n = _parse_elastic(args.elastic, args.nnodes)
+    respawn_secs = float(os.environ.get("PTPU_ELASTIC_RESPAWN_SECS", "5"))
+    max_respawns = int(os.environ.get("PTPU_ELASTIC_MAX_RESPAWNS", "2"))
+    run_dir = args.run_dir
+    report = SupervisorReport(os.path.join(run_dir, "launcher_report.json"))
+
+    generation = 0
+    members = set(range(args.nnodes))
+    write_world(run_dir, generation=generation, members=members,
+                min_size=min_n, max_size=max_n, reason="launch")
+    report.record("elastic.world", generation=generation,
+                  members=sorted(members), min=min_n, max=max_n)
+
+    # workers run the script directly (sys.path[0] becomes the script's
+    # dir, not ours) — make sure they can import this very package
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+    def spawn(rank: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = str(len(members))
+        env["PTPU_RUN_DIR"] = run_dir
+        env["PTPU_ELASTIC"] = args.elastic
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        cmd = [sys.executable, args.script] + [
+            a for a in args.script_args if a != "--"]
+        vlog(1, "elastic: spawning rank %d: %s", rank, cmd)
+        return subprocess.Popen(cmd, env=env)
+
+    def publish(reason: str, direction: str, changed):
+        nonlocal generation
+        generation += 1
+        write_world(run_dir, generation=generation, members=members,
+                    min_size=min_n, max_size=max_n, reason=reason)
+        monitor.set_expected(set(members))
+        report.record("elastic.resize", generation=generation,
+                      world_size=len(members), members=sorted(members),
+                      direction=direction, changed=sorted(changed),
+                      reason=reason)
+        try:
+            from ...observability import get_registry
+            reg = get_registry()
+            reg.counter("elastic.resizes").inc()
+            reg.gauge("elastic.generation").set(generation)
+            reg.gauge("elastic.world_size").set(len(members))
+        except Exception as e:
+            vlog(1, "elastic: resize metrics failed: %r", e)
+        vlog(0, "elastic: world generation %d — %s %s (%d member(s): %s)",
+             generation, direction, sorted(changed), len(members),
+             sorted(members))
+
+    monitor = HeartbeatMonitor(run_dir, expected=set(members),
+                               report=report)
+    stop_live = _live_aggregate(run_dir, report)
+    procs = {rank: spawn(rank) for rank in sorted(members)}
+    respawn_at: dict = {}      # rank -> monotonic deadline
+    respawns: dict = {}        # rank -> attempts used
+    finished_clean = set()
+    failed = False
+    poll_every = min(0.2, default_interval() / 2.0)
+    last_hb_poll = 0.0
+    try:
+        while procs or respawn_at:
+            now = time.monotonic()
+            if now - last_hb_poll >= default_interval() / 2.0:
+                last_hb_poll = now
+                monitor.poll()
+            for rank, proc in list(procs.items()):
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                del procs[rank]
+                if rc == 0:
+                    finished_clean.add(rank)
+                    vlog(1, "elastic: rank %d finished clean", rank)
+                    continue
+                if rank not in members:
+                    vlog(1, "elastic: retired rank %d exited %d", rank, rc)
+                    continue
+                # lost worker — shrink the world (or fail below MIN)
+                members.discard(rank)
+                report.record("elastic.worker_lost", rank=rank,
+                              returncode=rc)
+                if len(members) < min_n:
+                    report.record("elastic.failed", reason="below-min",
+                                  world_size=len(members), min=min_n)
+                    vlog(0, "elastic: %d member(s) left < min %d — "
+                         "failing the run", len(members), min_n)
+                    failed = True
+                    for p in procs.values():
+                        p.terminate()
+                    return 1
+                publish(f"lost-worker:{rank}", "shrink", {rank})
+                if respawns.get(rank, 0) < max_respawns \
+                        and len(members) < max_n:
+                    respawn_at[rank] = now + respawn_secs
+            # a finished world means the run is over: members that are
+            # neither running nor scheduled for respawn all exited clean
+            live_members = [r for r in members
+                            if r in procs or r in respawn_at]
+            if not live_members and members <= finished_clean:
+                respawn_at.clear()
+                break
+            for rank, deadline in list(respawn_at.items()):
+                if time.monotonic() < deadline:
+                    continue
+                del respawn_at[rank]
+                respawns[rank] = respawns.get(rank, 0) + 1
+                members.add(rank)
+                publish(f"respawn:{rank}", "grow", {rank})
+                procs[rank] = spawn(rank)
+            time.sleep(poll_every)
+    finally:
+        for rank, proc in procs.items():
+            if proc.poll() is None:   # retired stragglers: the run is over
+                vlog(1, "elastic: terminating leftover rank %d", rank)
+                proc.terminate()
+        if stop_live is not None:
+            stop_live()
+        monitor.poll()
+        rc_final = 1 if failed or not (members <= finished_clean) else 0
+        report.record("elastic.done", returncode=rc_final,
+                      generation=generation, members=sorted(members),
+                      finished=sorted(finished_clean),
+                      respawns=dict(respawns))
+        _aggregate_metrics(run_dir)
+    return rc_final
 
 
 def _aggregate_metrics(run_dir: str) -> None:

@@ -326,6 +326,11 @@ class Model:
                         good = sup.last_action in (None, GuardAction.OK)
                         if sup.pending_rollback:
                             self._supervised_rollback(sup)
+                        elif sup.pending_resize is not None:
+                            # elastic resize (ISSUE 9): lost worker or a
+                            # scale signal — re-form the mesh at the new
+                            # width and resume from last_good_step
+                            self._supervised_resize(sup)
                         else:
                             # checkpoint only states a good update built
                             sup.note_step_ok(
@@ -474,6 +479,18 @@ class Model:
             lambda: (sup.initial_state if sup.initial_state is not None
                      else self._supervised_state()),
             lambda: self._supervised_state(), reason)
+        self._load_supervised_state(state)
+
+    def _supervised_resize(self, sup) -> None:
+        """Execute a latched elastic resize (ISSUE 9): the coordinator
+        re-forms the mesh at the new width and re-shards the last
+        committed state onto it; the live model adopts the restored
+        (rewound) state and training continues — the jitted step simply
+        retraces against the new placements."""
+        state, _start = sup.perform_resize(
+            lambda: (sup.initial_state if sup.initial_state is not None
+                     else self._supervised_state()),
+            lambda: self._supervised_state())
         self._load_supervised_state(state)
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,

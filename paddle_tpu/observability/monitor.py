@@ -182,6 +182,31 @@ class StatusServer:
                 pass
         status["serving"] = serving or None
         sup = self.supervisor
+        # elasticity (ISSUE 9): present whenever an elastic coordinator
+        # drives this worker or elastic.* instruments exist — the page an
+        # operator checks after a preemption notice
+        elastic: Dict[str, Any] = {}
+        if any(k.startswith("elastic.") for k in snap):
+            resizes = snap.get("elastic.resizes")
+            elastic = {
+                "generation": gauge("elastic.generation"),
+                "world_size": gauge("elastic.world_size"),
+                "dp": gauge("elastic.dp"),
+                "resizes": (resizes["value"] if resizes
+                            and resizes.get("type") == "counter" else 0),
+            }
+        coord = getattr(sup, "coordinator", None) if sup else None
+        if coord is not None:
+            elastic.update({
+                "generation": coord.generation,
+                "dp": coord.dp, "mp": coord.mp, "pp": coord.pp,
+                "world_size": coord.world_size,
+                "min_dp": coord.min_dp, "max_dp": coord.max_dp,
+                "resizes": coord.resizes,
+                "last_resize": coord.last_resize,
+                "pending": getattr(sup, "pending_resize", None),
+            })
+        status["elastic"] = elastic or None
         if sup is not None:
             if status["step"] is None:
                 status["step"] = sup.gstep

@@ -78,7 +78,8 @@ class RunSupervisor:
                  expected_workers: Optional[int] = None,
                  reseed: Optional[Callable[[int], None]] = None,
                  report_path: Optional[str] = None,
-                 sigterm_handler: bool = True, clock=time.time):
+                 sigterm_handler: bool = True, clock=time.time,
+                 coordinator=None):
         os.makedirs(run_dir, exist_ok=True)
         self.run_dir = run_dir
         self.report = SupervisorReport(
@@ -107,6 +108,13 @@ class RunSupervisor:
         self.rollback = RollbackManager(
             self.elastic, budget=rollback_budget, report=self.report,
             reseed=reseed)
+        # elastic resize (ISSUE 9): an optional ElasticCoordinator turns
+        # lost-worker from "roll back at the same width" into "re-form
+        # the mesh at the surviving width and continue"
+        self.coordinator = coordinator
+        if coordinator is not None and coordinator.event_sink is None:
+            coordinator.event_sink = self.report.record
+        self.pending_resize: Optional[dict] = None
         self.step_failure_budget = int(step_failure_budget)
         self.pending_rollback: Optional[str] = None
         self.last_action: Optional[str] = None
@@ -265,11 +273,54 @@ class RunSupervisor:
         return GuardAction.SKIP
 
     def maybe_poll(self) -> None:
-        """Heartbeat-health poll, throttled to half the stale window."""
+        """Heartbeat-health poll, throttled to half the stale window.
+        With an elastic coordinator attached, a LOST_WORKER verdict
+        latches a resize to the surviving width instead of leaving
+        rollback-at-full-width as the only remedy (ISSUE 9)."""
         now = float(self._clock())
         if now - self._last_poll >= self.monitor.stale_after / 2.0:
             self._last_poll = now
-            self.monitor.poll()
+            detail = self.monitor.poll()
+            if (self.coordinator is not None
+                    and self.pending_resize is None
+                    and detail["state"] == RunState.LOST_WORKER):
+                gone = sorted(set(detail["lost"]) | set(detail["missing"]))
+                current = self.coordinator.dp or self.coordinator.max_dp
+                target = self.coordinator.clamp(current - len(gone))
+                if target != self.coordinator.dp:
+                    self.request_resize(
+                        target, reason="lost-worker:" + ",".join(
+                            str(w) for w in gone))
+
+    # -- elastic resize (ISSUE 9) ------------------------------------------
+    def request_resize(self, new_dp: int, reason: str = "scale-signal"
+                       ) -> None:
+        """Latch a resize for the driving loop to execute (same protocol
+        as ``pending_rollback``) — callable from a scale signal, a
+        callback, or the lost-worker poll above."""
+        if self.coordinator is None:
+            raise RuntimeError("request_resize needs an ElasticCoordinator "
+                               "(RunSupervisor(coordinator=...))")
+        self.pending_resize = {"dp": int(new_dp), "reason": str(reason)}
+        self.report.record("elastic.resize_requested", dp=int(new_dp),
+                           reason=reason, step=self.gstep)
+
+    def perform_resize(self, init_fn: Callable[[], Any],
+                       template_fn: Callable[[], Any]) -> Tuple[Any, int]:
+        """Execute the latched resize: quiesce → re-form the mesh →
+        re-shard the last committed state → rewind to last_good_step —
+        one checkpoint interval lost, not the run."""
+        req = self.pending_resize or {"dp": self.coordinator.dp,
+                                      "reason": "requested"}
+        self.pending_resize = None
+        state, start = self.coordinator.resize(
+            req["dp"], template_fn, init_fn, reason=req["reason"])
+        self.consecutive_step_failures = 0
+        self.guard.reset_after_rollback()
+        vlog(0, "supervisor: elastic resize rewound step counter %d → %d",
+             self.gstep, start)
+        self.gstep = start
+        return state, start
 
     def perform_rollback(self, init_fn: Callable[[], Any],
                          template_fn: Callable[[], Any],

@@ -76,6 +76,9 @@ class HeartbeatWriter:
         self.beats = 0
         self._last_step: Optional[int] = None
         self._last_beat = 0.0
+        #: world generation stamped into every beat when set (elastic
+        #: fleets: lets any reader spot a zombie from an older world)
+        self.generation: Optional[int] = None
 
     @property
     def path(self) -> str:
@@ -87,6 +90,8 @@ class HeartbeatWriter:
         payload = {"worker": self.worker_id, "pid": os.getpid(),
                    "time": float(self._clock()), "step": self._last_step,
                    "beats": self.beats}
+        if self.generation is not None:
+            payload["generation"] = int(self.generation)
         os.makedirs(heartbeat_dir(self.run_dir), exist_ok=True)
         try:
             fsio.atomic_write_bytes(
@@ -139,12 +144,15 @@ class HeartbeatMonitor:
 
     ``expected``: worker count the run was launched with (``None`` means
     "whoever has ever beaten") — a worker that never wrote a beat within
-    ``lost_after`` of monitor construction counts as lost.
+    ``lost_after`` of monitor construction counts as lost.  An elastic
+    fleet (ISSUE 9) passes a *set of member ids* instead and updates it
+    on every resize (``set_expected``): beats from retired workers'
+    stale files stop counting against the run's health.
     """
 
     def __init__(self, run_dir: str, stale_after: Optional[float] = None,
                  lost_after: Optional[float] = None,
-                 expected: Optional[int] = None, clock=time.time,
+                 expected=None, clock=time.time,
                  report=None):
         self.run_dir = run_dir
         base = default_interval()
@@ -175,12 +183,29 @@ class HeartbeatMonitor:
                 continue  # torn/garbled beat reads as "no beat" → stale
         return beats
 
+    def set_expected(self, expected) -> None:
+        """Adopt a new membership (count or id set) — the elastic
+        reconciler calls this on every resize."""
+        self.expected = expected
+
+    def _expected_ids(self):
+        if self.expected is None:
+            return None
+        if isinstance(self.expected, int):
+            return set(range(self.expected))
+        return {int(w) for w in self.expected}
+
     def poll(self) -> Dict[str, Any]:
         """One classification pass → ``{"state", "workers", "stale",
         "lost", "missing"}``; records a ``run_state`` event on every
         transition."""
         now = float(self._clock())
         beats = self._read_beats()
+        expected_ids = self._expected_ids()
+        if expected_ids is not None:
+            # a retired member's beat file outlives it; only current
+            # members can make the run stale/lost
+            beats = {w: p for w, p in beats.items() if w in expected_ids}
         stale, lost = [], []
         for wid, payload in beats.items():
             age = now - float(payload.get("time", 0.0))
@@ -189,8 +214,8 @@ class HeartbeatMonitor:
             elif age > self.stale_after:
                 stale.append(wid)
         missing = []
-        if self.expected is not None:
-            unseen = set(range(self.expected)) - set(beats)
+        if expected_ids is not None:
+            unseen = expected_ids - set(beats)
             # an expected worker that has NEVER beaten is only lost once
             # the monitor has waited long enough for a first beat
             if now - self._born > self.lost_after:

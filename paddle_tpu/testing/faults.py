@@ -40,7 +40,7 @@ from ..utils.retry import RetryPolicy
 
 __all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
            "corrupt_manifest", "fast_retries", "hang", "slow_call",
-           "diverge_after"]
+           "diverge_after", "sigkill_self", "sigkill_at"]
 
 
 def _default_transient() -> OSError:
@@ -229,6 +229,49 @@ class diverge_after:
         if self.mode == "inf":
             return float("inf")
         return (abs(loss) + 1.0) * self.factor ** self.triggered
+
+
+def sigkill_self() -> None:
+    """SIGKILL this process — the unmaskable preemption.  Unlike the
+    SIGTERM the fault injector delivers, there is no grace window and no
+    final checkpoint flush: the elastic fleet drill (ISSUE 9) uses this
+    to prove that losing a worker *between* checkpoints costs one
+    interval, not the run."""
+    os.kill(os.getpid(), _signal.SIGKILL)
+
+
+class sigkill_at:
+    """Step-triggered SIGKILL for elastic fault drills: call per step
+    (``fault(step)``); fires :func:`sigkill_self` once when ``step >=
+    trigger`` AND ``generation == gen`` (``None`` = any generation) —
+    gating on the first generation keeps a respawned worker from killing
+    itself again.
+
+    Env-driven form for worker scripts:
+    ``sigkill_at.from_env(rank, generation)`` reads
+    ``PTPU_TEST_SIGKILL_STEP`` / ``PTPU_TEST_SIGKILL_RANK`` and returns
+    a no-op when this worker is not the target."""
+
+    def __init__(self, step: int, generation: Optional[int] = 0):
+        self.step = int(step)
+        self.generation = generation
+
+    def __call__(self, step: int, generation: Optional[int] = None
+                 ) -> None:
+        if step < self.step:
+            return
+        if (self.generation is not None and generation is not None
+                and int(generation) != self.generation):
+            return
+        sigkill_self()
+
+    @staticmethod
+    def from_env(rank: int) -> Callable[..., None]:
+        target_step = os.environ.get("PTPU_TEST_SIGKILL_STEP")
+        target_rank = int(os.environ.get("PTPU_TEST_SIGKILL_RANK", "-1"))
+        if target_step is None or int(rank) != target_rank:
+            return lambda *_a, **_k: None
+        return sigkill_at(int(target_step))
 
 
 @contextlib.contextmanager

@@ -1,0 +1,651 @@
+"""Elastic fleet proof (ISSUE 9): resize a live run instead of rolling
+it back.
+
+- world descriptor + generation fencing: a worker the fleet retired
+  cannot commit a checkpoint (StaleGeneration), a still-member worker
+  can;
+- cross-width checkpoint relayout: model + ZeRO-1 flat master saved on
+  an 8-way dp mesh restore onto 4- and 2-way meshes with the gathered
+  values preserved BITWISE (only zero padding moves);
+- the coordinator's full resize arc: quiesce → fence → remesh →
+  reshard → rewind to last_good_step → reseed, with elastic.resize /
+  elastic.ef_reset events and a loss trajectory matching a fixed-width
+  run after the rewind point;
+- supervisor/hapi wiring: a scale signal mid-`fit` resizes and the run
+  completes;
+- launcher reconciliation (`launch --elastic min:max`): SIGKILL a
+  worker mid-run → the run completes at reduced width, resumes from
+  last_good_step (one interval lost), re-expands when the worker
+  returns — both transitions recorded (subprocess drills marked slow;
+  ci.sh runs them in the elastic tier).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import elastic as el
+from paddle_tpu.distributed.comm import ShardedOptimizer, repack_flat
+from paddle_tpu.distributed.topology import get_mesh
+from paddle_tpu.supervisor import RunSupervisor
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    # the coordinator installs a process-global hybrid mesh; reset BEFORE
+    # each test too — earlier files in a full run may leave one installed
+    dist.set_hybrid_communicate_group(None)
+    yield
+    dist.set_hybrid_communicate_group(None)
+
+
+def _events(sink_list):
+    return [k for k, _ in sink_list]
+
+
+# -- world descriptor ------------------------------------------------------
+class TestWorldDescriptor:
+    def test_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        desc = el.write_world(d, generation=3, members=[2, 0, 1],
+                              min_size=1, max_size=4, reason="test")
+        got = el.read_world(d)
+        assert got == desc
+        assert got["members"] == [0, 1, 2]  # sorted
+        assert got["world_size"] == 3
+
+    def test_absent_reads_none(self, tmp_path):
+        assert el.read_world(str(tmp_path / "nope")) is None
+
+
+# -- generation fencing ----------------------------------------------------
+class TestGenerationFencing:
+    def _state(self):
+        return {"w": jnp.arange(8.0)}
+
+    def test_stale_worker_cannot_commit(self, tmp_path):
+        run = str(tmp_path)
+        el.write_world(run, generation=0, members=[0, 1])
+        events = []
+        mgr = el.ElasticTrainState(os.path.join(run, "ck"),
+                                   install_sigterm_handler=False,
+                                   event_sink=lambda k, **f:
+                                   events.append((k, f)))
+        mgr.bind_world(run)
+        mgr.save(5, self._state(), use_async=False)   # current gen: fine
+        assert mgr.last_good_step() == 5
+        # the fleet moves on without this worker
+        el.write_world(run, generation=1, members=[1],
+                       reason="lost-worker:0")
+        with pytest.raises(el.StaleGeneration):
+            mgr.save(7, self._state(), use_async=False)
+        assert mgr.last_good_step() == 5       # nothing new committed
+        assert "elastic.fence_rejected" in _events(events)
+        # and no step-7 debris is eligible for restore
+        assert all("step-7" not in os.path.basename(p)
+                   for p in el.committed_checkpoints(mgr.directory))
+
+    def test_async_commit_fence_surfaces_on_wait(self, tmp_path):
+        run = str(tmp_path)
+        el.write_world(run, generation=0, members=[0])
+        mgr = el.ElasticTrainState(os.path.join(run, "ck"),
+                                   install_sigterm_handler=False)
+        mgr.bind_world(run)
+        el.write_world(run, generation=2, members=[], reason="retired")
+        mgr.save(3, self._state(), use_async=True)
+        with pytest.raises(el.StaleGeneration):
+            mgr.wait()
+        assert mgr.last_good_step() == -1
+
+    def test_member_of_newer_world_may_commit(self, tmp_path):
+        # a still-member that hasn't polled the bump yet is NOT a zombie
+        run = str(tmp_path)
+        el.write_world(run, generation=0, members=[0, 1])
+        mgr = el.ElasticTrainState(os.path.join(run, "ck"),
+                                   install_sigterm_handler=False)
+        mgr.bind_world(run, worker_id=0)
+        el.write_world(run, generation=1, members=[0],
+                       reason="lost-worker:1")
+        mgr.save(4, self._state(), use_async=False)    # allowed
+        assert mgr.last_good_step() == 4
+        # ... until the fleet retires it too
+        el.write_world(run, generation=2, members=[1], reason="swap")
+        with pytest.raises(el.StaleGeneration):
+            mgr.save(6, self._state(), use_async=False)
+
+
+# -- corrupt-quarantine GC bound -------------------------------------------
+class TestCorruptGcBound:
+    def test_keeps_newest_k_quarantines(self, tmp_path):
+        d = str(tmp_path / "ck")
+        os.makedirs(d)
+        for step in (1, 2, 3, 4, 5):
+            os.makedirs(os.path.join(d, f"step-{step}.corrupt"))
+        mgr = el.ElasticTrainState(d, keep=2, corrupt_keep=2,
+                                   install_sigterm_handler=False)
+        mgr.save(10, {"w": jnp.ones(4)}, use_async=False)  # triggers gc
+        left = sorted(n for n in os.listdir(d) if n.endswith(".corrupt"))
+        assert left == ["step-4.corrupt", "step-5.corrupt"]
+
+    def test_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PTPU_CORRUPT_KEEP", "1")
+        mgr = el.ElasticTrainState(str(tmp_path),
+                                   install_sigterm_handler=False)
+        assert mgr.corrupt_keep == 1
+
+
+# -- flat repack -----------------------------------------------------------
+class TestRepackFlat:
+    def test_shrink_drops_only_zero_padding(self):
+        saved = np.zeros(16, np.float32)
+        saved[:10] = np.arange(10) + 1
+        out = repack_flat(saved, 12)
+        assert out.shape == (12,)
+        np.testing.assert_array_equal(out[:10], saved[:10])
+
+    def test_grow_pads_zeros(self):
+        out = repack_flat(np.arange(6, dtype=np.float32), 8)
+        np.testing.assert_array_equal(out, [0, 1, 2, 3, 4, 5, 0, 0])
+
+    def test_refuses_to_drop_real_elements(self):
+        with pytest.raises(Exception, match="nonzero"):
+            repack_flat(np.arange(8, dtype=np.float32) + 1, 6)
+
+    def test_bitwise_roundtrip(self):
+        rng = np.random.RandomState(0)
+        base = np.zeros(720, np.float32)
+        base[:714] = rng.randn(714).astype(np.float32)
+        down = repack_flat(base, 716)
+        up = repack_flat(down, 720)
+        np.testing.assert_array_equal(up, base)
+
+
+# -- cross-width ZeRO-1 relayout (the acceptance drill) --------------------
+def _grad_like(params, seed):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.RandomState(seed)
+    return jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(rng.randn(*np.shape(p)).astype(np.float32))
+                  for p in leaves])
+
+
+class TestZero1CrossWidth:
+    """Save model + ZeRO-1 flat master on dp=8; restore onto dp=4 and
+    dp=2: gathered params and the real (unpadded) master elements must
+    be BITWISE equal; continued training stays on the fp32 trajectory."""
+
+    TOTAL = 37 * 19 + 11     # 714: padded differs per width (720/716/714)
+
+    def _params(self):
+        rng = np.random.RandomState(0)
+        return {"w": jnp.asarray(rng.randn(37, 19), jnp.float32),
+                "b": jnp.asarray(rng.randn(11), jnp.float32)}
+
+    def _train(self, opt, params, state, steps, seed0=100):
+        step_fn = jax.jit(opt.apply_gradients)
+        for i in range(steps):
+            params, state = step_fn(_grad_like(params, seed0 + i),
+                                    params, state)
+        return params, state
+
+    @pytest.mark.parametrize("new_dp", [4, 2])
+    def test_restore_reduced_width_bitwise(self, tmp_path, new_dp):
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(mgr, mp=1, pp=1)
+        coord.form_mesh(8)
+        params = self._params()
+        opt8 = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                axis="dp")
+        state = opt8.init(params)
+        assert np.asarray(state["flat"]).shape == (720,)
+        params, state = self._train(opt8, params, state, 3)
+        saved_params = jax.tree_util.tree_map(np.asarray, params)
+        saved_flat = np.asarray(state["flat"])
+        mgr.save(3, {"params": params, "opt": state}, use_async=False)
+
+        def template_fn():
+            opt_new = ShardedOptimizer(pt.optimizer.Adam(
+                learning_rate=1e-3), axis="dp").bind_mesh(get_mesh())
+            return {"params": self._params(),
+                    "opt": opt_new.init(self._params())}
+
+        restored, start = coord.resize(new_dp, template_fn,
+                                       reason="lost-worker")
+        assert start == 4
+        padded_new = -(-self.TOTAL // new_dp) * new_dp
+        flat_new = np.asarray(restored["opt"]["flat"])
+        assert flat_new.shape == (padded_new,)
+        # bitwise: the gathered params and every real master element
+        for name in ("w", "b"):
+            np.testing.assert_array_equal(
+                np.asarray(restored["params"][name]), saved_params[name])
+        np.testing.assert_array_equal(flat_new[:self.TOTAL],
+                                      saved_flat[:self.TOTAL])
+        for slot in jax.tree_util.tree_leaves(restored["opt"]["slots"]):
+            assert np.asarray(slot).shape == (padded_new,)
+        assert int(restored["opt"]["step"]) == 3
+
+    def test_continued_training_parity(self, tmp_path):
+        """The continued-training drill: restore at dp=4 and keep
+        stepping — trajectory matches staying at dp=8."""
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(mgr, mp=1, pp=1)
+        coord.form_mesh(8)
+        params = self._params()
+        opt8 = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                axis="dp")
+        state = opt8.init(params)
+        params, state = self._train(opt8, params, state, 3)
+        mgr.save(3, {"params": params, "opt": state}, use_async=False)
+        # baseline: stay at width 8 for 2 more steps
+        base_params, _ = self._train(opt8, params, state, 2, seed0=200)
+
+        def template_fn():
+            opt_new = ShardedOptimizer(pt.optimizer.Adam(
+                learning_rate=1e-3), axis="dp").bind_mesh(get_mesh())
+            return {"params": self._params(),
+                    "opt": opt_new.init(self._params())}
+
+        restored, _start = coord.resize(4, template_fn,
+                                        reason="lost-worker")
+        opt4 = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                axis="dp").bind_mesh(get_mesh())
+        got_params, _ = self._train(opt4, restored["params"],
+                                    restored["opt"], 2, seed0=200)
+        for name in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(got_params[name]),
+                                       np.asarray(base_params[name]),
+                                       rtol=0, atol=1e-6)
+
+    def test_relayout_state_direct(self):
+        """Unit form of the repack: relayout_state re-packs a host ZeRO
+        state onto the currently-bound shard count."""
+        coordless_mesh = None
+        dist.set_hybrid_communicate_group(coordless_mesh)
+        params = self._params()
+        opt8 = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                axis="dp", num_shards=8)
+        state = opt8.init(params)
+        host = {"step": np.asarray(state["step"]),
+                "flat": np.asarray(state["flat"]),
+                "slots": jax.tree_util.tree_map(np.asarray,
+                                                state["slots"])}
+        opt4 = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                axis="dp", num_shards=4)
+        out = opt4.relayout_state(host, params)
+        assert np.asarray(out["flat"]).shape == (716,)
+        np.testing.assert_array_equal(np.asarray(out["flat"])[:714],
+                                      host["flat"][:714])
+
+
+# -- coordinator resize arc ------------------------------------------------
+class TestCoordinatorResize:
+    def test_ef_residuals_reset_on_width_change(self, tmp_path):
+        events = []
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(
+            mgr, mp=1, pp=1,
+            event_sink=lambda k, **f: events.append((k, f)))
+        mesh8 = coord.form_mesh(8)
+        resid = jax.device_put(
+            np.random.RandomState(0).randn(8 * 4, 3).astype(np.float32),
+            NamedSharding(mesh8, P("dp", None)))
+        w = jax.device_put(np.arange(32.0, dtype=np.float32).reshape(8, 4),
+                           NamedSharding(mesh8, P("dp", None)))
+        mgr.save(7, {"w": w, "resid": resid}, use_async=False)
+
+        def template_fn():
+            m = get_mesh()
+            sds = jax.ShapeDtypeStruct
+            return {"w": sds((8, 4), jnp.float32,
+                             sharding=NamedSharding(m, P("dp", None))),
+                    "resid": sds((4 * 4, 3), jnp.float32,
+                                 sharding=NamedSharding(m, P("dp", None)))}
+
+        state, start = coord.resize(4, template_fn, reason="lost-worker:5")
+        assert start == 8
+        assert not np.asarray(state["resid"]).any()      # dropped
+        np.testing.assert_array_equal(np.asarray(state["w"]),
+                                      np.arange(32.0).reshape(8, 4))
+        kinds = _events(events)
+        assert "elastic.ef_reset" in kinds
+        assert "elastic.resize" in kinds
+        (resize,) = [f for k, f in events if k == "elastic.resize"]
+        assert resize["old_dp"] == 8 and resize["new_dp"] == 4
+        assert resize["generation"] == 1
+        assert coord.resizes == 1 and coord.dp == 4
+
+    def test_same_width_keeps_ef(self, tmp_path):
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(mgr, mp=1, pp=1)
+        mesh8 = coord.form_mesh(8)
+        resid = jax.device_put(
+            np.random.RandomState(0).randn(8 * 2, 3).astype(np.float32),
+            NamedSharding(mesh8, P("dp", None)))
+        mgr.save(2, {"resid": resid}, use_async=False)
+
+        def template_fn():
+            m = get_mesh()
+            return {"resid": jax.ShapeDtypeStruct(
+                (8 * 2, 3), jnp.float32,
+                sharding=NamedSharding(m, P("dp", None)))}
+
+        state, _ = coord.resize(8, template_fn, reason="restart")
+        np.testing.assert_array_equal(np.asarray(state["resid"]),
+                                      np.asarray(resid))
+
+    def test_reseed_hook_and_bounds(self, tmp_path):
+        calls = []
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(
+            mgr, mp=1, pp=1, min_dp=2, max_dp=8,
+            reseed=lambda start, dp: calls.append((start, dp)))
+        coord.form_mesh(8)
+        mgr.save(5, {"w": jnp.ones(4)}, use_async=False)
+        _state, start = coord.resize(1, lambda: {"w": jnp.zeros(4)},
+                                     reason="over-shrink")
+        assert coord.dp == 2              # clamped to min_dp
+        assert calls == [(start, 2)]
+
+    def test_loss_trajectory_matches_fixed_width_after_rewind(
+            self, tmp_path):
+        """The in-process fault drill: train on dp=8, lose workers at
+        step 13, resize to 4, re-expand to 8 — every recomputed loss
+        matches the uninterrupted fixed-width run."""
+        def make_batch(step):
+            rng = np.random.RandomState(500 + step)
+            x = rng.randn(16, 8).astype(np.float32)
+            y = (x @ np.linspace(-1, 1, 8).astype(np.float32)
+                 + 0.01 * rng.randn(16).astype(np.float32))
+            return jnp.asarray(x), jnp.asarray(y)
+
+        @jax.jit
+        def step_fn(w, x, y):
+            def loss_fn(w):
+                return jnp.mean((x @ w - y) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            return w - 0.1 * g, loss
+
+        def run_fixed(total):
+            w = jnp.zeros((8,), jnp.float32)
+            losses = {}
+            for s in range(total):
+                x, y = make_batch(s)
+                w, loss = step_fn(w, x, y)
+                losses[s] = float(loss)
+            return losses
+
+        baseline = run_fixed(20)
+
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   save_interval_steps=5,
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(mgr, mp=1, pp=1)
+        coord.form_mesh(8)
+
+        def template_fn():
+            m = get_mesh()
+            return {"w": jax.ShapeDtypeStruct(
+                (8,), jnp.float32, sharding=NamedSharding(m, P()))}
+
+        losses = {}
+        w = jnp.zeros((8,), jnp.float32)
+        step = 0
+        resize_plan = {13: (4, "lost-worker:4-7"),
+                       16: (8, "workers-returned")}
+        while step < 20:
+            if step in resize_plan:
+                dp, reason = resize_plan.pop(step)
+                state, start = coord.resize(dp, template_fn,
+                                            reason=reason)
+                w = state["w"]
+                if reason.startswith("lost"):
+                    # we were at 13, the newest commit was at 10 — one
+                    # checkpoint interval lost, not the run
+                    assert start == 11
+                step = start
+                continue
+            x, y = make_batch(step)
+            w, loss = step_fn(w, x, y)
+            losses[step] = float(loss)
+            mgr.maybe_save(step, {"w": w})
+            step += 1
+        mgr.wait()
+        assert coord.generation == 2 and coord.resizes == 2
+        for s in range(20):
+            np.testing.assert_allclose(losses[s], baseline[s],
+                                       rtol=0, atol=1e-6)
+
+
+# -- heartbeat membership --------------------------------------------------
+class TestHeartbeatMembership:
+    def test_retired_workers_stale_beat_is_ignored(self, tmp_path):
+        from paddle_tpu.supervisor.heartbeat import (HeartbeatMonitor,
+                                                     HeartbeatWriter,
+                                                     RunState)
+        clock = [1000.0]
+        run = str(tmp_path)
+        for wid in (0, 1):
+            HeartbeatWriter(run, worker_id=wid,
+                            clock=lambda: clock[0]).beat()
+        mon = HeartbeatMonitor(run, stale_after=5, lost_after=10,
+                               expected={0, 1}, clock=lambda: clock[0])
+        assert mon.poll()["state"] == RunState.HEALTHY
+        clock[0] += 60.0                      # both beats go stale
+        HeartbeatWriter(run, worker_id=0,
+                        clock=lambda: clock[0]).beat()   # 0 still alive
+        assert mon.poll()["state"] == RunState.LOST_WORKER
+        mon.set_expected({0})                 # the fleet retired 1
+        detail = mon.poll()
+        assert detail["state"] == RunState.HEALTHY
+        assert detail["workers"] == [0]
+
+    def test_generation_stamped_beats(self, tmp_path):
+        from paddle_tpu.supervisor.heartbeat import HeartbeatWriter
+        hb = HeartbeatWriter(str(tmp_path), worker_id=3)
+        hb.generation = 7
+        hb.beat(step=11)
+        payload = json.loads(open(hb.path).read())
+        assert payload["generation"] == 7 and payload["step"] == 11
+
+
+# -- supervisor / hapi wiring ----------------------------------------------
+class TestSupervisedElasticFit:
+    def test_scale_signal_mid_fit_resizes_and_completes(self, tmp_path):
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import Callback
+        from paddle_tpu.io import TensorDataset
+
+        pt.seed(0)
+        model = Model(nn.Linear(4, 2))
+        model.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+                      loss=lambda out, y: jnp.mean((out - y) ** 2))
+        rng = np.random.RandomState(0)
+        ds = TensorDataset([rng.randn(24, 4).astype(np.float32),
+                            rng.randn(24, 2).astype(np.float32)])
+        run = str(tmp_path / "run")
+        mgr = el.ElasticTrainState(os.path.join(run, "checkpoints"),
+                                   save_interval_steps=4,
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(mgr, mp=1, pp=1)
+        coord.form_mesh(8)
+        sup = RunSupervisor(run, elastic=mgr, coordinator=coord,
+                            watchdog_secs=60.0, heartbeat_secs=60.0,
+                            sigterm_handler=False)
+
+        class ScaleSignal(Callback):
+            fired = False
+
+            def on_train_batch_end(self, step, logs=None):
+                if step == 12 and not ScaleSignal.fired:
+                    ScaleSignal.fired = True
+                    sup.request_resize(4, reason="preemption-notice")
+
+        history = model.fit(ds, batch_size=1, epochs=1, verbose=0,
+                            supervisor=sup, callbacks=[ScaleSignal()])
+        assert np.isfinite(history["loss"][-1])
+        assert coord.resizes == 1 and coord.dp == 4
+        counts = sup.report.counts()
+        assert counts["elastic.resize_requested"] == 1
+        assert counts["elastic.resize"] == 1
+        assert counts.get("rollback") is None     # resize, NOT rollback
+        (resize,) = sup.report.of_kind("elastic.resize")
+        # rewound to the newest commit: one interval lost, run completed
+        assert resize["start_step"] <= 13
+
+    def test_statusz_elastic_section(self, tmp_path):
+        from paddle_tpu.observability.monitor import StatusServer
+        run = str(tmp_path / "run")
+        mgr = el.ElasticTrainState(os.path.join(run, "checkpoints"),
+                                   install_sigterm_handler=False)
+        coord = el.ElasticCoordinator(mgr, mp=1, pp=1)
+        coord.form_mesh(8)
+        mgr.save(3, {"w": jnp.ones(4)}, use_async=False)
+        coord.resize(4, lambda: {"w": jnp.zeros(4)}, reason="drill")
+        sup = RunSupervisor(run, elastic=mgr, coordinator=coord,
+                            sigterm_handler=False)
+        page = StatusServer(supervisor=sup).statusz()
+        ela = page["elastic"]
+        assert ela["dp"] == 4 and ela["generation"] == 1
+        assert ela["resizes"] == 1
+        assert ela["last_resize"]["reason"] == "drill"
+        assert ela["min_dp"] == 1 and ela["max_dp"] == 8
+
+
+# -- launcher reconciliation (subprocess drills) ---------------------------
+def _launch_elastic(run_dir, extra_env, script_args, nnodes=2,
+                    elastic="1:2", timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", str(nnodes), "--elastic", elastic,
+         "--run_dir", run_dir,
+         os.path.join(REPO, "examples", "train_elastic.py"), "--",
+         ] + script_args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+
+
+class TestParseElastic:
+    def test_parse(self):
+        from paddle_tpu.distributed.launch import _parse_elastic
+        assert _parse_elastic("1:4", 2) == (1, 4)
+        assert _parse_elastic("2", 2) == (2, 2)
+        with pytest.raises(SystemExit):
+            _parse_elastic("3:4", 2)      # nnodes below MIN
+        with pytest.raises(SystemExit):
+            _parse_elastic("1:2", 4)      # nnodes above MAX
+
+
+@pytest.mark.slow
+class TestLauncherSigkillDrill:
+    def test_sigkill_worker_midrun_shrinks_then_reexpands(self, tmp_path):
+        """THE acceptance drill: SIGKILL worker 1 at its step 10 → the
+        run completes at reduced width from last_good_step (≤ one
+        save-interval lost), re-expands when the launcher respawns the
+        worker, and both transitions land in launcher_report.json."""
+        run = str(tmp_path / "run")
+        save_interval = 8
+        r = _launch_elastic(
+            run,
+            {"PTPU_HEARTBEAT_SECS": "0.5",
+             "PTPU_ELASTIC_RESPAWN_SECS": "1.5",
+             "PTPU_TEST_SIGKILL_STEP": "10",
+             "PTPU_TEST_SIGKILL_RANK": "1"},
+            ["--steps", "30", "--save-interval", str(save_interval),
+             "--step-time", "0.08"])
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+        report = json.loads(
+            open(os.path.join(run, "launcher_report.json")).read())
+        kinds = [e["kind"] for e in report["events"]]
+        assert kinds.count("elastic.resize") >= 2
+        resizes = [e for e in report["events"]
+                   if e["kind"] == "elastic.resize"]
+        shrink = next(e for e in resizes if e["direction"] == "shrink")
+        grow = next(e for e in resizes if e["direction"] == "grow")
+        assert shrink["changed"] == [1] and shrink["world_size"] == 1
+        assert grow["changed"] == [1] and grow["world_size"] == 2
+        assert grow["generation"] > shrink["generation"] >= 1
+        (lost,) = [e for e in report["events"]
+                   if e["kind"] == "elastic.worker_lost"]
+        assert lost["rank"] == 1 and lost["returncode"] == -9
+        (done,) = [e for e in report["events"]
+                   if e["kind"] == "elastic.done"]
+        assert done["returncode"] == 0 and done["respawns"] == {"1": 1}
+
+        world = el.read_world(run)
+        assert world["generation"] >= 2 and world["members"] == [0, 1]
+
+        # the surviving chief rewound to last_good_step: at most one
+        # checkpoint interval recomputed
+        r0 = json.loads(
+            open(os.path.join(run, "result-worker-0.json")).read())
+        assert r0["rewinds"] >= 1
+        w0 = json.loads(open(os.path.join(
+            run, "reports", "worker-0.json")).read())
+        rewinds = [e for e in w0["events"]
+                   if e["kind"] == "elastic.rewind"]
+        assert rewinds
+        for e in rewinds:
+            assert e["to_step"] <= e["from_step"]
+            assert e["from_step"] - e["to_step"] <= save_interval + 1
+
+        # loss-trajectory parity with a fixed-width run: recompute the
+        # deterministic reference and compare every recorded loss
+        sys.path.insert(0, os.path.join(REPO, "examples"))
+        try:
+            import train_elastic as te
+        finally:
+            sys.path.pop(0)
+        w = jnp.zeros((te.DIM,), jnp.float32)
+        for s in range(30):
+            x, y = te.make_batch(s)
+            w, loss = te.train_step(w, x, y, 0.1)
+            if str(s) in r0["losses"]:
+                np.testing.assert_allclose(r0["losses"][str(s)],
+                                           float(loss), rtol=0, atol=1e-5)
+        assert len(r0["losses"]) == 30
+
+    def test_below_min_fails_loudly(self, tmp_path):
+        run = str(tmp_path / "run")
+        r = _launch_elastic(
+            run,
+            {"PTPU_HEARTBEAT_SECS": "0.5",
+             "PTPU_ELASTIC_MAX_RESPAWNS": "0",
+             "PTPU_TEST_SIGKILL_STEP": "5",
+             "PTPU_TEST_SIGKILL_RANK": "0"},
+            ["--steps", "25", "--save-interval", "6",
+             "--step-time", "0.08"],
+            elastic="2:2")
+        assert r.returncode == 1
+        report = json.loads(
+            open(os.path.join(run, "launcher_report.json")).read())
+        kinds = [e["kind"] for e in report["events"]]
+        assert "elastic.failed" in kinds
+        (failed,) = [e for e in report["events"]
+                     if e["kind"] == "elastic.failed"]
+        assert failed["reason"] == "below-min"

@@ -250,14 +250,21 @@ class TestRestoreFallback:
     def test_gc_sweeps_stale_debris(self, tmp_path):
         d = str(tmp_path / "ck")
         os.makedirs(os.path.join(d, "step-1.tmp"))
+        # quarantines are bounded to the newest PTPU_CORRUPT_KEEP
+        # (default 2) regardless of age (ISSUE 9) — the newest stay as
+        # forensic evidence, older ones are swept
+        os.makedirs(os.path.join(d, "step-0.corrupt"))
         os.makedirs(os.path.join(d, "step-2.corrupt"))
+        os.makedirs(os.path.join(d, "step-4.corrupt"))
         os.makedirs(os.path.join(d, "step-3"))       # uncommitted crash
         os.makedirs(os.path.join(d, "step-9.tmp"))   # in-flight, newer
         mgr = _mgr(d, keep=2)
         mgr.save(5, _state(5), use_async=False)      # commit triggers gc
         names = set(os.listdir(d))
         assert "step-1.tmp" not in names
-        assert "step-2.corrupt" not in names
+        assert "step-0.corrupt" not in names         # beyond the bound
+        assert "step-2.corrupt" in names             # newest 2 kept
+        assert "step-4.corrupt" in names
         assert "step-3" not in names
         assert "step-9.tmp" in names                 # never touch newer
         assert "step-5" in names

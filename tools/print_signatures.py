@@ -38,6 +38,7 @@ _MODULES = [
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.distributed.comm",
+    "paddle_tpu.distributed.elastic",
     "paddle_tpu.distributed.auto_parallel",
     "paddle_tpu.vision.models",
     "paddle_tpu.vision.ops",
