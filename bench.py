@@ -613,6 +613,64 @@ _SMOKE_COMM_AB = dict(B=8, S=128, steps=30, warmup=2,
                       cfg_factory=_smoke_comm_cfg)
 
 
+_SMOKE_INTEGRITY_AB = dict(B=4, S=256, steps=6, warmup=2,
+                           cfg_factory=_smoke_block_cfg)
+
+
+def _bench_integrity_overhead(B=4, S=256, steps=6, warmup=2,
+                              cfg_factory=None, interval=None,
+                              artifact=True):
+    """Integrity-guard overhead A/B (ISSUE 11 acceptance): the per-check
+    cost of the tree fingerprint (jitted digest + board publish +
+    compare), amortized over the default ``PTPU_INTEGRITY_EVERY``
+    interval, against the same smoke step the fused-block A/B times.
+    The digest runs OUTSIDE the jitted train step (``note_step_ok``), so
+    the honest measure is per-check wall time over ``interval *
+    step_time``, not a fused-leg timing diff.  Artifact:
+    benchmarks/integrity_overhead.json."""
+    from paddle_tpu.distributed.fingerprint import TreeFingerprint
+    from paddle_tpu.supervisor.integrity import IntegrityGuard, \
+        default_interval
+    import tempfile
+
+    cfg_factory = cfg_factory or _smoke_block_cfg
+    interval = default_interval() if interval is None else int(interval)
+    rows = _ab_train_legs([("base", cfg_factory())], B, S, steps, warmup)
+    _jitted, _model, params, opt_state, _ids, _labels = _build(
+        cfg_factory(), B, S)
+    state = {"params": dict(params), "opt": opt_state}
+    fp = TreeFingerprint()
+    fp.digest(state).tree                     # compile, out of the timing
+    reps = max(3, steps)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fpr = fp.digest(state)
+        _ = fpr.tree                          # the one scalar readback
+    digest_ms = (time.perf_counter() - t0) / reps * 1e3
+    with tempfile.TemporaryDirectory() as run_dir:
+        guard = IntegrityGuard(run_dir, every=interval, expected=1)
+        t0 = time.perf_counter()
+        for i in range(reps):
+            guard.publish((i + 1) * interval, fpr)
+            guard.compare((i + 1) * interval)
+        board_ms = (time.perf_counter() - t0) / reps * 1e3
+    check_ms = digest_ms + board_ms
+    overhead = check_ms / (interval * rows["base"]["step_ms"])
+    rows["integrity"] = {"digest_ms": digest_ms, "board_ms": board_ms,
+                         "check_ms": check_ms, "interval": interval,
+                         "overhead_frac": overhead}
+    print(f"[integrity-overhead] digest={digest_ms:.2f}ms "
+          f"board={board_ms:.2f}ms step={rows['base']['step_ms']:.1f}ms "
+          f"every={interval} → {overhead:.3%} of step time",
+          file=sys.stderr, flush=True)
+    _emit_diag("integrity_overhead", digest_ms=digest_ms,
+               board_ms=board_ms, interval=interval,
+               step_ms=rows["base"]["step_ms"], overhead_frac=overhead)
+    if artifact:
+        _write_artifact("integrity_overhead.json", rows)
+    return rows
+
+
 def _fused_ce_op_memory(B=2, S=512, H=256, V=50304, chunk=128):
     """Op-level rendering of the fused-CE memory claim: loss+grad of
     linear_softmax_cross_entropy at a chunk < S (the scan engages) vs the

@@ -34,7 +34,10 @@ from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         param_sharding, variables_sharding)
 from .checkpoint import (save_sharded, load_sharded,  # noqa: F401
                          verify_sharded, AsyncSaveHandle,
-                         CheckpointCorruption)
+                         CheckpointCorruption, DigestMismatch,
+                         read_integrity)
+from .fingerprint import (TreeFingerprint, Fingerprint,  # noqa: F401
+                          digest_tree_host, tree_digest)
 from .moe import (MoELayer, ExpertFFN, global_scatter,  # noqa: F401
                   global_gather, limit_by_capacity, switch_gating,
                   gshard_gating, collect_aux_losses)
@@ -57,7 +60,9 @@ __all__ = [
     "send_recv_permute", "split", "ColumnParallelLinear", "RowParallelLinear",
     "VocabParallelEmbedding", "shard_constraint", "param_sharding",
     "variables_sharding", "save_sharded", "load_sharded", "verify_sharded",
-    "AsyncSaveHandle", "CheckpointCorruption",
+    "AsyncSaveHandle", "CheckpointCorruption", "DigestMismatch",
+    "read_integrity", "TreeFingerprint", "Fingerprint",
+    "digest_tree_host", "tree_digest",
     "MoELayer", "ExpertFFN", "global_scatter",
     "global_gather", "limit_by_capacity", "switch_gating", "gshard_gating",
     "collect_aux_losses", "parallel_cross_entropy", "parallel_log_softmax",

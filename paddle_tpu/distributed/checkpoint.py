@@ -53,7 +53,8 @@ from ..utils import fsio
 from ..utils.retry import RetryPolicy, retry_call
 
 __all__ = ["save_sharded", "load_sharded", "verify_sharded",
-           "AsyncSaveHandle", "CheckpointCorruption"]
+           "AsyncSaveHandle", "CheckpointCorruption", "DigestMismatch",
+           "read_integrity"]
 
 _MANIFEST = "manifest.json"          # single-host name (kept for reading)
 MANIFEST_VERSION = 2                 # v2 = per-shard crc32 + byte sizes
@@ -67,6 +68,25 @@ class CheckpointCorruption(OSError):
     """A checkpoint failed integrity verification (missing shard file,
     size mismatch, or CRC32 mismatch).  Deliberately NOT retryable: the
     bytes on disk are wrong and will stay wrong."""
+
+
+class DigestMismatch(CheckpointCorruption):
+    """The restored tree's fingerprint differs from the digest stamped
+    into the manifest at save time (ISSUE 11).  CRC32 covers the bytes
+    each shard file held when it was written; the tree digest covers the
+    whole save→reshard→restore round trip of the LIVE state — a state
+    corrupted between hashing and serialization passes every CRC and
+    only this check catches it."""
+
+
+def _count(name: str) -> None:
+    """Best-effort observability counter (checkpoint layer must not
+    depend hard on the registry)."""
+    try:
+        from ..observability import get_registry
+        get_registry().counter(name).inc()
+    except Exception:
+        pass  # noqa: swallow
 
 
 def _manifest_name() -> str:
@@ -122,7 +142,8 @@ class AsyncSaveHandle:
         return not self._thread.is_alive()
 
 
-def save_sharded(state, path: str, *, use_async: bool = False
+def save_sharded(state, path: str, *, use_async: bool = False,
+                 integrity: Optional[Dict[str, Any]] = None
                  ) -> Optional[AsyncSaveHandle]:
     """Write ``state`` (pytree of jax/numpy arrays) as a sharded checkpoint.
 
@@ -135,6 +156,12 @@ def save_sharded(state, path: str, *, use_async: bool = False
     sees a manifest sees (and can verify) every byte it references.  The
     device→host copy happens synchronously before this returns even with
     ``use_async=True``; only serialization + file I/O runs on the thread.
+
+    ``integrity`` (ISSUE 11): a JSON-ready fingerprint stamp — typically
+    ``Fingerprint.meta()`` plus the ``exclude`` patterns it was computed
+    with — recorded verbatim in the manifest.  ``load_sharded`` re-hashes
+    the restored tree against it, closing the live-state gap CRC32
+    leaves open.
     """
     os.makedirs(path, exist_ok=True)
     leaves = _flatten(state)
@@ -142,6 +169,8 @@ def save_sharded(state, path: str, *, use_async: bool = False
     # picks up stale manifests from an earlier save with more processes
     manifest: Dict[str, Any] = {"version": MANIFEST_VERSION,
                                 "world": jax.process_count(), "leaves": {}}
+    if integrity is not None:
+        manifest["integrity"] = dict(integrity)
     work: List[Tuple[str, List[Dict[str, Any]]]] = []
     proc = jax.process_index()
 
@@ -210,8 +239,11 @@ def save_sharded(state, path: str, *, use_async: bool = False
     return AsyncSaveHandle(t, errors)
 
 
-def _read_manifests(path: str) -> Tuple[int, Dict[str, Any]]:
-    """Merge every process's manifest; returns (version, leaves)."""
+def _read_manifests(path: str) -> Tuple[int, Dict[str, Any],
+                                        Optional[Dict[str, Any]]]:
+    """Merge every process's manifest; returns (version, leaves,
+    integrity) — ``integrity`` is the head (p0) manifest's fingerprint
+    stamp, or None for checkpoints saved without one."""
     p0 = os.path.join(path, "manifest-p0.json")
     if not os.path.exists(p0) and os.path.exists(
             os.path.join(path, _MANIFEST)):
@@ -249,7 +281,13 @@ def _read_manifests(path: str) -> Tuple[int, Dict[str, Any]]:
                 leaves[lname]["shards"].extend(entry["shards"])
             else:
                 leaves[lname] = entry
-    return version, leaves
+    return version, leaves, head.get("integrity")
+
+
+def read_integrity(path: str) -> Optional[Dict[str, Any]]:
+    """The fingerprint stamp a checkpoint's head manifest carries (or
+    None) — ``{"algo", "tree", "exclude", "excluded", "leaves"}``."""
+    return _read_manifests(path)[2]
 
 
 def verify_sharded(path: str) -> List[str]:
@@ -259,7 +297,7 @@ def verify_sharded(path: str) -> List[str]:
     existence + byte-size + CRC32 checks; v1 manifests (no checksums) get
     existence checks only.
     """
-    version, leaves = _read_manifests(path)
+    version, leaves, _integrity = _read_manifests(path)
     problems: List[str] = []
     for name, entry in leaves.items():
         d = _leaf_dir(path, name)
@@ -320,8 +358,58 @@ def _read_window(leaf_dir: str, entry: Dict[str, Any], window) -> np.ndarray:
     return out
 
 
+def _verify_digest(path: str, restored, meta: Optional[Dict[str, Any]],
+                   strict: bool) -> None:
+    """Re-hash a restored tree against the manifest's fingerprint stamp
+    (ISSUE 11).  Raises :class:`DigestMismatch` (a corruption — the
+    restore fallback chain quarantines on it); ``strict=False`` demotes
+    to a warning.  Width-change restores verify too: the digest is
+    invariant under ZeRO-1 trailing-zero relayout and the stamp's
+    ``exclude`` patterns skip the rank-private leaves a resize resets.
+    """
+    if not meta:
+        return
+    from .fingerprint import DEFAULT_EXCLUDE, DIGEST_ALGO, \
+        digest_tree_host
+    if meta.get("algo") != DIGEST_ALGO:
+        warnings.warn(
+            f"checkpoint {path!r} stamped with unknown digest algo "
+            f"{meta.get('algo')!r}; fingerprint verification skipped",
+            RuntimeWarning, stacklevel=3)
+        return
+    if jax.process_count() > 1:
+        # per-host windows can't be rehashed against a global digest
+        # without a gather; multi-host re-verification is the integrity
+        # guard's cross-worker compare, not the loader's
+        return
+    got = digest_tree_host(
+        restored, tuple(meta.get("exclude", DEFAULT_EXCLUDE)))
+    want = str(meta.get("tree"))
+    if got.hex() == want:
+        _count("integrity.ckpt_verified")
+        vlog(1, "checkpoint: %s tree digest %s verified", path, want)
+        return
+    _count("integrity.ckpt_digest_mismatch")
+    stamped = meta.get("leaves") or {}
+    mine = got.leaf_digests()
+    bad = sorted(n for n, h in stamped.items()
+                 if n in mine and f"{mine[n]:08x}" != h)
+    msg = (f"checkpoint {path!r} restored tree digest {got.hex()} != "
+           f"stamped {want}"
+           + (f" (leaves differing: {bad[:5]}"
+              + (" …" if len(bad) > 5 else "") + ")" if bad else "")
+           + " — state corrupted between fingerprint and serialization,"
+           " or mangled by the restore/reshard path (shard CRCs cover"
+           " bytes on disk, not this)")
+    if strict:
+        raise DigestMismatch(msg)
+    warnings.warn(msg + " — loading anyway (strict=False)",
+                  RuntimeWarning, stacklevel=3)
+    vlog(0, "checkpoint: %s", msg)
+
+
 def load_sharded(path: str, template=None, *, strict: bool = True,
-                 mismatch=None):
+                 mismatch=None, verify_digest: bool = True):
     """Load a sharded checkpoint.
 
     ``template``: a pytree matching the saved structure whose leaves carry
@@ -345,8 +433,15 @@ def load_sharded(path: str, template=None, *, strict: bool = True,
     verification failures to warnings and loads whatever it can (forensics
     / partial-recovery mode).  v1 manifests skip the checksum pass with a
     warning — pre-checksum checkpoints stay loadable.
+
+    Fingerprint round-trip (ISSUE 11): when the manifest carries an
+    ``integrity`` stamp (``save_sharded(integrity=...)``), the RESTORED
+    tree is re-hashed and compared — :class:`DigestMismatch` on failure
+    (``verify_digest=False`` opts out; ``strict=False`` demotes).
     """
-    version, leaves = _read_manifests(path)
+    version, leaves, integrity = _read_manifests(path)
+    if not verify_digest:
+        integrity = None
     if version < 2:
         warnings.warn(
             f"checkpoint {path!r} has a v{version} manifest (no checksums); "
@@ -375,6 +470,7 @@ def load_sharded(path: str, template=None, *, strict: bool = True,
             for p in parts[:-1]:
                 node = node.setdefault(p, {})
             node[parts[-1]] = full
+        _verify_digest(path, out, integrity, strict)
         return out
 
     tpl_leaves = _flatten(template)
@@ -420,4 +516,6 @@ def load_sharded(path: str, template=None, *, strict: bool = True,
             parts.append(str(k.key) if hasattr(k, "key")
                          else str(getattr(k, "idx", k)))
         ordered.append(restored["/".join(parts)])
-    return jax.tree_util.tree_unflatten(treedef, ordered)
+    out = jax.tree_util.tree_unflatten(treedef, ordered)
+    _verify_digest(path, out, integrity, strict)
+    return out

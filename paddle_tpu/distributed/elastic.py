@@ -65,8 +65,8 @@ import jax
 from ..framework.errors import enforce
 from ..framework.log import vlog
 from ..utils import fsio
-from .checkpoint import (AsyncSaveHandle, CheckpointCorruption, load_sharded,
-                         save_sharded)
+from .checkpoint import (AsyncSaveHandle, CheckpointCorruption,
+                         DigestMismatch, load_sharded, save_sharded)
 
 __all__ = ["ElasticTrainState", "ElasticCoordinator", "StaleGeneration",
            "latest_checkpoint", "committed_checkpoints", "read_world",
@@ -188,9 +188,16 @@ class ElasticTrainState:
     def __init__(self, directory: str, save_interval_steps: int = 1000,
                  keep: int = 2, install_sigterm_handler: bool = True,
                  event_sink: Optional[Callable] = None,
-                 corrupt_keep: Optional[int] = None):
+                 corrupt_keep: Optional[int] = None,
+                 fingerprint=None):
         self.directory = directory
         self._event_sink = event_sink
+        #: optional TreeFingerprint (ISSUE 11): when set, every save
+        #: stamps the live tree digest into the manifest and every
+        #: restore re-verifies it (load_sharded's round-trip check) —
+        #: the supervisor's IntegrityGuard shares the instance so the
+        #: checkpoint stamp and the cross-worker compare use one digest
+        self.fingerprint = fingerprint
         self.save_interval_steps = int(save_interval_steps)
         self.keep = keep
         self.corrupt_keep = (int(os.environ.get(CORRUPT_KEEP_ENV, "2"))
@@ -308,7 +315,7 @@ class ElasticTrainState:
                 # leftover from an earlier crashed/uncommitted save of the
                 # same step — the fresh staging dir supersedes it
                 shutil.rmtree(final)
-            os.replace(stage, final)
+            os.replace(stage, final)  # noqa: fsio — dir rename; parent fsync'd below
         # multi-host: every process wrote its own shards straight into
         # ``final`` (no per-process rename possible over a shared dir);
         # the COMMITTED marker below is still the only eligibility gate
@@ -328,14 +335,31 @@ class ElasticTrainState:
             return f"{self._path(step)}.{self._save_seq}{_TMP_SUFFIX}"
         return self._path(step)
 
+    def _integrity_meta(self, step: int, state) -> Optional[Dict[str, Any]]:
+        """Manifest fingerprint stamp for ``state`` (None when digesting
+        is off).  Computed synchronously BEFORE the save serializes
+        anything — the whole point is that the digest describes the live
+        tree, so corruption between here and the shard writes is caught
+        at restore even though every CRC passes."""
+        if self.fingerprint is None:
+            return None
+        fpr = self.fingerprint.digest(state)
+        meta = fpr.meta()
+        meta["exclude"] = list(self.fingerprint.exclude)
+        self._emit("checkpoint_digest", step=step, digest=fpr.hex(),
+                   excluded=len(fpr.excluded))
+        return meta
+
     def save(self, step: int, state, *, use_async: bool = True) -> None:
         self.wait()
         stage = self._stage_path(step)
         if stage.endswith(_TMP_SUFFIX) and os.path.isdir(stage):
             shutil.rmtree(stage)  # stale staging dir from a crashed save
         vlog(1, "elastic: saving checkpoint %s", self._path(step))
+        integrity = self._integrity_meta(step, state)
         if use_async:
-            handle = save_sharded(state, stage, use_async=True)
+            handle = save_sharded(state, stage, use_async=True,
+                                  integrity=integrity)
             mgr = self
             errors: list = []
 
@@ -350,7 +374,7 @@ class ElasticTrainState:
             t.start()
             self._pending = AsyncSaveHandle(t, errors)
         else:
-            save_sharded(state, stage)
+            save_sharded(state, stage, integrity=integrity)
             self._commit(step, stage)
 
     def maybe_save(self, step: int, state) -> bool:
@@ -373,6 +397,47 @@ class ElasticTrainState:
             self._pending = None
 
     # -- restore -----------------------------------------------------------
+    def _fallback_kind(self, e: Exception) -> str:
+        if isinstance(e, DigestMismatch):
+            return "digest mismatch"
+        if isinstance(e, CheckpointCorruption):
+            return "corruption"
+        return "load failure"
+
+    def _note_fallback(self, step: Optional[int], path: str, reason: str,
+                       error: str = "") -> None:
+        """ISSUE 11: every step the restore chain skips gets a named
+        ``restore.fallback`` event + counter — older-step fallback used
+        to be silent in the timeline, which hid exactly the evidence an
+        SDC post-mortem needs (which steps were skipped and why)."""
+        self._emit("restore.fallback", step=step, path=path,
+                   reason=reason, error=error)
+        try:
+            from ..observability import get_registry
+            reg = get_registry()
+            reg.counter("restore.fallbacks").inc()
+            reg.emit("restore.fallback", step=step, reason=reason,
+                     path=path)
+        except Exception as e:
+            vlog(1, "elastic: fallback metrics failed: %r", e)
+
+    def _note_uncommitted(self) -> None:
+        """Fallback events for step dirs that never got a COMMITTED
+        marker (crashed mid-save): the restore walk silently ignores
+        them, the timeline should not."""
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in sorted(entries, reverse=True):
+            if (not name.startswith(_STEP_PREFIX)
+                    or name.endswith((_TMP_SUFFIX, _CORRUPT_SUFFIX))):
+                continue
+            full = os.path.join(self.directory, name)
+            if not os.path.exists(os.path.join(full, "COMMITTED")):
+                self._note_fallback(_step_of(name), full,
+                                    "missing COMMITTED")
+
     def restore_or(self, init_fn: Callable[[], Any],
                    template_fn: Callable[[], Any]):
         """(state, start_step): restore the newest VALID committed
@@ -380,22 +445,24 @@ class ElasticTrainState:
         ``(init_fn(), 0)``.
 
         Fallback chain: committed steps are tried newest→oldest; any that
-        fail manifest/checksum validation (or raise during load) are
-        quarantined to ``step-N.corrupt/`` and the next one is tried.  A
-        single flipped bit therefore costs one checkpoint interval, not
-        the run.
+        fail manifest/checksum validation, tree-digest re-verification,
+        or raise during load are quarantined to ``step-N.corrupt/`` and
+        the next one is tried — each skip named by a ``restore.fallback``
+        event (corrupt / digest mismatch / missing COMMITTED).  A single
+        flipped bit therefore costs one checkpoint interval, not the run.
         """
+        self._note_uncommitted()
         for path in committed_checkpoints(self.directory):
             step = int(os.path.basename(path)[len(_STEP_PREFIX):])
             vlog(1, "elastic: restoring %s", path)
             try:
                 return load_sharded(path, template_fn()), step + 1
             except Exception as e:
-                kind = ("corruption" if isinstance(e, CheckpointCorruption)
-                        else "load failure")
+                kind = self._fallback_kind(e)
                 vlog(0, "elastic: %s restoring %s (%s) — quarantining and "
                      "falling back to the previous committed step",
                      kind, path, e)
+                self._note_fallback(step, path, kind, error=str(e))
                 self._quarantine(path, reason=kind, error=str(e))
         return init_fn(), 0
 
@@ -404,7 +471,7 @@ class ElasticTrainState:
         dst = path + _CORRUPT_SUFFIX
         if os.path.isdir(dst):
             shutil.rmtree(dst)
-        os.replace(path, dst)
+        os.replace(path, dst)  # noqa: fsio — dir rename; parent fsync'd below
         fsio.fsync_dir(self.directory)
         self._emit("checkpoint_quarantined", path=path, step=_step_of(
             os.path.basename(path)), reason=reason, error=error,
@@ -738,6 +805,7 @@ class ElasticCoordinator:
         """``restore_or`` with the relayout hook threaded through: walk
         committed steps newest→oldest, quarantining failures."""
         directory = self.elastic.directory
+        self.elastic._note_uncommitted()
         for path in committed_checkpoints(directory):
             step = int(os.path.basename(path)[len(_STEP_PREFIX):])
             vlog(1, "elastic: resharding %s onto dp=%s", path, self.dp)
@@ -746,10 +814,11 @@ class ElasticCoordinator:
                                      mismatch=self._relayout_leaf)
                 return state, step + 1
             except Exception as e:
-                kind = ("corruption" if isinstance(e, CheckpointCorruption)
-                        else "load failure")
+                kind = self.elastic._fallback_kind(e)
                 vlog(0, "elastic: %s resharding %s (%s) — quarantining "
                      "and falling back", kind, path, e)
+                self.elastic._note_fallback(step, path, kind,
+                                            error=str(e))
                 self.elastic._quarantine(path, reason=kind, error=str(e))
         enforce(init_fn is not None,
                 "no committed checkpoint survives and no init_fn was "

@@ -1,0 +1,301 @@
+"""On-device pytree fingerprinting (ISSUE 11).
+
+The integrity guard (``supervisor/integrity.py``) needs a digest of the
+full training state that is (a) cheap enough to run in-graph every
+``PTPU_INTEGRITY_EVERY`` steps with ONE scalar readback, (b) guaranteed
+to notice a single flipped bit anywhere in the tree, and (c) equal
+across ZeRO-1 dp widths holding the same logical state — dp=8 and dp=4
+pad the flat master to different lengths (``comm/zero.py``'s
+``repack_flat`` invariant: real elements occupy ``[0, total)``, padding
+is trailing zeros), so a layout-aware digest is the only one that can
+survive an elastic resize or a cross-width restore.
+
+Digest scheme — chunked multilinear hash mod 2**32:
+
+    leaf(x)  = Σ_j V[j] · ( Σ_k u32(x)[j·C + k] · W[k] )      (mod 2**32)
+    tree     = Σ_leaf  nameweight(name) · leaf(x)             (mod 2**32)
+
+with ``C = CHUNK`` lanes per chunk, ``W`` a fixed random vector of ODD
+u32 weights, ``V[j] = (j·2654435761 + 0x9E3779B9) | 1`` the (odd) chunk
+weight, and ``nameweight`` the (odd) FNV-1a hash of the leaf name.  Odd
+weights buy the single-bit guarantee: flipping bit ``b < 32`` of lane
+``i`` perturbs the digest by ``±2**b · W[i%C] · V[i//C]``, a power of
+two times an odd number — never 0 mod 2**32.  Zero lanes contribute
+nothing, so the digest is — deliberately — invariant under trailing
+zero padding: that is exactly the ZeRO-1 width-invariance (c), with no
+layout metadata needed.  The same argument makes an all-zeros leaf
+digest to 0 regardless of its padded length.
+
+Rank-private leaves (error-feedback residuals — legitimately different
+on every replica, see ``ElasticCoordinator.ef_keys``) are EXCLUDED by
+name-part match and accounted in ``Fingerprint.excluded`` so a report
+can prove what the digest does not cover.
+
+Two implementations share the weight schedule and must agree bit-for-
+bit (tested): a jitted device path (:class:`TreeFingerprint`, one
+compile per tree signature, leaf digests stay on device until the
+attribution path asks) and a host numpy path (:func:`digest_tree_host`,
+used by ``checkpoint.load_sharded`` to re-verify a restored tree
+without touching the device).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["TreeFingerprint", "Fingerprint", "digest_tree_host",
+           "tree_digest", "leaf_name_weight", "is_rank_private",
+           "DEFAULT_EXCLUDE", "CHUNK", "DIGEST_ALGO"]
+
+#: lanes per chunk — leaves shorter than this cost a single weighted sum
+CHUNK = 4096
+
+#: algorithm tag stamped into checkpoint manifests; digests are only
+#: comparable between equal tags
+DIGEST_ALGO = "mlh32/1"
+
+#: default rank-private exclusion patterns — MUST stay in sync with
+#: ``ElasticCoordinator.ef_keys`` (same name-part match semantics)
+DEFAULT_EXCLUDE: Tuple[str, ...] = ("resid", "ef_residual")
+
+_MOD = np.uint64(1) << np.uint64(32)
+# fixed seed: digests must be stable across processes, hosts and runs
+_W_HOST = (np.random.RandomState(0x17D1)
+           .randint(0, 2**32, size=CHUNK, dtype=np.uint64)
+           .astype(np.uint32) | np.uint32(1))
+_CHUNK_MUL = 2654435761       # Knuth multiplicative constant
+_CHUNK_ADD = 0x9E3779B9       # golden-ratio offset
+
+
+def leaf_name_weight(name: str) -> int:
+    """Odd 32-bit FNV-1a of the leaf name — the tree-level combining
+    weight, so 'value v under leaf A' and 'under leaf B' hash apart."""
+    h = 2166136261
+    for b in name.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h | 1
+
+
+def is_rank_private(name: str, exclude: Sequence[str] = DEFAULT_EXCLUDE
+                    ) -> bool:
+    """Same name-part match as ``ElasticCoordinator._is_rank_private``."""
+    parts = name.split("/")
+    return any(k in parts for k in exclude)
+
+
+def _flatten_named(tree) -> List[Tuple[str, Any]]:
+    # identical "/"-joined naming to checkpoint._flatten so digests,
+    # manifests and relayout hooks all speak about the same leaves
+    from .checkpoint import _flatten
+    return _flatten(tree)
+
+
+# ---------------------------------------------------------------------------
+# lane extraction — the exact bit pattern as u32 lanes, numpy and jnp
+# ---------------------------------------------------------------------------
+def _lanes_np(x) -> np.ndarray:
+    x = np.ascontiguousarray(x)
+    if x.dtype == np.bool_:
+        x = x.astype(np.uint8)
+    size = x.dtype.itemsize
+    flat = x.reshape(-1)
+    if size >= 4:
+        # 8-byte dtypes view to two u32 words per element (low word
+        # first on little-endian hosts — matched by the jnp path's
+        # bitcast minor-dim order on all current platforms)
+        return flat.view(np.uint32)
+    if size == 2:
+        return flat.view(np.uint16).astype(np.uint32)
+    return flat.view(np.uint8).astype(np.uint32)
+
+
+def _lanes_jnp(x: jax.Array) -> jax.Array:
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    size = jnp.dtype(x.dtype).itemsize
+    flat = x.reshape(-1)
+    if size >= 4:
+        bits = lax.bitcast_convert_type(flat, jnp.uint32)
+        return bits.reshape(-1) if size > 4 else bits
+    if size == 2:
+        u16 = lax.bitcast_convert_type(flat, jnp.uint16)
+        return u16.astype(jnp.uint32)
+    return lax.bitcast_convert_type(flat, jnp.uint8).astype(jnp.uint32)
+
+
+def _leaf_digest_np(x) -> int:
+    lanes = _lanes_np(x)
+    n = lanes.size
+    if n == 0:
+        return 0
+    pad = (-n) % CHUNK
+    if pad:
+        lanes = np.concatenate([lanes, np.zeros(pad, np.uint32)])
+    rows = lanes.reshape(-1, CHUNK)
+    rowsums = np.einsum("jk,k->j", rows.astype(np.uint64),
+                        _W_HOST.astype(np.uint64)) % _MOD
+    j = np.arange(rows.shape[0], dtype=np.uint64)
+    v = (j * np.uint64(_CHUNK_MUL) + np.uint64(_CHUNK_ADD)) % _MOD | \
+        np.uint64(1)
+    return int((rowsums * v % _MOD).sum() % _MOD)
+
+
+def _leaf_digest_jnp(x: jax.Array) -> jax.Array:
+    lanes = _lanes_jnp(x)
+    n = lanes.size
+    if n == 0:
+        return jnp.uint32(0)
+    pad = (-n) % CHUNK
+    if pad:
+        lanes = jnp.concatenate(
+            [lanes, jnp.zeros(pad, jnp.uint32)])
+    rows = lanes.reshape(-1, CHUNK)
+    w = jnp.asarray(_W_HOST)
+    rowsums = jnp.sum(rows * w[None, :], axis=1, dtype=jnp.uint32)
+    j = lax.iota(jnp.uint32, rows.shape[0])
+    v = (j * jnp.uint32(_CHUNK_MUL) + jnp.uint32(_CHUNK_ADD)) \
+        | jnp.uint32(1)
+    return jnp.sum(rowsums * v, dtype=jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# results
+# ---------------------------------------------------------------------------
+class Fingerprint:
+    """One digest pass over a tree.
+
+    ``tree`` (property) is the single scalar readback the per-interval
+    check pays; :meth:`leaf_digests` pulls the per-leaf vector to host —
+    the attribution path, taken only on mismatch.  ``excluded`` accounts
+    for every rank-private leaf the digest deliberately does not cover.
+    """
+
+    def __init__(self, names: List[str], excluded: List[str],
+                 tree_digest, leaf_digests):
+        self.names = list(names)
+        self.excluded = list(excluded)
+        self._tree = tree_digest
+        self._leaves = leaf_digests
+
+    @property
+    def tree(self) -> int:
+        return int(self._tree)
+
+    def hex(self) -> str:
+        return f"{self.tree:08x}"
+
+    def leaf_digests(self) -> Dict[str, int]:
+        vals = np.asarray(self._leaves)
+        return {n: int(v) for n, v in zip(self.names, vals)}
+
+    def diff(self, other: "Fingerprint") -> List[str]:
+        """Names of leaves whose digests differ (attribution)."""
+        mine, theirs = self.leaf_digests(), other.leaf_digests()
+        return sorted(n for n in mine
+                      if theirs.get(n, None) != mine[n])
+
+    def meta(self, with_leaves: bool = True) -> Dict[str, Any]:
+        """JSON-ready manifest stamp (``checkpoint.save_sharded``)."""
+        out: Dict[str, Any] = {"algo": DIGEST_ALGO, "tree": self.hex(),
+                               "excluded": self.excluded}
+        if with_leaves:
+            out["leaves"] = {n: f"{d:08x}"
+                             for n, d in self.leaf_digests().items()}
+        return out
+
+    def __repr__(self) -> str:
+        return (f"Fingerprint(tree={self.hex()}, leaves={len(self.names)},"
+                f" excluded={len(self.excluded)})")
+
+
+def _combine_tree(names: Sequence[str], leaf_digests):
+    w = np.array([leaf_name_weight(n) for n in names], dtype=np.uint32)
+    if isinstance(leaf_digests, np.ndarray):
+        return int((leaf_digests.astype(np.uint64) * w.astype(np.uint64)
+                    % _MOD).sum() % _MOD)
+    return jnp.sum(leaf_digests * jnp.asarray(w), dtype=jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# device path
+# ---------------------------------------------------------------------------
+class TreeFingerprint:
+    """Jitted tree digester with per-signature compile caching.
+
+    >>> fp = TreeFingerprint()
+    >>> r = fp.digest(state)       # device work + ONE scalar readback
+    >>> r.hex()
+    '9f2a44c1'
+
+    ``exclude``: rank-private name-part patterns (default matches
+    ``ElasticCoordinator.ef_keys``) — these leaves are skipped and
+    accounted in ``Fingerprint.excluded``.
+    """
+
+    def __init__(self, exclude: Sequence[str] = DEFAULT_EXCLUDE):
+        self.exclude = tuple(exclude)
+        self._cache: Dict[Any, Any] = {}
+
+    def _split(self, tree):
+        named = _flatten_named(tree)
+        included = [(n, x) for n, x in named
+                    if not is_rank_private(n, self.exclude)]
+        excluded = sorted(n for n, _ in named
+                          if is_rank_private(n, self.exclude))
+        included.sort(key=lambda nx: nx[0])
+        return included, excluded
+
+    def _fn(self, names, leaves):
+        sig = tuple((n, np.shape(x), str(getattr(x, "dtype", type(x))))
+                    for n, x in zip(names, leaves))
+        fn = self._cache.get(sig)
+        if fn is None:
+            nm = tuple(names)
+
+            @jax.jit
+            def digest_fn(xs):
+                per_leaf = jnp.stack([_leaf_digest_jnp(x) for x in xs])
+                return _combine_tree(nm, per_leaf), per_leaf
+
+            fn = self._cache[sig] = digest_fn
+        return fn
+
+    def digest(self, tree) -> Fingerprint:
+        included, excluded = self._split(tree)
+        names = [n for n, _ in included]
+        leaves = [x for _, x in included]
+        if not leaves:
+            return Fingerprint(names, excluded, 0,
+                               np.zeros(0, np.uint32))
+        tree_d, leaf_d = self._fn(names, leaves)(leaves)
+        return Fingerprint(names, excluded, tree_d, leaf_d)
+
+
+# ---------------------------------------------------------------------------
+# host path (checkpoint verification — no device, no compile)
+# ---------------------------------------------------------------------------
+def digest_tree_host(tree, exclude: Sequence[str] = DEFAULT_EXCLUDE
+                     ) -> Fingerprint:
+    """Numpy mirror of :meth:`TreeFingerprint.digest` — bit-identical
+    digests, used where the tree already lives on host (a freshly
+    restored checkpoint) or a compile is not worth paying."""
+    named = _flatten_named(tree)
+    excluded = sorted(n for n, _ in named if is_rank_private(n, exclude))
+    included = sorted(((n, x) for n, x in named
+                       if not is_rank_private(n, exclude)),
+                      key=lambda nx: nx[0])
+    names = [n for n, _ in included]
+    leaf_d = np.array([_leaf_digest_np(np.asarray(x))
+                       for _, x in included], dtype=np.uint32)
+    return Fingerprint(names, excluded, _combine_tree(names, leaf_d),
+                       leaf_d)
+
+
+def tree_digest(tree, exclude: Sequence[str] = DEFAULT_EXCLUDE) -> int:
+    """Convenience: the (blocking) tree digest as an int."""
+    return digest_tree_host(tree, exclude).tree

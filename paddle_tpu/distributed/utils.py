@@ -263,7 +263,8 @@ def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
             training_script_args)
         fn = None
         if log_dir:
-            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+            fn = open(os.path.join(log_dir, f"workerlog.{idx}"),  # noqa: fsio — live stream handle for Popen, not a durable commit
+                      "a")
         proc = subprocess.Popen(cmd, env=env, stdout=fn or None,
                                 stderr=fn or None)
         tp = TrainerProc()

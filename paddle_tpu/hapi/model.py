@@ -164,6 +164,16 @@ class Model:
             # top of whatever schedule is active
             lr_override = jnp.asarray(
                 self._optimizer.get_lr() * sup.guard.lr_scale, jnp.float32)
+        if (sup is not None and sup.integrity is not None
+                and sup.integrity.enabled):
+            # replay-audit stash (ISSUE 11): references to this step's
+            # pre-state and exact inputs (jax arrays are immutable, so
+            # this is pointer assignment, not a copy)
+            if sup.integrity.replay_fn is None:
+                sup.integrity.replay_fn = self._integrity_replay
+            sup.integrity.stash_replay(sup.gstep + 1,
+                                       self._supervised_state(),
+                                       (data, key, lr_override))
         try:
             if sup is not None:
                 # the armed region covers the jitted step AND the host
@@ -331,6 +341,12 @@ class Model:
                             # scale signal — re-form the mesh at the new
                             # width and resume from last_good_step
                             self._supervised_resize(sup)
+                        elif sup.pending_integrity is not None:
+                            # state-integrity heal (ISSUE 11): a desync
+                            # verdict — majority members publish the
+                            # resync offer, suspects climb the
+                            # resync → rollback → evict ladder
+                            self._supervised_integrity_heal(sup)
                         else:
                             # checkpoint only states a good update built
                             sup.note_step_ok(
@@ -480,6 +496,35 @@ class Model:
                      else self._supervised_state()),
             lambda: self._supervised_state(), reason)
         self._load_supervised_state(state)
+
+    def _supervised_integrity_heal(self, sup) -> None:
+        """Execute a latched state-integrity heal (ISSUE 11); the live
+        model adopts whatever state the healing ladder lands on — the
+        majority state (resync), a digest-verified checkpoint
+        (rollback), or the re-formed fleet's state (evict)."""
+        state, _start = sup.perform_integrity_heal(
+            lambda: (sup.initial_state if sup.initial_state is not None
+                     else self._supervised_state()),
+            lambda: self._supervised_state(),
+            self._supervised_state())
+        self._load_supervised_state(state)
+
+    def _integrity_replay(self, state, stashed):
+        """Deterministic re-run of one stashed microbatch for the replay
+        audit: same inputs, same RNG key, same LR — the jitted step is
+        pure, so two replays that disagree indict software
+        nondeterminism and a replay that disagrees with the live state
+        indicts the hardware (state damaged outside the computed path)."""
+        data, key, lr_override = stashed
+        params = state["params"]
+        tv = self.network.trainable_variables()
+        # same container type + order as the live step — the optimizer
+        # state's treedef is structural, not just keyed
+        trainable = type(tv)((k, params[k]) for k in tv)
+        rest = {k: v for k, v in params.items() if k not in tv}
+        _loss, _out, merged, new_opt_state, _finite, _g = self._train_step(
+            trainable, rest, state["opt"], key, lr_override, *data)
+        return {"params": dict(merged), "opt": new_opt_state}
 
     def _supervised_resize(self, sup) -> None:
         """Execute a latched elastic resize (ISSUE 9): the coordinator

@@ -30,6 +30,7 @@ from jax import export as jax_export
 
 from .distributed.checkpoint import load_sharded, save_sharded
 from .framework.errors import enforce
+from .utils import fsio
 
 __all__ = ["to_static", "save", "load", "InputSpec", "TranslatedLayer"]
 
@@ -111,11 +112,13 @@ def save(layer, path: str, input_spec: List[InputSpec]) -> None:
     sds = [s.sds(scope=scope, prefix=f"s{i}_")
            for i, s in enumerate(input_spec)]
     exported = jax_export.export(jax.jit(fwd))(params, *sds)
-    with open(os.path.join(path, "model.stablehlo"), "wb") as f:
-        f.write(exported.serialize())
+    fsio.write_bytes(os.path.join(path, "model.stablehlo"),
+                     bytes(exported.serialize()))
     save_sharded(params, os.path.join(path, "params"))
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"input_spec": [s.to_json() for s in input_spec]}, f)
+    fsio.write_bytes(
+        os.path.join(path, "meta.json"),
+        json.dumps({"input_spec": [s.to_json() for s in input_spec]}
+                   ).encode("utf-8"))
 
 
 class TranslatedLayer:

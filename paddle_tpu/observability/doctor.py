@@ -408,6 +408,57 @@ def check_supervisor(events) -> List[Dict[str, Any]]:
         ev, events=bad)]
 
 
+def check_integrity(events) -> List[Dict[str, Any]]:
+    """State-integrity verdicts (ISSUE 11): ``desync`` when replicas
+    voted a digest mismatch, ``sdc_suspect`` when a replay audit pinned
+    the damage outside the computed path (hardware SDC signature)."""
+    findings: List[Dict[str, Any]] = []
+    desyncs = [e for e in events if e.get("kind") == "integrity.desync"]
+    audits = [e for e in events if e.get("kind") == "integrity.audit"]
+    heals = [e for e in events if e.get("kind") == "integrity.heal"]
+    sdc = [e for e in audits if e.get("verdict") == "sdc_suspect"]
+    nondet = [e for e in audits if e.get("verdict") == "nondeterminism"]
+    if sdc:
+        ev = [f"replay audit at step {e.get('step')}: replays agree "
+              f"({e.get('replay')}) but live state reads {e.get('live')} "
+              "— damaged outside the computed path" for e in sdc[:4]]
+        ev.append("suspect the device: re-run the burn-in "
+                  "(tools/burnin), cordon the host if it reproduces")
+        findings.append(_finding(
+            "sdc_suspect", 80 + 5 * min(4, len(sdc)),
+            f"{len(sdc)} replay audit(s) indict silent data corruption",
+            ev, audits=len(sdc)))
+    if desyncs:
+        suspects: Dict[str, int] = {}
+        for e in desyncs:
+            for w in (e.get("suspects") or []):
+                suspects[str(w)] = suspects.get(str(w), 0) + 1
+        healed: Dict[str, int] = {}
+        for h in heals:
+            a = str(h.get("action"))
+            healed[a] = healed.get(a, 0) + 1
+        ev = [f"{len(desyncs)}× digest mismatch across replicas "
+              f"(steps {sorted(set(e.get('step') for e in desyncs))})"]
+        if suspects:
+            ev.append("suspect worker(s) by majority vote: " + ", ".join(
+                f"worker {w} ({n}×)" for w, n in sorted(suspects.items())))
+        if any(e.get("ambiguous") for e in desyncs):
+            ev.append("at least one split had no majority (ambiguous) — "
+                      "both sides were rolled back")
+        if nondet:
+            ev.append(f"{len(nondet)} replay audit(s) reproduced "
+                      "DIFFERENT digests from identical inputs — "
+                      "software nondeterminism, not hardware")
+        if healed:
+            ev.append("healing actions: " + ", ".join(
+                f"{n}× {a}" for a, n in sorted(healed.items())))
+        findings.append(_finding(
+            "desync", 60 + 5 * min(6, len(desyncs)),
+            "replica state digests diverged during the run",
+            ev, count=len(desyncs), suspects=suspects))
+    return findings
+
+
 def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     """Run every check against ``run_dir``; returns the diagnosis dict
     (findings ranked most-severe first) or ``None`` when the run left no
@@ -432,6 +483,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_straggler(workers, summary)
     findings += check_data_starved(workers)
     findings += check_comm_bound(workers)
+    findings += check_integrity(events)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

@@ -207,6 +207,44 @@ class StatusServer:
                 "pending": getattr(sup, "pending_resize", None),
             })
         status["elastic"] = elastic or None
+        # state integrity (ISSUE 11): present whenever an IntegrityGuard
+        # drives this worker or integrity.* instruments exist — the page
+        # an operator checks when replicas start disagreeing
+        integrity: Dict[str, Any] = {}
+        if any(k.startswith("integrity.") for k in snap):
+            def count(name):
+                m = snap.get(f"integrity.{name}")
+                return m["value"] if m and m.get("type") == "counter" else 0
+            integrity = {
+                "last_step": gauge("integrity.last_step"),
+                "interval": gauge("integrity.interval"),
+                "digest": gauge("integrity.digest"),
+                "workers": gauge("integrity.workers"),
+                "suspects": gauge("integrity.suspects"),
+                "checks": count("checks"),
+                "mismatches": count("mismatches"),
+                "audits": count("audits"),
+                "resyncs": count("resyncs"),
+            }
+        ig = getattr(sup, "integrity", None) if sup else None
+        if ig is not None:
+            integrity.update({
+                "enabled": ig.enabled,
+                "interval": ig.every,
+                "action": ig.action,
+                "checks": ig.checks,
+                "mismatches": ig.mismatches,
+                "strikes": dict(ig.strikes),
+                "last_digest": (ig.last_fingerprint.hex()
+                                if ig.last_fingerprint is not None
+                                else None),
+                "last_verdict": (dict(ig.last_verdict)
+                                 if ig.last_verdict is not None else None),
+                "pending": (dict(sup.pending_integrity)
+                            if getattr(sup, "pending_integrity", None)
+                            is not None else None),
+            })
+        status["integrity"] = integrity or None
         if sup is not None:
             if status["step"] is None:
                 status["step"] = sup.gstep

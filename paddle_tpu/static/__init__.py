@@ -1163,8 +1163,8 @@ def deserialize_persistables(program, data: bytes, executor=None):
 
 
 def save_to_file(path: str, content: bytes):
-    with open(path, "wb") as f:
-        f.write(content)
+    from ..utils import fsio
+    fsio.write_bytes(path, content)
 
 
 def load_from_file(path: str) -> bytes:

@@ -45,6 +45,7 @@ from ..framework.log import vlog
 from .guard import DivergenceGuard, GuardAction
 from .heartbeat import (HeartbeatMonitor, HeartbeatWriter, RunState,
                         heartbeat_dir)
+from .integrity import IntegrityGuard, IntegrityVerdict, integrity_dir
 from .report import SupervisorReport
 from .rollback import RollbackBudgetExceeded, RollbackManager
 from .watchdog import (StepTimeout, Watchdog, global_watchdog, guarded,
@@ -54,6 +55,7 @@ __all__ = [
     "RunSupervisor", "SupervisorReport", "Watchdog", "StepTimeout",
     "HeartbeatWriter", "HeartbeatMonitor", "RunState", "DivergenceGuard",
     "GuardAction", "RollbackManager", "RollbackBudgetExceeded",
+    "IntegrityGuard", "IntegrityVerdict", "integrity_dir",
     "install_global", "global_watchdog", "guarded", "heartbeat_dir",
 ]
 
@@ -79,7 +81,7 @@ class RunSupervisor:
                  reseed: Optional[Callable[[int], None]] = None,
                  report_path: Optional[str] = None,
                  sigterm_handler: bool = True, clock=time.time,
-                 coordinator=None):
+                 coordinator=None, integrity=None):
         os.makedirs(run_dir, exist_ok=True)
         self.run_dir = run_dir
         self.report = SupervisorReport(
@@ -114,6 +116,23 @@ class RunSupervisor:
         self.coordinator = coordinator
         if coordinator is not None and coordinator.event_sink is None:
             coordinator.event_sink = self.report.record
+        # state-integrity guard (ISSUE 11): pass an IntegrityGuard, or
+        # set PTPU_INTEGRITY_EVERY > 0 to get the default one; the guard
+        # shares its TreeFingerprint with the elastic manager so the
+        # checkpoint digest stamp and the cross-worker compare agree
+        if integrity is None and int(
+                os.environ.get("PTPU_INTEGRITY_EVERY", "0") or "0") > 0:
+            integrity = IntegrityGuard(
+                run_dir, worker_id=self.heartbeat.worker_id,
+                expected=expected_workers, report=self.report,
+                clock=clock)
+        self.integrity = integrity
+        if integrity is not None:
+            if integrity.report is None:
+                integrity.report = self.report
+            if getattr(self.elastic, "fingerprint", None) is None:
+                self.elastic.fingerprint = integrity.fingerprint
+        self.pending_integrity: Optional[IntegrityVerdict] = None
         self.pending_resize: Optional[dict] = None
         self.step_failure_budget = int(step_failure_budget)
         self.pending_rollback: Optional[str] = None
@@ -260,6 +279,11 @@ class RunSupervisor:
         self.maybe_poll()
         if state is not None:
             self.elastic.maybe_save(self.gstep, state)
+            if self.integrity is not None:
+                verdict = self.integrity.maybe_check(self.gstep, state)
+                if (verdict is not None and not verdict.ok
+                        and self.pending_integrity is None):
+                    self.pending_integrity = verdict
 
     def note_step_failure(self, reason: str = "step-timeout") -> str:
         """SKIP while repeated failures stay inside the budget; beyond it
@@ -321,6 +345,42 @@ class RunSupervisor:
              self.gstep, start)
         self.gstep = start
         return state, start
+
+    # -- state-integrity healing (ISSUE 11) --------------------------------
+    def recheck_integrity(self, step: Optional[int] = None
+                          ) -> Optional["IntegrityVerdict"]:
+        """Fleet-barrier form of the integrity compare: re-vote after
+        every member's boards landed (a worker whose ``note_step_ok``
+        ran before its peers' saw an incomplete board set), latching a
+        mismatch exactly like ``note_step_ok`` does."""
+        if self.integrity is None or not self.integrity.enabled:
+            return None
+        verdict = self.integrity.recheck(step)
+        if (verdict is not None and not verdict.ok
+                and self.pending_integrity is None):
+            self.pending_integrity = verdict
+        return verdict
+
+    def perform_integrity_heal(self, init_fn: Callable[[], Any],
+                               template_fn: Callable[[], Any],
+                               state: Any) -> Tuple[Any, int]:
+        """Execute the latched integrity heal: majority members publish
+        the resync offer and continue; suspects climb the
+        resync → rollback → evict ladder.  Returns ``(state, start)`` —
+        unchanged for the majority side."""
+        verdict = self.pending_integrity
+        self.pending_integrity = None
+        if verdict is None or self.integrity is None:
+            return state, self.gstep
+        st, start, action = self.integrity.heal(
+            self, verdict, init_fn, template_fn, state)
+        if action in ("rollback", "evict", "resync"):
+            self.consecutive_step_failures = 0
+        if start != self.gstep:
+            vlog(0, "supervisor: integrity heal (%s) rewound step "
+                 "counter %d → %d", action, self.gstep, start)
+            self.gstep = start
+        return st, start
 
     def perform_rollback(self, init_fn: Callable[[], Any],
                          template_fn: Callable[[], Any],

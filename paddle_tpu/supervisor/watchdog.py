@@ -58,15 +58,22 @@ def _async_raise(thread_id: int, exc_type) -> bool:
     return res == 1
 
 
-def dump_all_stacks(limit: int = 16) -> str:
+def dump_all_stacks(limit: int = 16, first: Optional[int] = None) -> str:
     """Stack of every live thread, hung ones included (the forensic core
-    of the timeout path)."""
+    of the timeout path).  ``first`` puts that thread id at the top —
+    the report clips long dumps, and in a thread-heavy process (serving
+    callbacks, io workers, peer watchdogs) the hung thread's stack must
+    survive the clip."""
     names = {t.ident: t.name for t in threading.enumerate()}
     chunks = []
     for tid, frame in sys._current_frames().items():
         header = f"--- thread {names.get(tid, '?')} ({tid}) ---"
-        chunks.append(header + "\n"
-                      + "".join(traceback.format_stack(frame, limit=limit)))
+        chunk = (header + "\n"
+                 + "".join(traceback.format_stack(frame, limit=limit)))
+        if tid == first:
+            chunks.insert(0, chunk)
+        else:
+            chunks.append(chunk)
     return "\n".join(chunks)
 
 
@@ -162,7 +169,7 @@ class Watchdog:
         """Called with the condition held: expire one armed section."""
         entry.expired = True
         self.timeouts += 1
-        stacks = dump_all_stacks()
+        stacks = dump_all_stacks(first=entry.thread_id)
         vlog(0, "watchdog: %r missed its deadline — thread stacks:\n%s",
              entry.label, stacks)
         if self.report is not None:

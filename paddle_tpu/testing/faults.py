@@ -40,7 +40,8 @@ from ..utils.retry import RetryPolicy
 
 __all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
            "corrupt_manifest", "fast_retries", "hang", "slow_call",
-           "diverge_after", "sigkill_self", "sigkill_at"]
+           "diverge_after", "sigkill_self", "sigkill_at", "bitflip",
+           "flip_tree_bit"]
 
 
 def _default_transient() -> OSError:
@@ -135,7 +136,7 @@ class FaultInjector:
 def flip_byte(path: str, offset: Optional[int] = None) -> None:
     """XOR one byte of ``path`` in place (default: the middle byte, which
     for .npy files lands in array data, not the header)."""
-    with open(path, "r+b") as f:
+    with open(path, "r+b") as f:  # noqa: fsio — deliberate corruption, bypasses the seam on purpose
         f.seek(0, os.SEEK_END)
         size = f.tell()
         if size == 0:
@@ -148,7 +149,7 @@ def flip_byte(path: str, offset: Optional[int] = None) -> None:
 
 
 def truncate_file(path: str, keep_bytes: int = 8) -> None:
-    with open(path, "r+b") as f:
+    with open(path, "r+b") as f:  # noqa: fsio — deliberate corruption, bypasses the seam on purpose
         f.truncate(keep_bytes)
 
 
@@ -272,6 +273,82 @@ class sigkill_at:
         if target_step is None or int(rank) != target_rank:
             return lambda *_a, **_k: None
         return sigkill_at(int(target_step))
+
+
+# -- silent data corruption (ISSUE 11: integrity drills) -------------------
+def flip_tree_bit(tree, leaf: str, bit: int = 0, index: int = 0):
+    """XOR one bit of one element of one named leaf of a live state tree
+    — the in-memory SDC that CRCs on disk can never see.  ``leaf`` is
+    the "/"-joined path name (checkpoint convention); ``bit`` indexes
+    into the leaf's raw bytes (0 = LSB of byte 0), ``index`` offsets by
+    whole elements first.  Returns a NEW tree (jax arrays are
+    immutable); every other leaf is the same reference."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..distributed.checkpoint import _flatten
+
+    names = [n for n, _x in _flatten(tree)]
+    if leaf not in names:
+        raise KeyError(f"no leaf {leaf!r} (have {sorted(names)[:8]}...)")
+
+    def _flip(path, x):
+        parts = [str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in path]
+        if "/".join(parts) != leaf:
+            return x
+        arr = np.asarray(x).copy()
+        raw = arr.reshape(-1).view(np.uint8)
+        pos = index * arr.dtype.itemsize + bit // 8
+        raw[pos % raw.size] ^= np.uint8(1 << (bit % 8))
+        out = arr if isinstance(x, np.ndarray) else jnp.asarray(arr)
+        return out
+
+    return jax.tree_util.tree_map_with_path(_flip, tree)
+
+
+class bitflip:
+    """Step-triggered single-bit corruptor for integrity drills: call
+    per step with the live state (``state = fault(step, state)``); at
+    ``step >= trigger`` on the targeted ``worker`` it flips ``bit`` of
+    ``leaf`` exactly once and stays quiet forever after — one cosmic
+    ray, not a radiation storm.  ``fired`` records the step it struck.
+
+    The flip happens OUTSIDE the computed path (between steps), which is
+    precisely the signature the replay audit classifies as
+    ``sdc_suspect``: replays from the stashed pre-state agree with each
+    other but not with the live digest."""
+
+    def __init__(self, leaf: str, bit: int = 0, step: int = 1,
+                 worker: Optional[int] = None, index: int = 0):
+        self.leaf = leaf
+        self.bit = int(bit)
+        self.step = int(step)
+        self.worker = worker
+        self.index = int(index)
+        self.fired: Optional[int] = None
+
+    def __call__(self, step: int, tree, worker: Optional[int] = None):
+        if self.fired is not None or step < self.step:
+            return tree
+        if (self.worker is not None and worker is not None
+                and int(worker) != self.worker):
+            return tree
+        self.fired = int(step)
+        return flip_tree_bit(tree, self.leaf, self.bit, self.index)
+
+    @staticmethod
+    def from_env(rank: int) -> Optional["bitflip"]:
+        """Env-driven form for worker scripts: reads
+        ``PTPU_TEST_BITFLIP_STEP`` / ``_RANK`` / ``_LEAF`` / ``_BIT``;
+        None when this worker is not the target."""
+        step = os.environ.get("PTPU_TEST_BITFLIP_STEP")
+        target = int(os.environ.get("PTPU_TEST_BITFLIP_RANK", "-1"))
+        if step is None or int(rank) != target:
+            return None
+        return bitflip(os.environ["PTPU_TEST_BITFLIP_LEAF"],
+                       bit=int(os.environ.get("PTPU_TEST_BITFLIP_BIT", "0")),
+                       step=int(step), worker=target)
 
 
 @contextlib.contextmanager

@@ -58,6 +58,11 @@ def pytest_configure(config):
         "markers", "comm: communication-subsystem tests (compressed "
         "collectives, error feedback, ZeRO-1 sharded optimizer; ci.sh "
         "runs this tier explicitly)")
+    config.addinivalue_line(
+        "markers", "integrity: state-integrity guard tests (tree "
+        "fingerprint, desync attribution, replay audit, healing "
+        "ladder, checkpoint digest round trip; ci.sh runs this tier "
+        "explicitly)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -293,6 +293,36 @@ class TestZero1CrossWidth:
         np.testing.assert_array_equal(np.asarray(out["flat"])[:714],
                                       host["flat"][:714])
 
+    @pytest.mark.integrity
+    def test_fingerprint_invariant_across_width_relayout(self):
+        """ISSUE 11: the tree digest is IDENTICAL across dp-width
+        relayouts of the same logical state — zero lanes contribute
+        nothing to the multilinear hash, so the 720-, 716- and 714-wide
+        flats hash alike and cross-width desync comparison (a shrunk
+        fleet voting against pre-shrink boards) compares apples to
+        apples."""
+        from paddle_tpu.distributed.fingerprint import digest_tree_host
+        dist.set_hybrid_communicate_group(None)
+        params = self._params()
+        opt8 = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                axis="dp", num_shards=8)
+        state = opt8.init(params)
+        params, state = self._train(opt8, params, state, 3)
+        host = {"step": np.asarray(state["step"]),
+                "flat": np.asarray(state["flat"]),
+                "slots": jax.tree_util.tree_map(np.asarray,
+                                                state["slots"])}
+        digests = {8: digest_tree_host(
+            {"params": params, "opt": host}).hex()}
+        for dp in (4, 2):
+            opt = ShardedOptimizer(pt.optimizer.Adam(learning_rate=1e-3),
+                                   axis="dp", num_shards=dp)
+            re = opt.relayout_state(host, params)
+            assert np.asarray(re["flat"]).shape != host["flat"].shape
+            digests[dp] = digest_tree_host(
+                {"params": params, "opt": re}).hex()
+        assert len(set(digests.values())) == 1, digests
+
 
 # -- coordinator resize arc ------------------------------------------------
 class TestCoordinatorResize:

@@ -1,0 +1,483 @@
+"""State-integrity guard tests (ISSUE 11).
+
+Four layers, bottom-up:
+
+- the tree fingerprint: device/host bit-identical digests, single-bit
+  detection, zero-padding (dp-width) invariance, rank-private exclusion
+  (the cross-width relayout invariance drill piggybacks on
+  test_elastic_fleet.py's ZeRO-1 fixtures);
+- checkpoint round-trip verification: the live-tree digest stamped into
+  the manifest catches corruption that happened BETWEEN the in-memory
+  hash and the on-disk CRC computation — the window CRCs can't see;
+- the guard: board publication, majority-vote attribution, replay-audit
+  classification (nondeterminism / sdc_suspect / desync), the healing
+  ladder (resync → rollback → evict);
+- the e2e drill: a 3-replica fleet, one cosmic ray, detection within
+  one interval, correct attribution, a resync heal, and a final loss
+  bit-equal to the un-faulted reference.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.distributed import elastic as el
+from paddle_tpu.distributed.checkpoint import (DigestMismatch, load_sharded,
+                                               read_integrity, save_sharded,
+                                               verify_sharded)
+from paddle_tpu.distributed.fingerprint import (DEFAULT_EXCLUDE,
+                                                TreeFingerprint,
+                                                digest_tree_host,
+                                                leaf_name_weight,
+                                                tree_digest)
+from paddle_tpu.hapi import Model
+from paddle_tpu.observability.doctor import check_integrity
+from paddle_tpu.supervisor import RunSupervisor
+from paddle_tpu.supervisor.integrity import IntegrityGuard
+from paddle_tpu.testing.faults import bitflip, flip_tree_bit
+
+pytestmark = pytest.mark.integrity
+
+
+def _tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"params": {"w": jnp.asarray(rng.randn(37, 19), jnp.float32),
+                       "b": jnp.asarray(rng.randn(11), jnp.float32),
+                       "emb": jnp.asarray(rng.randn(24, 8), jnp.bfloat16)},
+            "opt": {"step": jnp.asarray(3, jnp.int32),
+                    "m": jnp.asarray(rng.randn(64), jnp.float32)}}
+
+
+class TestFingerprint:
+    def test_device_host_bit_identical(self):
+        tree = _tree()
+        fp = TreeFingerprint()
+        dev = fp.digest(tree)
+        host = digest_tree_host(tree)
+        assert dev.hex() == host.hex()
+        assert dev.leaf_digests() == host.leaf_digests()
+
+    @pytest.mark.parametrize("dtype,bit", [
+        ("float32", 0), ("float32", 17), ("float32", 31),
+        ("bfloat16", 0), ("bfloat16", 15),
+        ("int8", 3), ("bool", 0), ("uint8", 7), ("int32", 30),
+    ])
+    def test_single_bit_flip_detected_and_attributed(self, dtype, bit):
+        rng = np.random.RandomState(1)
+        x = rng.randn(33) * 3
+        leaf = (jnp.asarray(x > 0) if dtype == "bool"
+                else jnp.asarray(x, dtype))
+        tree = {"a": jnp.asarray(rng.randn(7), jnp.float32), "victim": leaf}
+        d0 = digest_tree_host(tree)
+        flipped = flip_tree_bit(tree, "victim", bit=bit, index=5)
+        d1 = digest_tree_host(flipped)
+        assert d0.tree != d1.tree
+        assert d0.diff(d1) == ["victim"]
+
+    def test_trailing_zero_padding_invariance(self):
+        """The ZeRO-1 relayout invariance: zero lanes contribute nothing,
+        so the same real elements padded to different widths hash
+        identically (repack_flat's contract: padding is trailing
+        zeros)."""
+        rng = np.random.RandomState(2)
+        real = rng.randn(714).astype(np.float32)
+        digests = set()
+        for padded in (714, 716, 720):
+            flat = np.zeros(padded, np.float32)
+            flat[:714] = real
+            digests.add(tree_digest({"flat": jnp.asarray(flat)}))
+        assert len(digests) == 1
+
+    def test_rank_private_leaves_excluded_with_accounting(self):
+        tree = _tree()
+        tree["resid"] = {"w": jnp.asarray(np.ones(5), jnp.float32)}
+        fp = TreeFingerprint()
+        d0 = fp.digest(tree)
+        assert "resid/w" in d0.excluded
+        assert "resid/w" not in d0.names
+        # changing a rank-private leaf does not move the digest
+        tree["resid"]["w"] = jnp.asarray(np.full(5, 9.0), jnp.float32)
+        assert fp.digest(tree).hex() == d0.hex()
+        # ... but it IS accounted in the meta
+        meta = d0.meta()
+        assert meta["excluded"] == ["resid/w"]
+        assert meta["algo"] == "mlh32/1"
+
+    def test_insertion_order_invariance(self):
+        rng = np.random.RandomState(3)
+        a = jnp.asarray(rng.randn(4), jnp.float32)
+        b = jnp.asarray(rng.randn(6), jnp.float32)
+        assert tree_digest({"a": a, "b": b}) == tree_digest({"b": b, "a": a})
+
+    def test_empty_tree(self):
+        fp = TreeFingerprint()
+        assert fp.digest({}).tree == 0
+
+    def test_name_weight_is_odd(self):
+        for name in ("params/w", "opt/m", "x"):
+            assert leaf_name_weight(name) % 2 == 1
+
+
+class TestCheckpointDigest:
+    def _save(self, tmp_path, tree):
+        fp = TreeFingerprint()
+        meta = fp.digest(tree).meta()
+        meta["exclude"] = list(fp.exclude)
+        path = str(tmp_path / "step-1")
+        save_sharded(tree, path, integrity=meta)
+        return path, meta
+
+    def test_round_trip_verified(self, tmp_path):
+        tree = _tree()
+        path, meta = self._save(tmp_path, tree)
+        stamped = read_integrity(path)
+        assert stamped["tree"] == meta["tree"]
+        restored = load_sharded(path, jax.tree_util.tree_map(
+            lambda x: x, tree))
+        assert digest_tree_host(restored).hex() == meta["tree"]
+
+    def test_corruption_between_hash_and_crc(self, tmp_path):
+        """The acceptance scenario: state corrupted AFTER the digest was
+        computed but BEFORE the shard bytes + CRCs were written.  The
+        CRCs cover the corrupt bytes (verify_sharded passes) — only the
+        stamped live-tree digest catches it, naming the leaf."""
+        tree = _tree()
+        fp = TreeFingerprint()
+        meta = fp.digest(tree).meta()
+        meta["exclude"] = list(fp.exclude)
+        corrupt = flip_tree_bit(tree, "params/w", bit=9, index=11)
+        path = str(tmp_path / "step-1")
+        save_sharded(corrupt, path, integrity=meta)
+        assert verify_sharded(path) == []     # CRCs are consistent...
+        with pytest.raises(DigestMismatch) as ei:
+            load_sharded(path, jax.tree_util.tree_map(lambda x: x, tree))
+        assert "params/w" in str(ei.value)    # ...the digest names the leaf
+
+    def test_verify_digest_off_loads_corrupt(self, tmp_path):
+        tree = _tree()
+        fp = TreeFingerprint()
+        meta = fp.digest(tree).meta()
+        corrupt = flip_tree_bit(tree, "params/w", bit=9)
+        path = str(tmp_path / "step-1")
+        save_sharded(corrupt, path, integrity=meta)
+        restored = load_sharded(path, jax.tree_util.tree_map(
+            lambda x: x, tree), verify_digest=False)
+        assert restored is not None
+
+    def test_unstamped_checkpoint_loads(self, tmp_path):
+        tree = _tree()
+        path = str(tmp_path / "step-1")
+        save_sharded(tree, path)
+        assert read_integrity(path) is None
+        load_sharded(path, jax.tree_util.tree_map(lambda x: x, tree))
+
+
+class TestRestoreFallback:
+    def _mgr(self, tmp_path, events):
+        mgr = el.ElasticTrainState(str(tmp_path / "ck"),
+                                   install_sigterm_handler=False,
+                                   fingerprint=TreeFingerprint())
+        mgr.set_event_sink(lambda kind, **f: events.append((kind, f)))
+        return mgr
+
+    def test_digest_mismatch_quarantined_and_named(self, tmp_path):
+        events = []
+        mgr = self._mgr(tmp_path, events)
+        tree = _tree()
+        mgr.save(10, tree, use_async=False)
+        mgr.save(20, tree, use_async=False)
+        # rewrite step-20's stamped digest: the state no longer matches
+        man = os.path.join(mgr.directory, "step-20", "manifest-p0.json")
+        payload = json.loads(open(man).read())
+        payload["integrity"]["tree"] = "deadbeef"
+        with open(man, "w") as f:  # noqa: fsio — deliberate corruption
+            f.write(json.dumps(payload))
+        state, start = mgr.restore_or(
+            lambda: _tree(), lambda: jax.tree_util.tree_map(
+                lambda x: x, tree))
+        assert start == 11                      # fell back to step 10
+        fallbacks = [f for k, f in events if k == "restore.fallback"]
+        assert any(f["reason"] == "digest mismatch" and f["step"] == 20
+                   for f in fallbacks), fallbacks
+        assert os.path.isdir(os.path.join(mgr.directory, "step-20.corrupt"))
+
+    def test_missing_committed_marker_reported(self, tmp_path):
+        events = []
+        mgr = self._mgr(tmp_path, events)
+        tree = _tree()
+        mgr.save(10, tree, use_async=False)
+        # a torn save: step dir without the COMMITTED marker
+        os.makedirs(os.path.join(mgr.directory, "step-20"))
+        state, start = mgr.restore_or(
+            lambda: _tree(), lambda: jax.tree_util.tree_map(
+                lambda x: x, tree))
+        assert start == 11
+        fallbacks = [f for k, f in events if k == "restore.fallback"]
+        assert any(f["reason"] == "missing COMMITTED" and f["step"] == 20
+                   for f in fallbacks), fallbacks
+
+
+class TestGuardCompare:
+    def _guards(self, tmp_path, n=3, **kw):
+        return [IntegrityGuard(str(tmp_path), worker_id=i, every=2,
+                               expected=n, action="resync", **kw)
+                for i in range(n)]
+
+    def test_majority_names_minority(self, tmp_path):
+        g0, g1, g2 = self._guards(tmp_path)
+        tree = _tree()
+        bad = flip_tree_bit(tree, "params/w", bit=3)
+        g0.publish(4, g0.fingerprint.digest(tree))
+        g1.publish(4, g1.fingerprint.digest(tree))
+        g2.publish(4, g2.fingerprint.digest(bad))
+        v = g0.compare()
+        assert not v.ok and v.suspects == [2] and not v["ambiguous"]
+        assert v["majority"] == g0.fingerprint.digest(tree).hex()
+
+    def test_two_way_split_is_ambiguous(self, tmp_path):
+        g0, g1 = self._guards(tmp_path, n=2)
+        tree = _tree()
+        bad = flip_tree_bit(tree, "params/w", bit=3)
+        g0.publish(4, g0.fingerprint.digest(tree))
+        g1.publish(4, g1.fingerprint.digest(bad))
+        v = g0.compare()
+        assert not v.ok and v["ambiguous"] and v.suspects == []
+
+    def test_waits_for_all_expected_members(self, tmp_path):
+        g0, g1, g2 = self._guards(tmp_path)
+        g0.publish(4, g0.fingerprint.digest(_tree()))
+        v = g0.compare()
+        assert v.ok and v["step"] is None       # nobody else published yet
+
+    def test_history_finds_common_step_across_skew(self, tmp_path):
+        g0, g1, g2 = self._guards(tmp_path)
+        tree = _tree()
+        for g in (g0, g1, g2):
+            g.publish(2, g.fingerprint.digest(tree))
+        g0.publish(4, g0.fingerprint.digest(tree))  # g0 ran ahead
+        v = g0.compare()
+        assert v.ok and v["step"] == 2          # newest ALL have
+
+    def test_maybe_check_interval_gating(self, tmp_path):
+        (g,) = self._guards(tmp_path, n=1)
+        tree = _tree()
+        assert g.maybe_check(1, tree) is None
+        assert g.maybe_check(2, tree) is not None
+        assert g.checks == 1
+
+    def test_disabled_guard(self, tmp_path):
+        g = IntegrityGuard(str(tmp_path), every=0)
+        assert not g.enabled
+        assert g.maybe_check(2, _tree()) is None
+
+
+class TestReplayAudit:
+    def test_classification(self, tmp_path):
+        g = IntegrityGuard(str(tmp_path), every=2, expected=1)
+        tree = _tree()
+        g.last_fingerprint = g.fingerprint.digest(tree)
+        g.stash_replay(2, tree, None)
+        # replays reproduce the live state → desync (upstream divergence)
+        assert g.audit(lambda s, i: s)["verdict"] == "desync"
+        # replays agree with each other, not with live → hardware SDC
+        other = flip_tree_bit(tree, "params/w", bit=3)
+        assert g.audit(lambda s, i: other)["verdict"] == "sdc_suspect"
+        # replays disagree with each other → software nondeterminism
+        seq = [tree, other]
+        assert g.audit(
+            lambda s, i: seq.pop(0))["verdict"] == "nondeterminism"
+
+    def test_unavailable_without_stash_or_fn(self, tmp_path):
+        g = IntegrityGuard(str(tmp_path), every=2)
+        assert g.audit()["verdict"] == "unavailable"
+        g.stash_replay(2, _tree(), None)
+        assert g.audit()["verdict"] == "unavailable"
+
+
+class TestHealingLadder:
+    def test_offer_and_take_resync(self, tmp_path):
+        g0 = IntegrityGuard(str(tmp_path), worker_id=0, every=2, expected=2,
+                            action="resync", resync_timeout=2.0)
+        g2 = IntegrityGuard(str(tmp_path), worker_id=2, every=2, expected=2,
+                            action="resync", resync_timeout=2.0)
+        tree = _tree()
+        tree["resid"] = {"w": jnp.asarray(np.ones(5), jnp.float32)}
+        g0.offer_resync(4, tree)
+        healed = g2.take_resync(4, lambda: jax.tree_util.tree_map(
+            lambda x: x, tree))
+        assert healed is not None
+        assert digest_tree_host(healed).hex() == \
+            digest_tree_host(tree).hex()
+        # adopted state has rank-private leaves RESET, not copied
+        np.testing.assert_array_equal(np.asarray(healed["resid"]["w"]),
+                                      np.zeros(5, np.float32))
+
+    def test_take_resync_times_out(self, tmp_path):
+        g = IntegrityGuard(str(tmp_path), worker_id=1, every=2,
+                           resync_timeout=0.2)
+        assert g.take_resync(4, lambda: _tree()) is None
+
+    def test_resync_offers_gc_to_newest_two(self, tmp_path):
+        g = IntegrityGuard(str(tmp_path), worker_id=0, every=2)
+        tree = _tree()
+        for step in (2, 4, 6):
+            g.offer_resync(step, tree)
+        left = sorted(n for n in os.listdir(str(tmp_path / "integrity"))
+                      if n.startswith("resync-step-"))
+        assert left == ["resync-step-4", "resync-step-6"]
+
+
+class TestDoctorVerdicts:
+    def test_desync_and_sdc_findings(self):
+        events = [
+            {"kind": "integrity.desync", "step": 4,
+             "digests": {"0": "aa", "1": "aa", "2": "bb"},
+             "majority": "aa", "suspects": [2], "ambiguous": False},
+            {"kind": "integrity.audit", "verdict": "sdc_suspect",
+             "step": 4, "replay": "aa", "replay2": "aa", "live": "bb"},
+            {"kind": "integrity.heal", "step": 4, "action": "resync",
+             "suspect": True},
+        ]
+        findings = check_integrity(events)
+        kinds = {f["kind"] for f in findings}
+        assert kinds == {"desync", "sdc_suspect"}
+        sdc = next(f for f in findings if f["kind"] == "sdc_suspect")
+        desync = next(f for f in findings if f["kind"] == "desync")
+        assert sdc["severity"] > desync["severity"]
+        assert any("worker 2" in ev for ev in desync["evidence"])
+        assert any("resync" in ev for ev in desync["evidence"])
+
+    def test_healthy_run_no_findings(self):
+        assert check_integrity([{"kind": "integrity.check", "ok": True}]) \
+            == []
+
+
+# -- the e2e drill ---------------------------------------------------------
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def _make_worker(run_dir, worker_id, n_workers):
+    pt.seed(7)                    # identical init across replicas
+    net = _Net()
+    m = Model(net)
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                         parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    guard = IntegrityGuard(run_dir, worker_id=worker_id, every=2,
+                           expected=n_workers, action="resync",
+                           resync_timeout=5.0)
+    sup = RunSupervisor(
+        run_dir, worker_id=worker_id, expected_workers=n_workers,
+        sigterm_handler=False, integrity=guard,
+        report_path=os.path.join(
+            run_dir, "supervisor_report.json" if worker_id == 0
+            else f"supervisor_report-{worker_id}.json"))
+    m._supervisor = sup
+    return m, sup
+
+
+class TestE2EDrill:
+    def test_bitflip_detected_attributed_healed(self, tmp_path):
+        """The ISSUE 11 acceptance drill: 3 replicas in lockstep, one
+        bit flipped on worker 2 between a step and its digest.  The
+        interval check must catch it at the very next boundary, the
+        vote must name worker 2, the replay audit must classify
+        hardware SDC (the replays agree with each other, not with the
+        live state), the resync heal must complete the run, and the
+        final loss must be bit-equal to an un-faulted reference."""
+        run_dir = str(tmp_path / "run")
+        N_WORKERS, STEPS, FLIP_STEP = 3, 8, 4
+        workers = [_make_worker(run_dir, i, N_WORKERS)
+                   for i in range(N_WORKERS)]
+        fault = bitflip("params/fc.weight", bit=13, step=FLIP_STEP,
+                        worker=2)
+        rng = np.random.RandomState(0)
+        batches = [(rng.randn(8, 8).astype("float32"),
+                    (np.arange(8) % 4).astype("int64"))
+                   for _ in range(STEPS)]
+        losses = {i: [] for i in range(N_WORKERS)}
+        for step0, (xs, ys) in enumerate(batches):
+            step = step0 + 1
+            for i, (m, sup) in enumerate(workers):
+                loss, _ = m.train_batch(xs, ys)
+                losses[i].append(loss)
+                # the cosmic ray: flip AFTER the computed step, BEFORE
+                # the digest — the replay-auditable SDC signature
+                st = fault(step, m._supervised_state(), worker=i)
+                m._load_supervised_state(st)
+                sup.note_step_ok(m._supervised_state())
+            # fleet barrier: re-vote now that every board landed
+            for m, sup in workers:
+                sup.recheck_integrity()
+            # healing pass, majority members first (they serve the offer)
+            suspects = set()
+            for m, sup in workers:
+                if sup.pending_integrity is not None:
+                    suspects.update(sup.pending_integrity["suspects"])
+            for i, (m, sup) in enumerate(workers):
+                if sup.pending_integrity is not None and i not in suspects:
+                    m._supervised_integrity_heal(sup)
+            for i, (m, sup) in enumerate(workers):
+                if sup.pending_integrity is not None:
+                    m._supervised_integrity_heal(sup)
+        assert fault.fired == FLIP_STEP
+        # detection within ONE interval of the flip
+        g2 = workers[2][1].integrity
+        assert g2.mismatches >= 1
+        desyncs = workers[0][1].report.of_kind("integrity.desync")
+        assert desyncs and desyncs[0]["step"] == FLIP_STEP
+        assert desyncs[0]["suspects"] == [2]        # correct attribution
+        # the replay audit pinned it as hardware SDC on the suspect
+        heals = workers[2][1].report.of_kind("integrity.heal")
+        healed = [h for h in heals if h.get("action") == "resync"]
+        assert healed and healed[0]["audit"]["verdict"] == "sdc_suspect"
+        # majority members served the offer
+        assert any(h.get("action") == "offer" for h in
+                   workers[0][1].report.of_kind("integrity.heal"))
+        # post-heal: every replica converged to the same state...
+        finals = [digest_tree_host(m._supervised_state()).hex()
+                  for m, _ in workers]
+        assert len(set(finals)) == 1, finals
+        # ...and no further mismatches after the heal interval
+        assert all(w[1].integrity.last_verdict.ok for w in workers)
+        # loss parity with the un-faulted reference, bit-equal
+        pt.seed(7)
+        ref_net = _Net()
+        ref = Model(ref_net)
+        ref.prepare(optimizer=pt.optimizer.SGD(
+            learning_rate=0.1, parameters=ref_net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        ref_losses = [ref.train_batch(xs, ys)[0] for xs, ys in batches]
+        assert ref_losses[-1] == losses[0][-1]
+        assert digest_tree_host(ref._supervised_state()).hex() == finals[0]
+        # the healed worker diverged only inside the detection window
+        assert losses[2][:FLIP_STEP] == ref_losses[:FLIP_STEP]
+        assert losses[2][-1] == ref_losses[-1]
+
+    def test_statusz_integrity_section(self, tmp_path):
+        from paddle_tpu.observability.monitor import StatusServer
+        run_dir = str(tmp_path / "run")
+        m, sup = _make_worker(run_dir, 0, 1)
+        xs = np.random.RandomState(0).randn(8, 8).astype("float32")
+        ys = (np.arange(8) % 4).astype("int64")
+        for _ in range(4):
+            m.train_batch(xs, ys)
+            sup.note_step_ok(m._supervised_state())
+        sz = StatusServer(supervisor=sup).statusz()
+        integ = sz["integrity"]
+        assert integ["enabled"] and integ["interval"] == 2
+        assert integ["checks"] == 2 and integ["mismatches"] == 0
+        assert integ["last_digest"] is not None
+        assert integ["last_verdict"]["ok"] is True

@@ -30,6 +30,7 @@ if (( SHARD == 0 )); then
     python tools/print_signatures.py --check
     python tools/lint_bare_except.py
     python tools/lint_print.py
+    python tools/lint_fsio.py
     # resilience tier: the fault-injection suite must stay green even when
     # sharding happens to place its files elsewhere
     python -m pytest -q -m faults tests/test_fault_tolerance.py \
@@ -247,11 +248,112 @@ print("elastic smoke: SIGKILL drill — shrink + re-expand recorded, "
       f"{world['generation']}")
 PYEOF
     rm -rf "$ELASTIC_TMP"
+    # integrity tier (ISSUE 11): fingerprint/guard/heal units + the
+    # cross-width relayout invariance drill in the elastic suite
+    python -m pytest -q -m integrity tests/test_integrity.py \
+        tests/test_elastic_fleet.py
+    # integrity smoke (ISSUE 11 acceptance): 3 lockstep replicas, one
+    # injected bitflip — detected within one interval, attributed to the
+    # right worker by majority vote, classified hardware-SDC by the
+    # replay audit, healed by resync, and the healed run's final state
+    # must be bit-identical to an un-faulted reference
+    INTEG_TMP=$(mktemp -d)
+    JAX_PLATFORMS=cpu python - "$INTEG_TMP" <<'PYEOF'
+import os, sys
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.distributed.fingerprint import digest_tree_host
+from paddle_tpu.hapi import Model
+from paddle_tpu.supervisor import RunSupervisor
+from paddle_tpu.supervisor.integrity import IntegrityGuard
+from paddle_tpu.testing.faults import bitflip
+
+run_dir = sys.argv[1]
+
+def worker(i, n):
+    pt.seed(7)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    m = Model(net)
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                         parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    guard = IntegrityGuard(run_dir, worker_id=i, every=2, expected=n,
+                           action="resync", resync_timeout=5.0)
+    sup = RunSupervisor(
+        run_dir, worker_id=i, expected_workers=n, sigterm_handler=False,
+        integrity=guard, report_path=os.path.join(
+            run_dir, "supervisor_report.json" if i == 0
+            else f"supervisor_report-{i}.json"))
+    sup.attach(m)
+    return m, sup
+
+N, STEPS, FLIP = 3, 8, 4
+workers = [worker(i, N) for i in range(N)]
+fault = bitflip("params/0.weight", bit=13, step=FLIP, worker=2)
+rng = np.random.RandomState(0)
+batches = [(rng.randn(8, 8).astype("float32"),
+            (np.arange(8) % 4).astype("int64")) for _ in range(STEPS)]
+losses = {i: [] for i in range(N)}
+for step0, (xs, ys) in enumerate(batches):
+    for i, (m, sup) in enumerate(workers):
+        losses[i].append(m.train_batch(xs, ys)[0])
+        m._load_supervised_state(
+            fault(step0 + 1, m._supervised_state(), worker=i))
+        sup.note_step_ok(m._supervised_state())
+    for m, sup in workers:
+        sup.recheck_integrity()
+    suspects = set()
+    for m, sup in workers:
+        if sup.pending_integrity is not None:
+            suspects.update(sup.pending_integrity["suspects"])
+    for i, (m, sup) in enumerate(workers):
+        if sup.pending_integrity is not None and i not in suspects:
+            m._supervised_integrity_heal(sup)
+    for i, (m, sup) in enumerate(workers):
+        if sup.pending_integrity is not None:
+            m._supervised_integrity_heal(sup)
+assert fault.fired == FLIP, "bitflip never fired"
+desyncs = workers[0][1].report.of_kind("integrity.desync")
+assert desyncs and desyncs[0]["step"] == FLIP, desyncs  # one interval
+assert desyncs[0]["suspects"] == [2], desyncs[0]        # right worker
+heals = workers[2][1].report.of_kind("integrity.heal")
+resyncs = [h for h in heals if h.get("action") == "resync"]
+assert resyncs and resyncs[0]["audit"]["verdict"] == "sdc_suspect", heals
+finals = {digest_tree_host(m._supervised_state()).hex()
+          for m, _ in workers}
+assert len(finals) == 1, finals
+pt.seed(7)
+ref_net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+ref = Model(ref_net)
+ref.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                       parameters=ref_net.parameters()),
+            loss=nn.CrossEntropyLoss())
+ref_losses = [ref.train_batch(xs, ys)[0] for xs, ys in batches]
+assert digest_tree_host(ref._supervised_state()).hex() in finals, \
+    "healed fleet diverged from the un-faulted reference"
+assert losses[0][-1] == ref_losses[-1]
+print(f"integrity smoke: bitflip at step {FLIP} detected same interval, "
+      "attributed to worker 2 (sdc_suspect), resync-healed, final state "
+      "bit-equal to un-faulted reference")
+PYEOF
+    rm -rf "$INTEG_TMP"
+    # integrity overhead bound (ISSUE 11 acceptance): the per-check cost
+    # amortized over the default interval must stay under 1% of step time
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+import bench
+rows = bench._bench_integrity_overhead(artifact=False,
+                                       **bench._SMOKE_INTEGRITY_AB)
+frac = rows["integrity"]["overhead_frac"]
+assert frac < 0.01, f"integrity overhead {frac:.3%} >= 1% of step time"
+print(f"integrity overhead: {frac:.3%} of step time (< 1% bound)")
+PYEOF
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
     echo "api-guard + lints + faults tier + telemetry tier + doctor" \
          "smoke + monitor smoke + serving tier + serve smoke + kernels" \
          "tier + fused-block smoke + comm tier + comm smoke + elastic" \
-         "tier + elastic smoke + bench smoke ok"
+         "tier + elastic smoke + integrity tier + integrity smoke +" \
+         "integrity overhead + bench smoke ok"
 fi
 echo "shard ${SHARD} green"
