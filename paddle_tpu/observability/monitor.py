@@ -420,12 +420,17 @@ class LiveAggregator:
         self.report = report
         self._registry = registry
         self._clock = clock
-        self._tails: Dict[int, StreamTail] = {}
-        self._windows: Dict[int, deque] = {}
-        self._alerted: set = set()
-        self.alerts: List[Dict[str, Any]] = []
-        self.polls = 0
-        self._last_poll = 0.0
+        # poll() runs on both the background thread (_run) and the main
+        # thread (stop()'s final sweep, or a caller's own loop); all
+        # window/alert state is shared and guarded.  An RLock so the
+        # helpers can self-acquire under a poll() that already holds it.
+        self._poll_lock = threading.RLock()
+        self._tails: Dict[int, StreamTail] = {}      # guarded_by: _poll_lock
+        self._windows: Dict[int, deque] = {}         # guarded_by: _poll_lock
+        self._alerted: set = set()                   # guarded_by: _poll_lock
+        self.alerts: List[Dict[str, Any]] = []       # guarded_by: _poll_lock
+        self.polls = 0                               # guarded_by: _poll_lock
+        self._last_poll = 0.0                        # guarded_by: _poll_lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -440,27 +445,31 @@ class LiveAggregator:
         mdir = metrics_dir(self.run_dir)
         if not os.path.isdir(mdir):
             return
-        for name in sorted(os.listdir(mdir)):
-            m = _WORKER_RE.match(name)
-            if m and int(m.group(1)) not in self._tails:
-                wid = int(m.group(1))
-                self._tails[wid] = StreamTail(os.path.join(mdir, name))
-                self._windows[wid] = deque(maxlen=self.window)
+        with self._poll_lock:
+            for name in sorted(os.listdir(mdir)):
+                m = _WORKER_RE.match(name)
+                if m and int(m.group(1)) not in self._tails:
+                    wid = int(m.group(1))
+                    self._tails[wid] = StreamTail(os.path.join(mdir, name))
+                    self._windows[wid] = deque(maxlen=self.window)
 
     def _ingest(self) -> int:
         self._discover()
         fresh = 0
-        for wid, tail in self._tails.items():
-            recs = tail.poll()
-            if recs:
-                self._windows[wid].extend(recs)
-                fresh += len(recs)
+        with self._poll_lock:
+            for wid, tail in self._tails.items():
+                recs = tail.poll()
+                if recs:
+                    self._windows[wid].extend(recs)
+                    fresh += len(recs)
         return fresh
 
     # -- rules over the window ---------------------------------------------
     def _evaluate(self) -> List[Dict[str, Any]]:
         from . import doctor
-        workers = {wid: list(w) for wid, w in self._windows.items() if w}
+        with self._poll_lock:
+            workers = {wid: list(w)
+                       for wid, w in self._windows.items() if w}
         if not workers:
             return []
         findings: List[Dict[str, Any]] = []
@@ -481,13 +490,14 @@ class LiveAggregator:
     def _raise_alerts(self, findings: List[Dict[str, Any]]) -> None:
         for f in findings:
             key = self._alert_key(f)
-            if key in self._alerted:
-                continue
-            self._alerted.add(key)
-            alert = {"kind": f["kind"], "severity": f["severity"],
-                     "title": f["title"], "evidence": f["evidence"],
-                     "first_seen": float(self._clock())}
-            self.alerts.append(alert)
+            with self._poll_lock:
+                if key in self._alerted:
+                    continue
+                self._alerted.add(key)
+                alert = {"kind": f["kind"], "severity": f["severity"],
+                         "title": f["title"], "evidence": f["evidence"],
+                         "first_seen": float(self._clock())}
+                self.alerts.append(alert)
             vlog(0, "monitor: ALERT [%d] %s: %s", f["severity"],
                  f["kind"], f["title"])
             reg = self._reg()
@@ -508,11 +518,12 @@ class LiveAggregator:
         """One tail-read + rule pass; throttled to ``interval`` unless
         ``force``.  Returns the status dict written to
         ``live_status.json`` (None when throttled)."""
-        now = float(self._clock())
-        if not force and now - self._last_poll < self.interval:
-            return None
-        self._last_poll = now
-        self.polls += 1
+        with self._poll_lock:
+            now = float(self._clock())
+            if not force and now - self._last_poll < self.interval:
+                return None
+            self._last_poll = now
+            self.polls += 1
         self._ingest()
         findings = self._evaluate()
         self._raise_alerts(findings)
@@ -528,32 +539,36 @@ class LiveAggregator:
 
     def _status(self, now: float,
                 findings: List[Dict[str, Any]]) -> Dict[str, Any]:
-        last_step: Dict[str, Any] = {}
-        records_seen: Dict[str, int] = {}
-        for wid, window in self._windows.items():
-            steps = [r.get("step") for r in window
-                     if r.get("kind") == "step" and r.get("step") is not None]
-            last_step[str(wid)] = steps[-1] if steps else None
-            records_seen[str(wid)] = len(window)
-        drops: Dict[str, int] = {}
-        for tail in self._tails.values():
-            for k, v in tail.drops.items():
-                drops[k] = drops.get(k, 0) + v
-        workers = {wid: list(w) for wid, w in self._windows.items() if w}
-        return {
-            "ts": now,
-            "run_dir": os.path.abspath(self.run_dir),
-            "polls": self.polls,
-            "workers": sorted(self._tails),
-            "last_step": last_step,
-            "window_records": records_seen,
-            "dropped": drops,
-            "healthy": not findings,
-            "findings": findings,
-            "alerts": self.alerts,
-            "straggler": straggler_stats(workers) if len(workers) > 1
-            else None,
-        }
+        with self._poll_lock:
+            last_step: Dict[str, Any] = {}
+            records_seen: Dict[str, int] = {}
+            for wid, window in self._windows.items():
+                steps = [r.get("step") for r in window
+                         if r.get("kind") == "step"
+                         and r.get("step") is not None]
+                last_step[str(wid)] = steps[-1] if steps else None
+                records_seen[str(wid)] = len(window)
+            drops: Dict[str, int] = {}
+            for tail in self._tails.values():
+                for k, v in tail.drops.items():
+                    drops[k] = drops.get(k, 0) + v
+            workers = {wid: list(w) for wid, w in self._windows.items() if w}
+            return {
+                "ts": now,
+                "run_dir": os.path.abspath(self.run_dir),
+                "polls": self.polls,
+                "workers": sorted(self._tails),
+                "last_step": last_step,
+                "window_records": records_seen,
+                "dropped": drops,
+                "healthy": not findings,
+                "findings": findings,
+                # snapshot: the caller serializes this dict after the
+                # lock is released, while alerts may keep growing
+                "alerts": list(self.alerts),
+                "straggler": straggler_stats(workers) if len(workers) > 1
+                else None,
+            }
 
     # -- background-thread form --------------------------------------------
     def start(self) -> "LiveAggregator":

@@ -96,7 +96,9 @@ def _arr(x):
 def fused_block_route() -> str:
     """'pallas' or 'reference' — which implementation the fused-block ops
     take on this backend (before per-shape legality)."""
-    forced = os.environ.get(FUSED_BLOCK_ENV, "")
+    # deliberate trace-time pin: the route IS part of the trace signature
+    # (a retrace re-reads it; flipping mid-run is not supported)
+    forced = os.environ.get(FUSED_BLOCK_ENV, "")  # noqa: trace — route pinned at trace time by design
     if forced in ("pallas", "reference"):
         return forced
     from ..framework import flags as _flags

@@ -109,9 +109,12 @@ def program_guard(main_program: Program,
     nothing here.)"""
     global _default_main, _default_startup
     prev_m, prev_s = _default_main, _default_startup
-    _default_main = main_program
+    # trace-time scope bookkeeping, not traced state: the default-program
+    # pointer is swapped so static.nn helpers resolve the right Program
+    # while its function traces, and restored in the finally below
+    _default_main = main_program  # noqa: trace — restored in finally, see above
     if startup_program is not None:
-        _default_startup = startup_program
+        _default_startup = startup_program  # noqa: trace — restored in finally, see above
     try:
         yield
     finally:

@@ -73,9 +73,12 @@ class HeartbeatWriter:
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.beats = 0
-        self._last_step: Optional[int] = None
-        self._last_beat = 0.0
+        # beat() runs on both the daemon thread and the training loop
+        # (maybe_beat); the beat state is shared and lock-guarded
+        self._lock = threading.Lock()
+        self.beats = 0                          # guarded_by: _lock
+        self._last_step: Optional[int] = None   # guarded_by: _lock
+        self._last_beat = 0.0                   # guarded_by: _lock
         #: world generation stamped into every beat when set (elastic
         #: fleets: lets any reader spot a zombie from an older world)
         self.generation: Optional[int] = None
@@ -85,31 +88,36 @@ class HeartbeatWriter:
         return _beat_path(self.run_dir, self.worker_id)
 
     def beat(self, step: Optional[int] = None) -> None:
-        if step is not None:
-            self._last_step = int(step)
-        payload = {"worker": self.worker_id, "pid": os.getpid(),
-                   "time": float(self._clock()), "step": self._last_step,
-                   "beats": self.beats}
-        if self.generation is not None:
-            payload["generation"] = int(self.generation)
-        os.makedirs(heartbeat_dir(self.run_dir), exist_ok=True)
-        try:
-            fsio.atomic_write_bytes(
-                self.path, json.dumps(payload).encode("utf-8"))
-            self.beats += 1
-            self._last_beat = payload["time"]
-        except OSError as e:
-            # a failed beat must not kill the worker it describes; the
-            # monitor sees staleness, which is the correct signal anyway
-            vlog(0, "heartbeat: write to %s failed: %s", self.path, e)
+        # held across the write too: a concurrent loop-beat and
+        # thread-beat must not interleave payload vs counter bumps
+        with self._lock:
+            if step is not None:
+                self._last_step = int(step)
+            payload = {"worker": self.worker_id, "pid": os.getpid(),
+                       "time": float(self._clock()),
+                       "step": self._last_step,
+                       "beats": self.beats}
+            if self.generation is not None:
+                payload["generation"] = int(self.generation)
+            os.makedirs(heartbeat_dir(self.run_dir), exist_ok=True)
+            try:
+                fsio.atomic_write_bytes(
+                    self.path, json.dumps(payload).encode("utf-8"))
+                self.beats += 1
+                self._last_beat = payload["time"]
+            except OSError as e:
+                # a failed beat must not kill the worker it describes; the
+                # monitor sees staleness, which is the correct signal anyway
+                vlog(0, "heartbeat: write to %s failed: %s", self.path, e)
 
     def maybe_beat(self, step: Optional[int] = None) -> bool:
         """Beat only when half an interval has passed — the training loop
         can call this every step without fsync'ing every step."""
-        if step is not None:
-            self._last_step = int(step)  # freshest step even when skipping
-        if float(self._clock()) - self._last_beat < self.interval / 2.0:
-            return False
+        with self._lock:
+            if step is not None:
+                self._last_step = int(step)  # freshest step even when skipping
+            if float(self._clock()) - self._last_beat < self.interval / 2.0:
+                return False
         self.beat(step)
         return True
 

@@ -63,6 +63,10 @@ def pytest_configure(config):
         "fingerprint, desync attribution, replay audit, healing "
         "ladder, checkpoint digest round trip; ci.sh runs this tier "
         "explicitly)")
+    config.addinivalue_line(
+        "markers", "ptlint: static-analysis engine tests (pass "
+        "fixtures, annotation grammar, baseline workflow, whole-repo "
+        "smoke; ci.sh runs this tier explicitly)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,462 @@
+"""ptlint engine tests (ISSUE 12): seeded-bug fixtures per pass, the
+noqa / ``# guarded_by:`` annotation grammar, the baseline workflow, the
+deprecation shims, and the whole-repo smoke (the package itself must be
+clean against the checked-in baseline)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.ptlint import (Project, load_baseline, new_findings,  # noqa: E402
+                          run_passes, write_baseline)
+from tools.ptlint.__main__ import main as ptlint_main  # noqa: E402
+
+pytestmark = pytest.mark.ptlint
+
+
+def _lint(tmp_path, source, passes, docs="", name="snippet.py"):
+    """Write one fixture module + docs file, lint it, return findings."""
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    docs_path = tmp_path / "ARCH.md"
+    docs_path.write_text(docs)
+    project = Project([str(path)], repo_root=str(tmp_path),
+                      docs_path=str(docs_path))
+    return run_passes(project, passes)
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+class TestTraceSafety:
+    def test_impure_helper_names_the_jit_entry(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import time
+            import jax
+
+            def helper(x):
+                return x + time.time()
+
+            def step(x):
+                return helper(x) * 2
+
+            fast = jax.jit(step)
+        """, ["trace"])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.pass_name == "trace" and f.code == "impure-call"
+        assert "time.time" in f.message
+        # the finding must name the jit entry whose trace is poisoned,
+        # not just the helper the impurity sits in
+        assert "helper" in f.message and "::step" in f.message
+        assert "jax.jit" in f.message
+
+    def test_decorator_form_env_read_and_rng(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import os
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def step(x):
+                scale = float(os.environ.get("SCALE", "1"))
+                noise = np.random.randn(4)
+                return x * scale + noise
+        """, ["trace"])
+        codes = sorted((f.code, f.message.split("`")[1]) for f in fs)
+        assert ("impure-call", "os.environ.get()") in codes
+        assert any("np.random.randn" in m for _c, m in codes)
+
+    def test_pallas_kernel_body_print(self, tmp_path):
+        fs = _lint(tmp_path, """
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                print("dbg")
+                o_ref[...] = x_ref[...]
+
+            def run(x):
+                return pl.pallas_call(kernel, out_shape=x)(x)
+        """, ["trace"])
+        assert len(fs) == 1
+        assert "print()" in fs[0].message
+        assert "pallas_call" in fs[0].message and "kernel" in fs[0].message
+
+    def test_concretization_is_a_warning(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.mean().item()
+        """, ["trace"])
+        assert len(fs) == 1
+        assert fs[0].code == "concretize"
+        assert fs[0].severity == "warning"
+
+    def test_global_mutation(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import jax
+
+            _CALLS = 0
+
+            @jax.jit
+            def step(x):
+                global _CALLS
+                _CALLS += 1
+                return x
+        """, ["trace"])
+        assert len(fs) == 1
+        assert fs[0].code == "global-mutation" and "_CALLS" in fs[0].message
+
+    def test_defvjp_bodies_are_roots(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import os
+            import jax
+
+            @jax.custom_vjp
+            def op(x):
+                return x * 2
+
+            def op_fwd(x):
+                if os.environ.get("PTPU_DEBUG"):
+                    pass
+                return op(x), x
+
+            def op_bwd(res, g):
+                return (g,)
+
+            op.defvjp(op_fwd, op_bwd)
+        """, ["trace"])
+        assert any(f.code == "impure-call" and "op_fwd" in f.message
+                   for f in fs)
+
+    def test_unreachable_impurity_is_not_flagged(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import time
+            import jax
+
+            def host_side():
+                return time.time()
+
+            @jax.jit
+            def step(x):
+                return x * 2
+        """, ["trace"])
+        assert fs == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t = time.time()  # noqa: trace — fixture: deliberate
+                return x + t
+        """, ["trace"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+_RACY = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.count = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def bump(self):
+            self.count += 1
+"""
+
+
+class TestLockDiscipline:
+    def test_dual_write_names_attr_and_both_contexts(self, tmp_path):
+        fs = _lint(tmp_path, _RACY, ["locks"])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.code == "unguarded-field"
+        # names the attribute AND both access contexts
+        assert "self.count" in f.message and "Worker" in f.message
+        assert "_run" in f.message and "bump" in f.message
+        assert "guarded_by" in f.message
+
+    def test_thread_only_helper_is_not_dual(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.ticks = 0
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    while True:
+                        self._tick()
+
+                def _tick(self):
+                    self.ticks += 1
+        """, ["locks"])
+        assert fs == []
+
+    def test_thread_subclass_run(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import threading
+
+            class Beater(threading.Thread):
+                def __init__(self):
+                    super().__init__(daemon=True)
+                    self.beats = 0
+
+                def run(self):
+                    while True:
+                        self.beats += 1
+
+                def poke(self):
+                    self.beats += 1
+        """, ["locks"])
+        assert [f.symbol for f in fs] == ["Beater.beats"]
+
+    def test_guarded_by_annotation_and_lexical_lock(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0  # guarded_by: _lock
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+
+                def sloppy(self):
+                    self.count += 1
+        """, ["locks"])
+        # annotation kills the unguarded-field finding; the one access
+        # outside `with self._lock:` is the only violation left
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.code == "unlocked-access"
+        assert "self.count" in f.message and "_lock" in f.message
+        assert "sloppy" in f.message
+
+    def test_noqa_locks_suppresses(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.count += 1  # noqa: locks — fixture: display only
+
+                def bump(self):
+                    self.count += 1
+        """, ["locks"])
+        assert fs == []
+
+    def test_nested_thread_body_with_self_alias(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import threading
+
+            class Saver:
+                def __init__(self):
+                    self.commits = 0
+
+                def save(self):
+                    mgr = self
+
+                    def _finish():
+                        mgr.commits += 1
+
+                    threading.Thread(target=_finish).start()
+
+                def note(self):
+                    self.commits += 1
+        """, ["locks"])
+        assert [f.symbol for f in fs] == ["Saver.commits"]
+        assert "_finish" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# env-knob inventory
+# ---------------------------------------------------------------------------
+class TestKnobInventory:
+    SRC = """
+        import os
+        INTERVAL = float(os.environ.get("PTPU_FIXTURE_KNOB", "5"))
+    """
+
+    def test_undocumented_knob(self, tmp_path):
+        fs = _lint(tmp_path, self.SRC, ["knobs"], docs="no tables here")
+        assert [f.symbol for f in fs] == ["PTPU_FIXTURE_KNOB"]
+        assert "PTPU_FIXTURE_KNOB" in fs[0].message
+
+    def test_documented_knob_passes(self, tmp_path):
+        fs = _lint(tmp_path, self.SRC, ["knobs"],
+                   docs="| `PTPU_FIXTURE_KNOB` | 5 | fixture interval |")
+        assert fs == []
+
+    def test_substring_of_longer_knob_does_not_count(self, tmp_path):
+        # PTPU_FIXTURE_KNOB must not ride on PTPU_FIXTURE_KNOB_MAX
+        fs = _lint(tmp_path, self.SRC, ["knobs"],
+                   docs="| `PTPU_FIXTURE_KNOB_MAX` | 9 | something else |")
+        assert [f.symbol for f in fs] == ["PTPU_FIXTURE_KNOB"]
+
+    def test_noqa_knobs_suppresses(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import os
+            X = os.environ.get("PTPU_SECRET_HOOK")  # noqa: knobs — internal
+        """, ["knobs"], docs="")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# absorbed legacy lints
+# ---------------------------------------------------------------------------
+class TestAbsorbedLints:
+    def test_bare_except(self, tmp_path):
+        fs = _lint(tmp_path, """
+            def f():
+                try:
+                    risky()
+                except:
+                    pass
+        """, ["bare_except"])
+        assert [f.code for f in fs] == ["bare-except"]
+
+    def test_swallow_and_noqa(self, tmp_path):
+        src = """
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    pass{noqa}
+        """
+        assert [f.code for f in
+                _lint(tmp_path, src.format(noqa=""), ["bare_except"])] \
+            == ["swallow"]
+        assert _lint(tmp_path,
+                     src.format(noqa="  # noqa: swallow — fixture"),
+                     ["bare_except"]) == []
+
+    def test_print_and_noqa(self, tmp_path):
+        src = """
+            def f():
+                print("hello"){noqa}
+        """
+        assert [f.code for f in
+                _lint(tmp_path, src.format(noqa=""), ["print"])] == ["print"]
+        assert _lint(tmp_path, src.format(noqa="  # noqa: print — fixture"),
+                     ["print"]) == []
+
+    def test_fsio_write_open_and_replace(self, tmp_path):
+        fs = _lint(tmp_path, """
+            import os
+
+            def f(path):
+                data = open(path).read()          # read mode: fine
+                with open(path, "w") as fh:       # raw write: flagged
+                    fh.write(data)
+                os.replace(path + ".tmp", path)   # flagged
+                os.replace(path, path + ".bak")   # noqa: fsio — fixture
+        """, ["fsio"])
+        assert sorted(f.code for f in fs) == ["open-write", "os-replace"]
+
+
+# ---------------------------------------------------------------------------
+# engine: baseline workflow + CLI
+# ---------------------------------------------------------------------------
+class TestBaselineWorkflow:
+    def test_baselined_findings_do_not_fail(self, tmp_path):
+        fs = _lint(tmp_path, "def f():\n    print('x')\n", ["print"])
+        assert len(fs) == 1
+        base = tmp_path / "baseline.json"
+        write_baseline(fs, str(base))
+        assert new_findings(fs, load_baseline(str(base))) == []
+        # a NEW finding (different symbol) still fails
+        fs2 = _lint(tmp_path, "def f():\n    print('x')\n"
+                              "def g():\n    print('y')\n", ["print"])
+        fresh = new_findings(fs2, load_baseline(str(base)))
+        assert [f.symbol for f in fresh] == ["g"]
+
+    def test_fingerprints_are_line_free(self, tmp_path):
+        fs1 = _lint(tmp_path, "def f():\n    print('x')\n", ["print"])
+        fs2 = _lint(tmp_path, "\n\n\ndef f():\n    print('x')\n", ["print"])
+        assert fs1[0].line != fs2[0].line
+        assert fs1[0].fingerprint == fs2[0].fingerprint
+
+    def test_syntax_error_is_a_parse_finding(self, tmp_path):
+        fs = _lint(tmp_path, "def broken(:\n", ["print"])
+        assert [f.pass_name for f in fs] == ["parse"]
+
+
+class TestCli:
+    def test_json_output(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f():\n    print('x')\n")
+        rc = ptlint_main(["--pass", "print", "--no-baseline", "--json",
+                          str(bad)])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["new"] == 1
+        (f,) = payload["findings"]
+        assert f["pass"] == "print" and f["line"] == 2 and f["new"]
+
+    def test_unknown_pass_is_a_usage_error(self, tmp_path, capsys):
+        assert ptlint_main(["--pass", "nope", str(tmp_path)]) == 2
+
+    def test_shims_reexec_the_engine(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text('with open("f", "w") as fh:\n    fh.write("x")\n')
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint_fsio.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=str(tmp_path))
+        assert out.returncode == 1, out.stderr
+        assert "bad.py:1" in out.stdout
+        assert "ptlint" in out.stderr  # the deprecation note
+
+
+# ---------------------------------------------------------------------------
+# whole-repo smoke: the package itself is clean against the baseline
+# ---------------------------------------------------------------------------
+class TestRepoClean:
+    def test_package_passes_all_passes(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.ptlint", "--all"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, f"\n{out.stdout}\n{out.stderr}"

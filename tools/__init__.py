@@ -1,0 +1,2 @@
+# makes tools/ importable so `python -m tools.ptlint` resolves from the
+# repo root (the lint shims exec it that way)

@@ -28,9 +28,11 @@ fi
 
 if (( SHARD == 0 )); then
     python tools/print_signatures.py --check
-    python tools/lint_bare_except.py
-    python tools/lint_print.py
-    python tools/lint_fsio.py
+    # static analysis (ISSUE 12): one engine, one AST parse — the three
+    # legacy lints plus trace-safety / lock-discipline / knob inventory,
+    # gated by tools/ptlint/baseline.json
+    python -m tools.ptlint --all
+    python -m pytest -q tests/test_ptlint.py
     # resilience tier: the fault-injection suite must stay green even when
     # sharding happens to place its files elsewhere
     python -m pytest -q -m faults tests/test_fault_tolerance.py \
@@ -350,7 +352,7 @@ print(f"integrity overhead: {frac:.3%} of step time (< 1% bound)")
 PYEOF
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
-    echo "api-guard + lints + faults tier + telemetry tier + doctor" \
+    echo "api-guard + ptlint + faults tier + telemetry tier + doctor" \
          "smoke + monitor smoke + serving tier + serve smoke + kernels" \
          "tier + fused-block smoke + comm tier + comm smoke + elastic" \
          "tier + elastic smoke + integrity tier + integrity smoke +" \

@@ -1,96 +1,36 @@
 #!/usr/bin/env python
-"""Reject bare ``except:`` clauses — and silent ``except Exception: pass``
-swallowing — in paddle_tpu/ (resilience hygiene).
+"""Deprecated shim — this lint is now the ptlint ``bare_except`` pass.
 
-A bare except swallows KeyboardInterrupt/SystemExit and — worse for the
-fault-tolerance layer — silently eats the SIGTERM-driven control flow and
-corruption errors the restore fallback chain depends on seeing.  Every
-handler must name what it catches (``except Exception:`` at minimum).
+The standalone walker was absorbed into the unified engine (one shared
+AST parse for every pass; see tools/ptlint/ and docs/ARCHITECTURE.md
+"Static analysis").  This file stays so muscle memory and old scripts
+keep working; it just re-execs
 
-An ``except Exception: pass`` (or ``except BaseException: pass``) names
-what it catches and then discards it anyway — the run supervisor (ISSUE 2)
-exists precisely because swallowed failures turn into silent hangs and
-divergence.  Handlers that legitimately must swallow (finalizers,
-best-effort shutdown paths) carry an explicit ``# noqa: swallow`` comment
-on the ``except`` or ``pass`` line.
+    python -m tools.ptlint --no-baseline --pass bare_except [root ...]
 
-Usage: ``python tools/lint_bare_except.py [root ...]`` (default:
-``paddle_tpu/``).  Exits 1 listing ``file:line`` for every violation.
+preserving the exit status and ``path:line: message`` output contract.
 """
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-_NOQA = "# noqa: swallow"
-_BROAD = {"Exception", "BaseException"}
+_PASS = "bare_except"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _is_swallow(node: ast.ExceptHandler) -> bool:
-    """True for ``except Exception/BaseException [as e]: pass``."""
-    if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
-        return False
-    t = node.type
-    return (t is None or (isinstance(t, ast.Name) and t.id in _BROAD)
-            or (isinstance(t, ast.Attribute) and t.attr in _BROAD))
-
-
-def find_violations(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [(getattr(e, "lineno", 0) or 0, f"syntax error: {e.msg}")]
-    lines = source.decode("utf-8", errors="replace").splitlines()
-
-    def allowlisted(node: ast.ExceptHandler) -> bool:
-        check = {node.lineno, node.body[0].lineno if node.body else 0}
-        return any(_NOQA in lines[n - 1] for n in check
-                   if 0 < n <= len(lines))
-
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.type is None:
-            out.append((node.lineno, "bare except"))
-        elif _is_swallow(node) and not allowlisted(node):
-            out.append((node.lineno,
-                        "swallowed exception (`except Exception: pass`) — "
-                        "handle it, narrow it, or mark `# noqa: swallow`"))
-    return out
-
-
-# back-compat alias (pre-ISSUE-2 name)
-find_bare_excepts = find_violations
-
-
-def main(argv):
-    roots = argv or [os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "paddle_tpu")]
-    violations = []
-    checked = 0
-    for root in roots:
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                full = os.path.join(dirpath, name)
-                checked += 1
-                for lineno, what in find_violations(full):
-                    violations.append(f"{os.path.relpath(full)}:{lineno}: "
-                                      f"{what}")
-    if violations:
-        print("\n".join(violations))
-        print(f"\n{len(violations)} violation(s) found — name the "
-              "exception (at minimum `except Exception:`) and don't "
-              "swallow it silently")
-        return 1
-    print(f"bare-except/swallow lint: {checked} files clean")
-    return 0
+def main() -> None:
+    # absolute roots: the shim may be invoked from any cwd, while the
+    # engine resolves relative paths against its own repo root
+    roots = [os.path.abspath(r) for r in sys.argv[1:]]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    sys.stderr.write(
+        f"note: tools/{os.path.basename(__file__)} is a shim - "
+        f"use `python -m tools.ptlint --pass {_PASS}`\n")
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "tools.ptlint", "--no-baseline",
+               "--pass", _PASS] + roots, env)
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1:]))
+    main()

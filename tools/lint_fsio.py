@@ -1,130 +1,34 @@
 #!/usr/bin/env python
-"""Reject durable writes that bypass the ``utils/fsio`` seam (ISSUE 11).
+"""Deprecated shim — this lint is now the ptlint ``fsio`` pass.
 
-Every durable byte in this codebase is supposed to flow through
-``paddle_tpu.utils.fsio`` — ``write_bytes`` / ``atomic_write_bytes`` /
-``append_bytes`` — because that seam is where fsync discipline, the
-fault injector (``testing/faults.FaultInjector``) and the integrity
-guard's channel guarantees all live.  A raw ``open(path, "w")`` or a
-bare ``os.replace`` sidesteps all three: the write isn't fsync'd (torn
-on power loss), fault drills can't see it, and the restore fallback
-chain can't reason about its commit point.
+The standalone walker was absorbed into the unified engine (one shared
+AST parse for every pass; see tools/ptlint/ and docs/ARCHITECTURE.md
+"Static analysis").  This file stays so muscle memory and old scripts
+keep working; it just re-execs
 
-Flagged:
+    python -m tools.ptlint --no-baseline --pass fsio [root ...]
 
-- ``open(..., mode)`` with any write mode (``w``, ``a``, ``x`` or
-  ``+``) — reads are fine;
-- ``os.replace(...)`` — the atomic-rename commit step must pair with a
-  directory fsync, which only ``fsio`` and the checkpoint committer do.
-
-Deliberate bypasses (the fault injector's corruption helpers, the
-checkpoint committer's own rename+fsync pair) carry an explicit
-``# noqa: fsio`` comment on the offending line.  ``utils/fsio.py``
-itself is exempt — it IS the seam.
-
-Usage: ``python tools/lint_fsio.py [root ...]`` (default:
-``paddle_tpu/``).  Exits 1 listing ``file:line`` for every violation.
+preserving the exit status and ``path:line: message`` output contract.
 """
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-_NOQA = "# noqa: fsio"
-_EXEMPT = {os.path.join("paddle_tpu", "utils", "fsio.py")}
-_WRITE_CHARS = set("wax+")
+_PASS = "fsio"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _mode_of(call: ast.Call):
-    """The mode argument of an ``open()`` call, if literal."""
-    if len(call.args) >= 2:
-        arg = call.args[1]
-    else:
-        arg = next((kw.value for kw in call.keywords
-                    if kw.arg == "mode"), None)
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-        return arg.value
-    return None
-
-
-def _is_write_open(node: ast.Call) -> bool:
-    fn = node.func
-    if not (isinstance(fn, ast.Name) and fn.id == "open"):
-        return False
-    mode = _mode_of(node)
-    if mode is None:  # default "r", or dynamic (give it the benefit)
-        return len(node.args) >= 2 or any(
-            kw.arg == "mode" for kw in node.keywords)
-    return bool(set(mode) & _WRITE_CHARS)
-
-
-def _is_os_replace(node: ast.Call) -> bool:
-    fn = node.func
-    return (isinstance(fn, ast.Attribute) and fn.attr == "replace"
-            and isinstance(fn.value, ast.Name) and fn.value.id == "os")
-
-
-def find_violations(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [(getattr(e, "lineno", 0) or 0, f"syntax error: {e.msg}")]
-    lines = source.decode("utf-8", errors="replace").splitlines()
-
-    def allowlisted(node: ast.Call) -> bool:
-        span = range(node.lineno,
-                     (getattr(node, "end_lineno", node.lineno)
-                      or node.lineno) + 1)
-        return any(_NOQA in lines[n - 1] for n in span
-                   if 0 < n <= len(lines))
-
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or allowlisted(node):
-            continue
-        if _is_write_open(node):
-            out.append((node.lineno,
-                        "write-mode open() bypasses utils/fsio — use "
-                        "fsio.write_bytes/atomic_write_bytes, or mark a "
-                        "deliberate bypass `# noqa: fsio`"))
-        elif _is_os_replace(node):
-            out.append((node.lineno,
-                        "bare os.replace bypasses utils/fsio's "
-                        "rename+fsync discipline — use "
-                        "fsio.atomic_write_bytes, or mark a deliberate "
-                        "bypass `# noqa: fsio`"))
-    return out
-
-
-def main(argv):
-    roots = argv or [os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "paddle_tpu")]
-    violations = []
-    checked = 0
-    for root in roots:
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                full = os.path.join(dirpath, name)
-                rel = os.path.relpath(full)
-                if any(rel.endswith(e) for e in _EXEMPT):
-                    continue
-                checked += 1
-                for lineno, what in find_violations(full):
-                    violations.append(f"{rel}:{lineno}: {what}")
-    if violations:
-        print("\n".join(violations))
-        print(f"\n{len(violations)} violation(s) found — durable bytes "
-              "flow through utils/fsio (fsync discipline + fault "
-              "injection + integrity guarantees)")
-        return 1
-    print(f"fsio lint: {checked} files clean")
-    return 0
+def main() -> None:
+    roots = [os.path.abspath(r) for r in sys.argv[1:]]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    sys.stderr.write(
+        f"note: tools/{os.path.basename(__file__)} is a shim - "
+        f"use `python -m tools.ptlint --pass {_PASS}`\n")
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "tools.ptlint", "--no-baseline",
+               "--pass", _PASS] + roots, env)
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1:]))
+    main()

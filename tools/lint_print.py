@@ -1,81 +1,34 @@
 #!/usr/bin/env python
-"""Reject bare ``print(`` calls in paddle_tpu/ (telemetry hygiene).
+"""Deprecated shim — this lint is now the ptlint ``print`` pass.
 
-With the unified telemetry layer (ISSUE 3) every signal has a proper
-channel: human-readable lines go through ``framework.log`` (VLOG / the
-package logger), machine-readable signals through
-``observability.get_registry()`` sinks.  A bare ``print`` bypasses both
-— it can't be silenced, filtered, redirected per-run, or aggregated, and
-on a 256-host pod it turns stdout into noise no one can parse.
+The standalone walker was absorbed into the unified engine (one shared
+AST parse for every pass; see tools/ptlint/ and docs/ARCHITECTURE.md
+"Static analysis").  This file stays so muscle memory and old scripts
+keep working; it just re-execs
 
-Deliberate console surfaces (the paddle-parity ``Model.summary`` /
-``flops`` pretty-printers, ``ProgBarLogger``, ``version`` / ``run_check``
-CLIs) carry an explicit ``# noqa: print`` on the call line.
+    python -m tools.ptlint --no-baseline --pass print [root ...]
 
-Only plain-name ``print(...)`` calls are flagged — attribute calls like
-``jax.debug.print`` are a different (traced) mechanism.
-
-Usage: ``python tools/lint_print.py [root ...]`` (default:
-``paddle_tpu/``).  Exits 1 listing ``file:line`` for every violation.
+preserving the exit status and ``path:line: message`` output contract.
 """
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-_NOQA = "# noqa: print"
+_PASS = "print"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def find_violations(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [(getattr(e, "lineno", 0) or 0, f"syntax error: {e.msg}")]
-    lines = source.decode("utf-8", errors="replace").splitlines()
-
-    def allowlisted(node: ast.Call) -> bool:
-        n = node.lineno
-        return 0 < n <= len(lines) and _NOQA in lines[n - 1]
-
-    out = []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-                and not allowlisted(node)):
-            out.append((node.lineno,
-                        "bare print() — route through framework.log / an "
-                        "observability sink, or mark a deliberate console "
-                        "surface with `# noqa: print`"))
-    return out
-
-
-def main(argv):
-    roots = argv or [os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "paddle_tpu")]
-    violations = []
-    checked = 0
-    for root in roots:
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                full = os.path.join(dirpath, name)
-                checked += 1
-                for lineno, what in find_violations(full):
-                    violations.append(f"{os.path.relpath(full)}:{lineno}: "
-                                      f"{what}")
-    if violations:
-        print("\n".join(violations))
-        print(f"\n{len(violations)} violation(s) found — output belongs "
-              "in framework.log or an observability sink")
-        return 1
-    print(f"print lint: {checked} files clean")
-    return 0
+def main() -> None:
+    roots = [os.path.abspath(r) for r in sys.argv[1:]]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    sys.stderr.write(
+        f"note: tools/{os.path.basename(__file__)} is a shim - "
+        f"use `python -m tools.ptlint --pass {_PASS}`\n")
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "tools.ptlint", "--no-baseline",
+               "--pass", _PASS] + roots, env)
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1:]))
+    main()

@@ -1,0 +1,15 @@
+"""ptlint — the repo's unified static-analysis engine (ISSUE 12).
+
+One AST parse per file, a pluggable pass registry, structured findings,
+a shared ``# noqa:`` allowlist and a checked-in baseline.  Run it as
+``python -m tools.ptlint --all`` from the repo root; see
+docs/ARCHITECTURE.md "Static analysis" for the pass table and the
+annotation grammar.
+"""
+from .engine import (DEFAULT_BASELINE, Finding, LintPass, Module, Project,
+                     all_passes, get_pass, load_baseline, new_findings,
+                     register, run_passes, write_baseline)
+
+__all__ = ["Finding", "Module", "Project", "LintPass", "register",
+           "all_passes", "get_pass", "run_passes", "load_baseline",
+           "write_baseline", "new_findings", "DEFAULT_BASELINE"]

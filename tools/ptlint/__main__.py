@@ -1,0 +1,112 @@
+"""CLI for ptlint: ``python -m tools.ptlint [--all | --pass NAME] [roots]``.
+
+Exit status: 0 when no *new* findings (relative to the baseline), 1 when
+new findings exist, 2 on usage errors.  ``--no-baseline`` compares
+against an empty baseline (every finding fails); ``--write-baseline``
+rewrites tools/ptlint/baseline.json from the current findings and exits
+0.  ``--json`` emits one machine-readable object for tooling.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from .engine import (DEFAULT_BASELINE, Project, all_passes, load_baseline,
+                     new_findings, run_passes, write_baseline)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_DEFAULT_ROOT = os.path.join(_REPO_ROOT, "paddle_tpu")
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ptlint",
+        description="unified static analysis for the paddle_tpu package")
+    parser.add_argument("roots", nargs="*", default=[],
+                        help="files/directories to scan "
+                             "(default: paddle_tpu/)")
+    parser.add_argument("--all", action="store_true",
+                        help="run every registered pass (default when no "
+                             "--pass is given)")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        default=[], metavar="NAME",
+                        help="run one pass (repeatable); see --list")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered passes and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as one JSON object")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        metavar="PATH", help="baseline file to compare "
+                        "against (default: tools/ptlint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline — every finding fails")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "and exit 0")
+    parser.add_argument("--docs", default=None, metavar="PATH",
+                        help="docs file for the knobs inventory "
+                             "(default: docs/ARCHITECTURE.md)")
+    args = parser.parse_args(argv)
+
+    registry = all_passes()
+    if args.list:
+        width = max(len(n) for n in registry)
+        for name in sorted(registry):
+            print(f"{name:<{width}}  {registry[name].description}")  # noqa: print — CLI output
+        return 0
+
+    for name in args.passes:
+        if name not in registry:
+            print(f"ptlint: unknown pass {name!r} "  # noqa: print — CLI output
+                  f"(known: {', '.join(sorted(registry))})",
+                  file=sys.stderr)
+            return 2
+    names = args.passes or None  # None → all registered passes
+
+    roots = args.roots or [_DEFAULT_ROOT]
+    for root in roots:
+        if not os.path.exists(root):
+            print(f"ptlint: no such path: {root}", file=sys.stderr)  # noqa: print — CLI output
+            return 2
+    project = Project(roots, repo_root=_REPO_ROOT, docs_path=args.docs)
+    findings = run_passes(project, names)
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"ptlint: wrote {len(findings)} fingerprint(s) to "  # noqa: print — CLI output
+              f"{os.path.relpath(args.baseline, _REPO_ROOT)}")
+        return 0
+
+    baseline = load_baseline(args.baseline) if not args.no_baseline else None
+    fresh = new_findings(findings, baseline) if baseline is not None \
+        else list(findings)
+
+    if args.json:
+        fresh_ids = {id(f) for f in fresh}
+        payload = {
+            "passes": sorted(names or registry),
+            "roots": [os.path.relpath(r, _REPO_ROOT) for r in
+                      (os.path.abspath(r) for r in roots)],
+            "findings": [dict(f.to_json(), new=(id(f) in fresh_ids))
+                         for f in findings],
+            "new": len(fresh),
+            "baselined": len(findings) - len(fresh),
+        }
+        print(json.dumps(payload, indent=1))  # noqa: print — CLI output
+    else:
+        for f in fresh:
+            print(f.render())  # noqa: print — CLI output
+        if fresh:
+            print(f"ptlint: {len(fresh)} new finding(s) "  # noqa: print — CLI output
+                  f"({len(findings) - len(fresh)} baselined)",
+                  file=sys.stderr)
+
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""ptlint engine (ISSUE 12): one parse per file, pluggable passes.
+
+The three ad-hoc lints (bare-except, print, fsio) each re-walked the
+package with their own ``ast.parse`` loop; the deep passes this engine
+exists for (trace-safety, lock-discipline) additionally need a *project*
+view — an intra-package call graph, the docs text, every class in one
+index.  So the engine inverts the old structure: a :class:`Project`
+parses every file exactly once into :class:`Module` objects, and each
+registered :class:`LintPass` walks those shared trees.
+
+Findings are structured (:class:`Finding`: path/line/pass/code/message/
+symbol/severity) and every pass shares one allowlist grammar — a
+``# noqa: <token>`` comment on the finding line (legacy tokens
+``swallow``/``print``/``fsio`` still work for the absorbed lints).
+
+The baseline (``tools/ptlint/baseline.json``) holds *fingerprints* of
+known findings — ``path::pass::code::symbol``, deliberately line-free so
+unrelated edits don't churn it.  A run fails only on findings whose
+fingerprint count exceeds the baseline's; ``--write-baseline``
+regenerates it.  See docs/ARCHITECTURE.md "Static analysis".
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+__all__ = ["Finding", "Module", "Project", "LintPass", "register",
+           "all_passes", "get_pass", "run_passes", "load_baseline",
+           "write_baseline", "new_findings", "DEFAULT_BASELINE"]
+
+_NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Za-z0-9_,\- ]+)")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+@dataclass
+class Finding:
+    """One structured lint finding.
+
+    ``symbol`` is the stable identity used for baseline fingerprints —
+    a function/attribute/knob name rather than a line number, so the
+    baseline survives unrelated edits to the same file.
+    """
+    path: str          # path relative to the scanned root's parent
+    line: int
+    pass_name: str
+    code: str          # short finding kind, e.g. "impure-call"
+    message: str
+    symbol: str = ""
+    severity: str = "error"   # "error" | "warning"
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.pass_name}::{self.code}::{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] "
+                f"{self.message}")
+
+    def to_json(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line,
+                "pass": self.pass_name, "code": self.code,
+                "message": self.message, "symbol": self.symbol,
+                "severity": self.severity,
+                "fingerprint": self.fingerprint}
+
+
+class Module:
+    """One parsed source file — tree + lines, parsed exactly once."""
+
+    def __init__(self, path: str, rel: str, source: bytes):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.decode("utf-8", errors="replace").splitlines()
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(source,
+                                                        filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        self._noqa: Optional[Dict[int, set]] = None
+
+    # -- noqa allowlist ----------------------------------------------------
+    def _noqa_map(self) -> Dict[int, set]:
+        if self._noqa is None:
+            m: Dict[int, set] = {}
+            for i, line in enumerate(self.lines, 1):
+                hit = _NOQA_RE.search(line)
+                if hit:
+                    # "# noqa: swallow — reason" / "# noqa: print, fsio":
+                    # first word of each comma-separated part is the token
+                    toks = {part.split()[0] for part in
+                            hit.group(1).split(",") if part.split()}
+                    m[i] = toks
+            self._noqa = m
+        return self._noqa
+
+    def noqa_at(self, linenos: Iterable[int],
+                tokens: Sequence[str]) -> bool:
+        """True when any of ``linenos`` carries ``# noqa: <tok>`` for one
+        of ``tokens`` (an allowlisted finding site)."""
+        m = self._noqa_map()
+        want = set(tokens)
+        return any(m.get(n, set()) & want for n in linenos)
+
+    def node_lines(self, node: ast.AST) -> List[int]:
+        """The line span a noqa comment may sit on for ``node``."""
+        start = getattr(node, "lineno", 0) or 0
+        end = getattr(node, "end_lineno", start) or start
+        return list(range(start, end + 1))
+
+    # -- package identity --------------------------------------------------
+    @property
+    def dotted(self) -> Optional[str]:
+        """``paddle_tpu.observability.monitor`` for package files, else
+        None — derived from the relative path."""
+        rel = self.rel.replace(os.sep, "/")
+        if not rel.endswith(".py"):
+            return None
+        parts = rel[:-3].split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts) if parts else None
+
+
+class Project:
+    """Every module of the scanned roots, parsed once and shared by all
+    passes, plus the repo-level context (docs text) cross-file passes
+    need."""
+
+    def __init__(self, roots: Sequence[str], repo_root: Optional[str] = None,
+                 docs_path: Optional[str] = None):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.repo_root = os.path.abspath(
+            repo_root
+            or os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        self.docs_path = docs_path or os.path.join(self.repo_root, "docs",
+                                                   "ARCHITECTURE.md")
+        self.modules: List[Module] = []
+        self.by_dotted: Dict[str, Module] = {}
+        self._docs_text: Optional[str] = None
+        for root in self.roots:
+            base = os.path.dirname(root.rstrip(os.sep))
+            if os.path.isfile(root):
+                self._add(root, os.path.relpath(root, base))
+                continue
+            for dirpath, _dirnames, filenames in os.walk(root):
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        self._add(full, os.path.relpath(full, base))
+        for mod in self.modules:
+            if mod.dotted:
+                self.by_dotted[mod.dotted] = mod
+
+    def _add(self, full: str, rel: str) -> None:
+        try:
+            with open(full, "rb") as f:
+                self.modules.append(Module(full, rel, f.read()))
+        except OSError:
+            pass  # unreadable file: nothing to lint
+
+    @property
+    def docs_text(self) -> str:
+        if self._docs_text is None:
+            try:
+                with open(self.docs_path, "rb") as f:
+                    self._docs_text = f.read().decode("utf-8",
+                                                      errors="replace")
+            except OSError:
+                self._docs_text = ""
+        return self._docs_text
+
+    def resolve(self, dotted: Optional[str]) -> Optional[Module]:
+        return self.by_dotted.get(dotted) if dotted else None
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+class LintPass:
+    """Base class for a ptlint pass.
+
+    ``name`` is the registry key and the canonical ``# noqa:`` token;
+    ``noqa`` may add legacy aliases (the absorbed lints keep their
+    historical ``swallow``/``print``/``fsio`` comments working)."""
+
+    name: str = ""
+    noqa: Tuple[str, ...] = ()
+    description: str = ""
+
+    @property
+    def tokens(self) -> Tuple[str, ...]:
+        return (self.name,) + tuple(self.noqa)
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[LintPass]] = {}
+
+
+def register(cls: Type[LintPass]) -> Type[LintPass]:
+    assert cls.name, f"{cls} has no pass name"
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_passes() -> Dict[str, Type[LintPass]]:
+    _load_builtin()
+    return dict(_REGISTRY)
+
+
+def get_pass(name: str) -> Type[LintPass]:
+    _load_builtin()
+    if name not in _REGISTRY:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown ptlint pass {name!r} (known: {known})")
+    return _REGISTRY[name]
+
+
+def _load_builtin() -> None:
+    from . import passes  # noqa: F401 — importing registers the passes
+    assert passes is not None
+
+
+def run_passes(project: Project,
+               names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the named passes (all when None) over the shared project.
+
+    Syntax errors surface as findings from a pseudo-pass ``parse`` so a
+    broken file fails loudly exactly once rather than once per pass."""
+    _load_builtin()
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if mod.syntax_error is not None:
+            e = mod.syntax_error
+            findings.append(Finding(
+                mod.rel, getattr(e, "lineno", 0) or 0, "parse",
+                "syntax-error", f"syntax error: {e.msg}",
+                symbol=os.path.basename(mod.rel)))
+    chosen = list(names) if names else sorted(_REGISTRY)
+    for name in chosen:
+        findings.extend(get_pass(name)().run(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name, f.code))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+def load_baseline(path: str = DEFAULT_BASELINE) -> Counter:
+    try:
+        with open(path, "rb") as f:
+            data = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return Counter()
+    return Counter(data.get("fingerprints", []))
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: str = DEFAULT_BASELINE) -> None:
+    payload = {"version": 1,
+               "fingerprints": sorted(f.fingerprint for f in findings)}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:  # noqa: fsio — dev tool, not runtime durability
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)  # noqa: fsio — dev tool, not runtime durability
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Counter) -> List[Finding]:
+    """Findings whose fingerprint count exceeds the baseline's — the set
+    that fails CI (pre-existing debt stays visible but non-blocking)."""
+    budget = Counter(baseline)
+    fresh: List[Finding] = []
+    for f in findings:
+        if budget[f.fingerprint] > 0:
+            budget[f.fingerprint] -= 1
+        else:
+            fresh.append(f)
+    return fresh

@@ -1,0 +1,7 @@
+"""Built-in ptlint passes — importing this package registers them all."""
+from . import hygiene    # noqa: F401  bare_except / print / fsio
+from . import trace_safety  # noqa: F401
+from . import locks      # noqa: F401
+from . import knobs      # noqa: F401
+
+__all__ = ["hygiene", "trace_safety", "locks", "knobs"]

@@ -1,0 +1,178 @@
+"""The three absorbed single-file lints, now sharing one parse.
+
+``bare_except`` / ``print`` / ``fsio`` keep their historical semantics
+and their historical ``# noqa: swallow`` / ``# noqa: print`` /
+``# noqa: fsio`` allowlist comments — the engine accepts both the pass
+name and the legacy token, so no annotated call site had to change.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..engine import Finding, LintPass, Module, Project, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_swallow(node: ast.ExceptHandler) -> bool:
+    """True for ``except Exception/BaseException [as e]: pass``."""
+    if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+        return False
+    t = node.type
+    return (t is None or (isinstance(t, ast.Name) and t.id in _BROAD)
+            or (isinstance(t, ast.Attribute) and t.attr in _BROAD))
+
+
+def _context_name(mod: Module, node: ast.AST) -> str:
+    """Nearest enclosing function/class name for a stable symbol."""
+    target = node
+    best = ""
+    for parent in ast.walk(mod.tree):
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            if (parent.lineno <= getattr(target, "lineno", 0)
+                    <= (parent.end_lineno or parent.lineno)):
+                best = parent.name
+    return best or os.path.basename(mod.rel)
+
+
+@register
+class BareExceptPass(LintPass):
+    """A bare ``except:`` swallows KeyboardInterrupt/SystemExit and the
+    SIGTERM-driven control flow the fault-tolerance layer depends on;
+    ``except Exception: pass`` names what it catches and then discards
+    it anyway.  Legacy allowlist: ``# noqa: swallow``."""
+
+    name = "bare_except"
+    noqa = ("swallow",)
+    description = ("bare `except:` clauses and silent "
+                   "`except Exception: pass` swallowing")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                sites = [node.lineno]
+                if node.body:
+                    sites.append(node.body[0].lineno)
+                if node.type is None:
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.name, "bare-except",
+                        "bare except — name the exception (at minimum "
+                        "`except Exception:`)",
+                        symbol=_context_name(mod, node)))
+                elif (_is_swallow(node)
+                      and not mod.noqa_at(sites, self.tokens)):
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.name, "swallow",
+                        "swallowed exception (`except Exception: pass`) — "
+                        "handle it, narrow it, or mark `# noqa: swallow`",
+                        symbol=_context_name(mod, node)))
+        return out
+
+
+@register
+class PrintPass(LintPass):
+    """Bare ``print(`` bypasses framework.log and the observability
+    sinks — it can't be silenced, filtered, or aggregated.  Deliberate
+    console surfaces carry ``# noqa: print``."""
+
+    name = "print"
+    noqa = ()
+    description = "bare print() calls outside the logging/metrics seams"
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                        and not mod.noqa_at([node.lineno], self.tokens)):
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.name, "print",
+                        "bare print() — route through framework.log / an "
+                        "observability sink, or mark a deliberate console "
+                        "surface with `# noqa: print`",
+                        symbol=_context_name(mod, node)))
+        return out
+
+
+_WRITE_CHARS = set("wax+")
+_FSIO_EXEMPT = (os.path.join("paddle_tpu", "utils", "fsio.py"),)
+
+
+def _mode_of(call: ast.Call):
+    if len(call.args) >= 2:
+        arg = call.args[1]
+    else:
+        arg = next((kw.value for kw in call.keywords
+                    if kw.arg == "mode"), None)
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _is_write_open(node: ast.Call) -> bool:
+    fn = node.func
+    if not (isinstance(fn, ast.Name) and fn.id == "open"):
+        return False
+    mode = _mode_of(node)
+    if mode is None:  # default "r", or dynamic (benefit of the doubt)
+        return len(node.args) >= 2 or any(
+            kw.arg == "mode" for kw in node.keywords)
+    return bool(set(mode) & _WRITE_CHARS)
+
+
+def _is_os_replace(node: ast.Call) -> bool:
+    fn = node.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "replace"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "os")
+
+
+@register
+class FsioPass(LintPass):
+    """Durable bytes flow through ``utils/fsio`` — that seam is where
+    fsync discipline, fault injection and the integrity guarantees live.
+    Flags write-mode ``open()`` and bare ``os.replace``; deliberate
+    bypasses carry ``# noqa: fsio``.  ``utils/fsio.py`` is exempt — it
+    IS the seam."""
+
+    name = "fsio"
+    noqa = ()
+    description = "durable writes bypassing the utils/fsio seam"
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None or any(mod.rel.endswith(e)
+                                       for e in _FSIO_EXEMPT):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if mod.noqa_at(mod.node_lines(node), self.tokens):
+                    continue
+                if _is_write_open(node):
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.name, "open-write",
+                        "write-mode open() bypasses utils/fsio — use "
+                        "fsio.write_bytes/atomic_write_bytes, or mark a "
+                        "deliberate bypass `# noqa: fsio`",
+                        symbol=_context_name(mod, node)))
+                elif _is_os_replace(node):
+                    out.append(Finding(
+                        mod.rel, node.lineno, self.name, "os-replace",
+                        "bare os.replace bypasses utils/fsio's rename+"
+                        "fsync discipline — use fsio.atomic_write_bytes, "
+                        "or mark a deliberate bypass `# noqa: fsio`",
+                        symbol=_context_name(mod, node)))
+        return out

@@ -1,0 +1,57 @@
+"""Env-knob inventory sub-pass (ISSUE 12 satellite).
+
+Every ``PTPU_*`` environment variable the package reads is an operator
+interface, and docs/ARCHITECTURE.md is its inventory — the knob tables
+there are what someone debugging a run at 3am greps.  PR 9 and PR 11
+both added knobs (elastic resize, fault-injection hooks) without adding
+table rows; this pass makes that drift a finding: any ``PTPU_*`` string
+literal in the package that does not appear (as a whole word) in the
+docs fails.  Knobs that are deliberately undocumented — internal
+test-only hooks — carry ``# noqa: knobs`` with a reason.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, LintPass, Project, register
+
+_KNOB_RE = re.compile(r"^PTPU_[A-Z0-9_]+$")
+
+
+@register
+class KnobInventoryPass(LintPass):
+    name = "knobs"
+    noqa = ()
+    description = ("PTPU_* environment knobs missing from the "
+                   "docs/ARCHITECTURE.md inventory tables")
+
+    def run(self, project: Project) -> List[Finding]:
+        docs = project.docs_text
+        # first un-noqa'd site per knob name; one finding per knob
+        sites: Dict[str, Tuple[str, int]] = {}
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and _KNOB_RE.match(node.value)):
+                    continue
+                if mod.noqa_at([node.lineno], self.tokens):
+                    continue
+                sites.setdefault(node.value, (mod.rel, node.lineno))
+        out: List[Finding] = []
+        for knob in sorted(sites):
+            # whole-word: PTPU_ELASTIC must not ride on PTPU_ELASTIC_MIN
+            if re.search(rf"\b{re.escape(knob)}\b", docs):
+                continue
+            rel, line = sites[knob]
+            out.append(Finding(
+                rel, line, self.name, "undocumented-knob",
+                f"env knob `{knob}` is read here but has no row in the "
+                "docs/ARCHITECTURE.md knob tables — document it, or mark "
+                "an internal hook `# noqa: knobs` with a reason",
+                symbol=knob))
+        return out

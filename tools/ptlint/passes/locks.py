@@ -1,0 +1,365 @@
+"""Lock-discipline pass (ISSUE 12 tentpole, pass 2).
+
+The package spawns daemon threads in a dozen places — watchdog monitor,
+heartbeat writer, status server, live aggregator, serving callback
+drain, async checkpoint commit — and every one of them shares instance
+attributes with the main thread.  The GIL makes single-bytecode races
+rare enough to survive tests and bite in production, which is exactly
+the class of silent hazard PR 11's integrity guard catches *after* it
+corrupts state.  This pass catches it before the code runs:
+
+1. **Thread contexts.**  A method is thread-context when it is the
+   ``target=`` of a ``threading.Thread(...)`` (``self.method`` or a
+   function nested in a method — the async-commit pattern), the
+   ``run()`` of a ``threading.Thread`` subclass, or transitively
+   self-called from one of those.  Every other method (``__init__``
+   excluded — it runs before any thread starts) is main-context; a
+   method reachable from both (``poll`` called by the loop *and* by
+   ``stop``) counts for both.
+
+2. **Findings.**  An instance attribute *written* from a thread context
+   and *also written* from a main context must carry a
+   ``# guarded_by: <lockname>`` annotation on an assignment line of
+   that attribute inside the class (idiomatically its ``__init__``
+   line).  Unannotated dual-context writes are findings naming the
+   attribute and both contexts.
+
+3. **Enforcement.**  For an annotated attribute, every access site
+   (read or write) outside ``__init__`` must be *lexically* inside a
+   ``with self.<lockname>:`` block — dynamic "the caller holds it"
+   discipline is exactly what rots — or carry ``# noqa: locks`` with a
+   reason (e.g. a monotonic counter read for display only).
+
+``threading.Condition`` counts as a lock (``with self._cond:`` is an
+acquire).  Annotation grammar and the workflow live in
+docs/ARCHITECTURE.md "Static analysis".
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, LintPass, Module, Project, register
+
+_GUARDED_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=#]+)?=[^#]*#\s*guarded_by:\s*(\w+)")
+_GUARDED_BARE_RE = re.compile(r"#\s*guarded_by:\s*(\w+)\s*$")
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    return ((isinstance(f, ast.Attribute) and f.attr == "Thread")
+            or (isinstance(f, ast.Name) and f.id == "Thread"))
+
+
+def _self_attr_store_root(target: ast.AST) -> Optional[str]:
+    """'x' when ``target`` stores through ``self.x`` (directly, or via
+    ``self.x[i] = .. / self.x.y = ..`` container mutation)."""
+    node = target
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        parent = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(parent, ast.Name) and parent.id == "self"):
+            return node.attr
+        node = parent
+    return None
+
+
+@dataclass
+class _MethodInfo:
+    name: str
+    node: ast.AST                      # FunctionDef (or nested thread body)
+    self_names: Set[str] = field(default_factory=set)  # {'self', aliases}
+    is_nested_thread_body: bool = False
+    host: str = ""                     # enclosing method for nested bodies
+
+    @property
+    def label(self) -> str:
+        return f"{self.host}.<locals>.{self.name}" \
+            if self.is_nested_thread_body else self.name
+
+
+class _ClassModel:
+    def __init__(self, mod: Module, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.methods: Dict[str, _MethodInfo] = {}
+        self.thread_roots: Set[str] = set()
+        self._collect()
+
+    # -- structure ---------------------------------------------------------
+    def _collect(self) -> None:
+        is_thread_subclass = any(
+            (isinstance(b, ast.Name) and b.id == "Thread")
+            or (isinstance(b, ast.Attribute) and b.attr == "Thread")
+            for b in self.node.bases)
+        for stmt in self.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mi = _MethodInfo(stmt.name, stmt, self_names={"self"})
+                self.methods[stmt.name] = mi
+        if is_thread_subclass and "run" in self.methods:
+            self.thread_roots.add("run")
+        # threading.Thread(target=...) sites inside methods
+        for name, mi in list(self.methods.items()):
+            aliases = self._self_aliases(mi.node)
+            mi.self_names |= aliases
+            for sub in ast.walk(mi.node):
+                if not (isinstance(sub, ast.Call)
+                        and _is_thread_ctor(sub)):
+                    continue
+                target = next((kw.value for kw in sub.keywords
+                               if kw.arg == "target"), None)
+                if target is None and sub.args:
+                    target = sub.args[0]
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id in mi.self_names
+                        and target.attr in self.methods):
+                    self.thread_roots.add(target.attr)
+                elif isinstance(target, ast.Name):
+                    nested = self._nested_def(mi.node, target.id)
+                    if nested is not None:
+                        body = _MethodInfo(
+                            target.id, nested,
+                            self_names=set(mi.self_names),
+                            is_nested_thread_body=True, host=name)
+                        key = f"{name}.<locals>.{target.id}"
+                        self.methods[key] = body
+                        self.thread_roots.add(key)
+
+    @staticmethod
+    def _nested_def(method: ast.AST, name: str) -> Optional[ast.AST]:
+        for sub in ast.walk(method):
+            if isinstance(sub, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)) \
+                    and sub.name == name and sub is not method:
+                return sub
+        return None
+
+    @staticmethod
+    def _self_aliases(method: ast.AST) -> Set[str]:
+        """Names bound to ``self`` in the method (``server = self`` — the
+        nested-handler/closure pattern)."""
+        out: Set[str] = set()
+        for sub in ast.walk(method):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                out.add(sub.targets[0].id)
+        return out
+
+    # -- intra-class call graph --------------------------------------------
+    def _calls_of(self, mi: _MethodInfo) -> Set[str]:
+        out: Set[str] = set()
+        for sub in ast.walk(mi.node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id in mi.self_names
+                    and sub.func.attr in self.methods):
+                out.add(sub.func.attr)
+        return out
+
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        seen: Set[str] = set()
+        queue = [r for r in roots if r in self.methods]
+        while queue:
+            name = queue.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            queue.extend(self._calls_of(self.methods[name]) - seen)
+        return seen
+
+    def contexts(self) -> Tuple[Set[str], Set[str]]:
+        """(thread_methods, main_methods) — method-name closures.
+
+        Thread context is the closure of the thread roots.  Main roots
+        are the methods *outside* that closure (a helper only ever
+        self-called from the thread body is thread-only, not "any other
+        method"); a thread-context method the main side also calls —
+        ``stop() -> poll()`` — lands in both closures, which is exactly
+        the dual-context case."""
+        thread = self._closure(self.thread_roots)
+        main_roots = {n for n in self.methods
+                      if n != "__init__" and n not in thread}
+        main = self._closure(main_roots)
+        return thread, main
+
+    # -- accesses ----------------------------------------------------------
+    def writes(self, mi: _MethodInfo) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        for sub in ast.walk(mi.node):
+            targets: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                targets = list(sub.targets)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            for t in targets:
+                # tuple/list unpack targets
+                elts = t.elts if isinstance(t, (ast.Tuple,
+                                                ast.List)) else [t]
+                for e in elts:
+                    attr = self._access_root(e, mi.self_names)
+                    if attr is not None:
+                        out.append((attr, sub.lineno))
+        return out
+
+    @staticmethod
+    def _access_root(node: ast.AST, self_names: Set[str]) -> Optional[str]:
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            parent = node.value
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(parent, ast.Name)
+                    and parent.id in self_names):
+                return node.attr
+            node = parent
+        return None
+
+    def accesses(self, mi: _MethodInfo) -> List[Tuple[str, int, ast.AST,
+                                                      List[ast.AST]]]:
+        """Every (attr, line, node, with_stack) touch of ``self.<attr>``
+        in the method, with the lexical ``with`` ancestry."""
+        out: List[Tuple[str, int, ast.AST, List[ast.AST]]] = []
+
+        def visit(node: ast.AST, withs: List[ast.AST]) -> None:
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in mi.self_names):
+                out.append((node.attr, node.lineno, node, list(withs)))
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    visit(item.context_expr, withs)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, withs)
+                inner = withs + [node]
+                for child in node.body:
+                    visit(child, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, withs)
+
+        visit(mi.node, [])
+        return out
+
+    # -- annotations -------------------------------------------------------
+    def guarded_by(self) -> Dict[str, Tuple[str, int]]:
+        """attr -> (lockname, annotation line) from ``# guarded_by:``
+        comments on assignment lines inside the class body."""
+        out: Dict[str, Tuple[str, int]] = {}
+        start = self.node.lineno
+        end = self.node.end_lineno or start
+        for n in range(start, min(end, len(self.mod.lines)) + 1):
+            line = self.mod.lines[n - 1]
+            m = _GUARDED_RE.search(line)
+            if m:
+                out[m.group(1)] = (m.group(2), n)
+        return out
+
+
+def _with_holds(withs: List[ast.AST], lock: str,
+                self_names: Set[str]) -> bool:
+    """True when some enclosing ``with`` acquires ``self.<lock>`` (or a
+    bare ``<lock>`` for module-level locks)."""
+    for w in withs:
+        for item in w.items:
+            e = item.context_expr
+            # with self._lock:  /  with LOCK:
+            if (isinstance(e, ast.Attribute) and e.attr == lock
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id in self_names):
+                return True
+            if isinstance(e, ast.Name) and e.id == lock:
+                return True
+            # with self._lock: wrapped — e.g. contextlib.nullcontext(..)
+            # does NOT count; only the lock itself.
+    return False
+
+
+@register
+class LockDisciplinePass(LintPass):
+    name = "locks"
+    noqa = ()
+    description = ("unannotated cross-thread attribute writes + guarded "
+                   "fields accessed outside their lock")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(mod, node))
+        return out
+
+    def _check_class(self, mod: Module,
+                     cls: ast.ClassDef) -> List[Finding]:
+        model = _ClassModel(mod, cls)
+        if not model.thread_roots:
+            return []
+        thread_ctx, main_ctx = model.contexts()
+        guarded = model.guarded_by()
+        findings: List[Finding] = []
+
+        def allowed(line: int) -> bool:
+            return mod.noqa_at([line], self.tokens)
+
+        # 1) dual-context writes need an annotation
+        thread_writes: Dict[str, Tuple[str, int]] = {}
+        main_writes: Dict[str, Tuple[str, int]] = {}
+        for name in sorted(model.methods):
+            mi = model.methods[name]
+            if mi.name == "__init__" and not mi.is_nested_thread_body:
+                continue
+            for attr, line in model.writes(mi):
+                if allowed(line):
+                    continue
+                if name in thread_ctx:
+                    thread_writes.setdefault(attr, (mi.label, line))
+                if name in main_ctx:
+                    main_writes.setdefault(attr, (mi.label, line))
+        for attr in sorted(set(thread_writes) & set(main_writes)):
+            if attr in guarded:
+                continue
+            tm, tline = thread_writes[attr]
+            mm, mline = main_writes[attr]
+            both = (f"thread context `{tm}` (line {tline}) and main "
+                    f"context `{mm}` (line {mline})"
+                    if tm != mm else
+                    f"`{tm}` (line {tline}), which is reachable from "
+                    f"both the thread body and the main thread")
+            findings.append(Finding(
+                mod.rel, tline, self.name, "unguarded-field",
+                f"`self.{attr}` of `{cls.name}` is written from {both} "
+                "with no `# guarded_by:` annotation — add the "
+                "annotation + lock, or `# noqa: locks` with a reason",
+                symbol=f"{cls.name}.{attr}"))
+
+        # 2) annotated fields: every access outside __init__ must be
+        # lexically under the lock
+        if guarded:
+            ann_lines = {line for _, line in guarded.values()}
+            for name in sorted(model.methods):
+                mi = model.methods[name]
+                if mi.name == "__init__" and not mi.is_nested_thread_body:
+                    continue
+                for attr, line, _node, withs in model.accesses(mi):
+                    if attr not in guarded or line in ann_lines:
+                        continue
+                    lock, _ = guarded[attr]
+                    if _with_holds(withs, lock, mi.self_names):
+                        continue
+                    if allowed(line):
+                        continue
+                    findings.append(Finding(
+                        mod.rel, line, self.name, "unlocked-access",
+                        f"`self.{attr}` is `# guarded_by: {lock}` but "
+                        f"this access in `{cls.name}.{mi.label}` is not "
+                        f"lexically inside `with self.{lock}:` — hold "
+                        "the lock, or `# noqa: locks` with a reason",
+                        symbol=f"{cls.name}.{attr}:{mi.label}"))
+        return findings

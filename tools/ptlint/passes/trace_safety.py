@@ -1,0 +1,568 @@
+"""Trace-safety pass (ISSUE 12 tentpole, pass 1).
+
+``jax.jit`` runs the Python body once per trace signature and bakes the
+result into XLA.  Host-impure code inside that body is therefore a
+silent hazard class of its own: a ``time.time()`` becomes a constant
+frozen at trace time (and a *different* constant after every retrace —
+the PR 4 retrace storms turn nondeterministic), a seedless
+``np.random`` draw de-synchronizes replicas (exactly the desync the
+PR 11 integrity guard exists to catch at runtime), an ``os.environ``
+read silently pins a knob at trace time, and ``float()/.item()`` on a
+traced value either crashes or forces a device sync.
+
+This pass finds the hazards *statically*: it resolves every jit
+boundary in the package — ``jax.jit`` / ``pjit`` / ``to_static`` /
+``pallas_call`` bodies, as calls or decorators (``partial(jax.jit,..)``
+included) — then walks a lightweight intra-package call graph from
+those roots (bare-name calls, ``self.method`` calls, calls through
+intra-package import aliases, plus bare references to lexically nested
+functions, which is how jax higher-order functions like
+``value_and_grad(f)`` receive their callees).  ``custom_vjp`` /
+``custom_jvp`` ops and their ``defvjp`` fwd/bwd registrations are also
+roots — those bodies always trace under AD.  Each finding names the jit
+entry point whose trace it poisons.
+
+Known resolution boundary: dynamic Layer dispatch —
+``self.network(...)`` through an instance attribute, or
+``apply(..., method=...)`` — is not followed.  Impurity behind such a
+call is caught only when its function is itself a jit/``defvjp`` root.
+
+Allowlist: ``# noqa: trace`` on the offending line — for the rare
+deliberate trace-time constant (document why on the same line).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, LintPass, Module, Project, register
+
+# host-impure call chains (dotted suffixes / exact chains)
+_TIME_ATTRS = {"time", "time_ns", "monotonic", "monotonic_ns",
+               "perf_counter", "perf_counter_ns", "process_time", "sleep"}
+_DATETIME_ATTRS = {"now", "utcnow", "today", "fromtimestamp"}
+_JIT_NAMES = {"jit", "pjit"}
+_FSIO_MODULE = "paddle_tpu.utils.fsio"
+_CONCRETIZE_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'time.time' for Attribute/Name chains, None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class FuncInfo:
+    """One function definition plus the scope context resolution needs."""
+    node: ast.AST                       # FunctionDef / AsyncFunctionDef / Lambda
+    module: Module
+    name: str
+    class_name: Optional[str] = None
+    parent: Optional["FuncInfo"] = None  # lexically enclosing function
+    nested: Dict[str, "FuncInfo"] = field(default_factory=dict)
+
+    @property
+    def params(self) -> Set[str]:
+        a = self.node.args
+        names = [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    @property
+    def qualname(self) -> str:
+        if self.class_name:
+            return f"{self.class_name}.{self.name}"
+        return self.name
+
+
+class ModuleIndex:
+    """Defs, classes and intra-package imports of one module."""
+
+    def __init__(self, mod: Module, package: Optional[str]):
+        self.mod = mod
+        self.top: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, Dict[str, FuncInfo]] = {}
+        self.all_funcs: List[FuncInfo] = []
+        # import alias -> dotted module; from-import name -> (module, attr)
+        self.mod_alias: Dict[str, str] = {}
+        self.from_import: Dict[str, Tuple[str, str]] = {}
+        if mod.tree is None:
+            return
+        self._index_scope(mod.tree.body, parent=None, class_name=None)
+        self._index_imports(mod.tree, package)
+
+    def _index_scope(self, body, parent: Optional[FuncInfo],
+                     class_name: Optional[str]) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(node, self.mod, node.name,
+                              class_name=class_name, parent=parent)
+                self.all_funcs.append(fi)
+                if parent is not None:
+                    parent.nested[node.name] = fi
+                elif class_name is not None:
+                    self.classes.setdefault(class_name, {})[node.name] = fi
+                else:
+                    self.top[node.name] = fi
+                self._index_scope(node.body, parent=fi,
+                                  class_name=class_name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.setdefault(node.name, {})
+                self._index_scope(node.body, parent=None,
+                                  class_name=node.name)
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While)):
+                # defs under conditionals/try (TYPE_CHECKING guards,
+                # version forks) still belong to this scope
+                for fld in ("body", "orelse", "finalbody"):
+                    self._index_scope(getattr(node, fld, []) or [],
+                                      parent=parent, class_name=class_name)
+                for handler in getattr(node, "handlers", []) or []:
+                    self._index_scope(handler.body, parent=parent,
+                                      class_name=class_name)
+
+    def _index_imports(self, tree: ast.Module,
+                       package: Optional[str]) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.mod_alias[alias.asname
+                                   or alias.name.split(".")[0]] = \
+                        alias.name if alias.asname else alias.name.split(
+                            ".")[0]
+                    if alias.asname:
+                        self.mod_alias[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    if package is None:
+                        continue
+                    parts = package.split(".")
+                    if node.level > len(parts):
+                        continue
+                    parts = parts[:len(parts) - node.level + 1]
+                    base = ".".join(parts + ([node.module]
+                                             if node.module else []))
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # could be a submodule or a function — record both
+                    # interpretations; resolution tries each
+                    self.from_import[local] = (base, alias.name)
+
+
+class _CallGraph:
+    """Project-wide lazy resolution over per-module indexes."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.indexes: Dict[str, ModuleIndex] = {}
+        for mod in project.modules:
+            pkg = None
+            if mod.dotted:
+                pkg = (mod.dotted if mod.rel.endswith("__init__.py")
+                       else ".".join(mod.dotted.split(".")[:-1]) or None)
+            self.indexes[mod.rel] = ModuleIndex(mod, pkg)
+
+    def index(self, mod: Module) -> ModuleIndex:
+        return self.indexes[mod.rel]
+
+    def resolve_module(self, dotted: str) -> Optional[ModuleIndex]:
+        m = self.project.resolve(dotted)
+        if m is None:
+            m = self.project.resolve(dotted + ".__init__")
+        return self.indexes.get(m.rel) if m is not None else None
+
+    # -- name resolution ---------------------------------------------------
+    def resolve_name(self, idx: ModuleIndex, fi: Optional[FuncInfo],
+                     name: str) -> Optional[FuncInfo]:
+        # nested defs of the current function, then lexical ancestors
+        cur = fi
+        while cur is not None:
+            if name in cur.nested:
+                return cur.nested[name]
+            cur = cur.parent
+        # sibling methods when inside a class body resolve via self.*,
+        # not bare names — skip straight to module scope
+        if name in idx.top:
+            return idx.top[name]
+        hit = idx.from_import.get(name)
+        if hit:
+            base, attr = hit
+            target = self.resolve_module(base)
+            if target is not None and attr in target.top:
+                return target.top[attr]
+        return None
+
+    def resolve_attr_call(self, idx: ModuleIndex, fi: Optional[FuncInfo],
+                          node: ast.Attribute) -> Optional[FuncInfo]:
+        # self.method() → method of the enclosing class
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and fi is not None and fi.class_name):
+            methods = idx.classes.get(fi.class_name, {})
+            return methods.get(node.attr)
+        # alias.func() through an intra-package import
+        chain = _dotted(node)
+        if chain is None:
+            return None
+        head, _, tail = chain.rpartition(".")
+        if not head:
+            return None
+        # `from .. import ops` → from_import maps the alias to a module
+        root = head.split(".")[0]
+        dotted_mod = None
+        if root in idx.mod_alias and idx.mod_alias[root].startswith(
+                "paddle_tpu"):
+            dotted_mod = idx.mod_alias[root] + head[len(root):]
+        elif root in idx.from_import:
+            base, attr = idx.from_import[root]
+            dotted_mod = (f"{base}.{attr}" if base else attr) \
+                + head[len(root):]
+        if dotted_mod is None:
+            return None
+        target = self.resolve_module(dotted_mod)
+        if target is not None:
+            return target.top.get(tail)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# jit-boundary discovery
+# ---------------------------------------------------------------------------
+def _is_jit_callable(node: ast.AST) -> Optional[str]:
+    """'jax.jit' / 'to_static' / 'pallas_call' … when ``node`` is a jit
+    wrapper reference, else None."""
+    chain = _dotted(node)
+    if chain is None:
+        return None
+    last = chain.split(".")[-1]
+    if last in _JIT_NAMES or last == "to_static":
+        return chain
+    if last == "pallas_call":
+        return chain
+    # custom_vjp/jvp-decorated bodies are traced whenever the op is used
+    # under a jax transform — a jit boundary in their own right
+    if last in ("custom_vjp", "custom_jvp"):
+        return chain
+    return None
+
+
+def _jit_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("fun", "function", "kernel"):
+            return kw.value
+    return None
+
+
+def _decorator_jit_kind(dec: ast.AST) -> Optional[str]:
+    kind = _is_jit_callable(dec)
+    if kind:
+        return kind
+    # @partial(jax.jit, static_argnums=...) / @functools.partial(jit, ..)
+    if isinstance(dec, ast.Call):
+        chain = _dotted(dec.func)
+        if chain and chain.split(".")[-1] == "partial" and dec.args:
+            return _is_jit_callable(dec.args[0])
+        # @jax.jit(...)-style configured decorator
+        return _is_jit_callable(dec.func)
+    return None
+
+
+@register
+class TraceSafetyPass(LintPass):
+    name = "trace"
+    noqa = ("trace_safety",)
+    description = ("host-impure calls / concretization / global mutation "
+                   "reachable from a jit boundary")
+
+    def run(self, project: Project) -> List[Finding]:
+        graph = _CallGraph(project)
+        roots: List[Tuple[FuncInfo, str]] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            idx = graph.index(mod)
+            roots.extend(self._find_roots(idx, graph))
+        findings: List[Finding] = []
+        # BFS over the call graph; first entry label to reach a function
+        # owns its findings (stable + deterministic: roots are in file
+        # order, traversal breadth-first)
+        seen: Set[int] = set()
+        queue: List[Tuple[FuncInfo, str]] = list(roots)
+        while queue:
+            fi, entry = queue.pop(0)
+            if id(fi.node) in seen:
+                continue
+            seen.add(id(fi.node))
+            findings.extend(self._check_function(fi, entry, graph))
+            for callee in self._callees(fi, graph):
+                if id(callee.node) not in seen:
+                    queue.append((callee, entry))
+        return findings
+
+    # -- roots -------------------------------------------------------------
+    def _find_roots(self, idx: ModuleIndex,
+                    graph: _CallGraph) -> List[Tuple[FuncInfo, str]]:
+        roots: List[Tuple[FuncInfo, str]] = []
+        mod = idx.mod
+
+        def entry_label(kind: str, fi: FuncInfo) -> str:
+            return f"{kind}({mod.rel}::{fi.qualname})"
+
+        # decorator form
+        for fi in idx.all_funcs:
+            for dec in getattr(fi.node, "decorator_list", []):
+                kind = _decorator_jit_kind(dec)
+                if kind:
+                    roots.append((fi, entry_label(kind, fi)))
+        # call form: jax.jit(f) / pl.pallas_call(kernel, ...)
+        enclosing = self._enclosing_map(idx)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # op.defvjp(fwd, bwd): both bodies trace under AD
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "defvjp"):
+                fi_scope = enclosing.get(id(node))
+                for arg in node.args:
+                    t = None
+                    if isinstance(arg, ast.Name):
+                        t = graph.resolve_name(idx, fi_scope, arg.id)
+                    elif isinstance(arg, ast.Attribute):
+                        t = graph.resolve_attr_call(idx, fi_scope, arg)
+                    if t is not None:
+                        roots.append((
+                            t, entry_label(_dotted(node.func) or "defvjp",
+                                           t)))
+                continue
+            kind = _is_jit_callable(node.func)
+            if not kind:
+                continue
+            arg = _jit_arg(node)
+            if arg is None:
+                continue
+            fi_scope = enclosing.get(id(node))
+            target: Optional[FuncInfo] = None
+            if isinstance(arg, ast.Call):
+                # pallas_call(functools.partial(kernel, ...), ...) — the
+                # idiomatic way kernels receive compile-time config
+                inner_chain = _dotted(arg.func)
+                if (inner_chain
+                        and inner_chain.split(".")[-1] == "partial"
+                        and arg.args):
+                    arg = arg.args[0]
+            if isinstance(arg, ast.Name):
+                target = graph.resolve_name(idx, fi_scope, arg.id)
+            elif isinstance(arg, ast.Lambda):
+                target = FuncInfo(arg, mod,
+                                  f"<lambda:{arg.lineno}>",
+                                  parent=fi_scope)
+            elif isinstance(arg, ast.Attribute):
+                target = graph.resolve_attr_call(idx, fi_scope, arg)
+            if target is not None:
+                roots.append((target, entry_label(kind, target)))
+        return roots
+
+    def _enclosing_map(self, idx: ModuleIndex) -> Dict[int, FuncInfo]:
+        """node id -> the FuncInfo whose body lexically contains it."""
+        out: Dict[int, FuncInfo] = {}
+        for fi in idx.all_funcs:
+            for sub in ast.walk(fi.node):
+                out.setdefault(id(sub), fi)
+        return out
+
+    # -- traversal ---------------------------------------------------------
+    def _body_nodes(self, fi: FuncInfo):
+        """Walk the function body, excluding nested function/class bodies
+        (those are separate call-graph nodes)."""
+        stack = list(ast.iter_child_nodes(fi.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _callees(self, fi: FuncInfo,
+                 graph: _CallGraph) -> List[FuncInfo]:
+        idx = graph.index(fi.module)
+        out: List[FuncInfo] = []
+        for node in self._body_nodes(fi):
+            if isinstance(node, ast.Call):
+                target = None
+                if isinstance(node.func, ast.Name):
+                    target = graph.resolve_name(idx, fi, node.func.id)
+                elif isinstance(node.func, ast.Attribute):
+                    target = graph.resolve_attr_call(idx, fi, node.func)
+                if target is not None:
+                    out.append(target)
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                # bare reference to a nested def — how jax higher-order
+                # fns (value_and_grad, scan, vmap) receive their callees
+                cur: Optional[FuncInfo] = fi
+                while cur is not None:
+                    if node.id in cur.nested:
+                        out.append(cur.nested[node.id])
+                        break
+                    cur = cur.parent
+        return out
+
+    # -- impurity checks ---------------------------------------------------
+    def _check_function(self, fi: FuncInfo, entry: str,
+                        graph: _CallGraph) -> List[Finding]:
+        mod = fi.module
+        idx = graph.index(mod)
+        out: List[Finding] = []
+        params = fi.params
+        global_names: Set[str] = set()
+        for node in self._body_nodes(fi):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+
+        def emit(node, code, what, severity="error"):
+            if mod.noqa_at(mod.node_lines(node), self.tokens):
+                return
+            out.append(Finding(
+                mod.rel, node.lineno, self.name, code,
+                f"{what} inside `{fi.qualname}` — poisons the trace of "
+                f"jit entry {entry}",
+                symbol=f"{fi.qualname}:{code}:{what}",
+                severity=severity))
+
+        for node in self._body_nodes(fi):
+            if isinstance(node, ast.Call):
+                self._check_call(node, fi, idx, params, emit)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in global_names:
+                        emit(node, "global-mutation",
+                             f"mutation of module global `{t.id}`")
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, ast.Load):
+                chain = _dotted(node.value)
+                if chain == "os.environ":
+                    emit(node, "impure-call",
+                         "`os.environ[...]` read (env pinned at trace "
+                         "time, differs across retraces)")
+        return out
+
+    def _check_call(self, node: ast.Call, fi: FuncInfo,
+                    idx: ModuleIndex, params: Set[str], emit) -> None:
+        # bare-name calls first: _dotted() returns the plain name for
+        # these too, so they must not fall into the chain logic
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name == "print":
+                emit(node, "impure-call",
+                     "`print()` host side effect (fires at trace time "
+                     "only; use jax.debug.print)")
+                return
+            if name == "open":
+                emit(node, "impure-call",
+                     "`open()` file I/O inside a traced function")
+                return
+            if (name in _CONCRETIZE_CASTS and len(node.args) == 1
+                    and self._param_rooted(node.args[0], params)):
+                emit(node, "concretize",
+                     f"`{name}()` on likely-traced "
+                     f"`{_describe(node.args[0])}` (concretizes a "
+                     "tracer)", severity="warning")
+            return
+        # `.item()` with an impure chain root (x.mean().item()): no
+        # dotted chain, but the concretization is the same
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args
+                and _dotted(node.func) is None):
+            emit(node, "concretize",
+                 "`.item()` (forces a device sync / concretizes a "
+                 "tracer)", severity="warning")
+            return
+        chain = _dotted(node.func)
+        if chain:
+            parts = chain.split(".")
+            root, last = parts[0], parts[-1]
+            if root == "time" and last in _TIME_ATTRS:
+                emit(node, "impure-call",
+                     f"`{chain}()` wall-clock read (frozen at trace "
+                     "time)")
+                return
+            if "datetime" in parts[:-1] or root == "datetime":
+                if last in _DATETIME_ATTRS:
+                    emit(node, "impure-call",
+                         f"`{chain}()` wall-clock read (frozen at trace "
+                         "time)")
+                    return
+            if root == "random":
+                emit(node, "impure-call",
+                     f"`{chain}()` stdlib RNG draw without an explicit "
+                     "key (replicas desynchronize)")
+                return
+            if (root in ("np", "numpy") and len(parts) >= 3
+                    and parts[1] == "random"):
+                emit(node, "impure-call",
+                     f"`{chain}()` seedless host RNG draw (replicas "
+                     "desynchronize; use jax.random with an explicit "
+                     "key)")
+                return
+            if chain in ("os.environ.get", "os.getenv"):
+                emit(node, "impure-call",
+                     f"`{chain}()` env read (knob pinned at trace time, "
+                     "differs across retraces)")
+                return
+            resolved_fsio = (
+                root in idx.mod_alias
+                and idx.mod_alias[root] == _FSIO_MODULE) or (
+                root in idx.from_import
+                and idx.from_import[root][0] == _FSIO_MODULE) or (
+                root in idx.from_import
+                and f"{idx.from_import[root][0]}."
+                    f"{idx.from_import[root][1]}" == _FSIO_MODULE)
+            if resolved_fsio:
+                emit(node, "impure-call",
+                     f"`{chain}()` file I/O inside a traced function")
+                return
+            if last == "item" and len(parts) >= 2 and not node.args:
+                emit(node, "concretize",
+                     f"`.item()` on `{'.'.join(parts[:-1])}` "
+                     "(forces a device sync / concretizes a tracer)",
+                     severity="warning")
+                return
+            if (root in ("np", "numpy") and last in ("asarray", "array")
+                    and node.args and self._param_rooted(node.args[0],
+                                                         params)):
+                emit(node, "concretize",
+                     f"`{chain}()` on a likely-traced argument "
+                     "(concretizes a tracer)", severity="warning")
+                return
+
+    @staticmethod
+    def _param_rooted(node: ast.AST, params: Set[str]) -> bool:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in params
+
+
+def _describe(node: ast.AST) -> str:
+    d = _dotted(node)
+    if d:
+        return d
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else "<expr>"
