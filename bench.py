@@ -975,21 +975,17 @@ def _write_artifact(name: str, payload) -> None:
 
 
 def _tpu_reachable(timeout_s: int = 420) -> bool:
-    """Probe device init in a subprocess: a dead TPU tunnel makes
-    jax.devices() hang indefinitely, which must not take the bench (and
-    the driver's BENCH json) down with it."""
-    import subprocess
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return False
-    return out.returncode == 0 and "tpu" in out.stdout
+    """Back-compat alias: the probe lives in ``paddle_tpu.bench.harness``
+    now (the matrix runner needs it too)."""
+    from paddle_tpu.bench.harness import tpu_reachable
+    return tpu_reachable(timeout_s)
 
 
 def main():
+    # why the run ended up on the device it did — stamped on the emitted
+    # row so a CPU-fallback number can never be mistaken for a TPU one
+    # (ISSUE 13: structured provenance, not a stderr note)
+    fallback_reason = None
     if os.environ.get("BENCH_CPU", "0") == "1":  # local smoke, no TPU probe
         from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
         # BENCH_CPU_DEVICES>1 fakes a dp mesh so the comm A/B has an axis
@@ -999,6 +995,7 @@ def main():
         print("[tpu unreachable after probe timeout — falling back to the "
               "CPU smoke so the bench still reports]", file=sys.stderr,
               flush=True)
+        fallback_reason = "tpu_unreachable"
         from paddle_tpu.framework.vmesh import force_virtual_cpu_mesh
         force_virtual_cpu_mesh(1)
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -1098,7 +1095,9 @@ def main():
                 print(f"[comm-ab] failed: {e!r}", file=sys.stderr)
 
     _emit_diag("headline", metric="gpt_tokens_per_sec_per_chip",
-               tok_s=tok_s, mfu=mfu, vs_target=mfu / 0.45)
+               tok_s=tok_s, mfu=mfu, vs_target=mfu / 0.45,
+               device_kind=str(jax.devices()[0].device_kind),
+               fallback_reason=fallback_reason)
     from paddle_tpu.observability import get_registry
     get_registry().flush()
     print(json.dumps({
@@ -1106,6 +1105,8 @@ def main():
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
+        "device_kind": str(jax.devices()[0].device_kind),
+        "fallback_reason": fallback_reason,
     }))
 
 

@@ -1,0 +1,50 @@
+"""Performance observatory (ISSUE 13; ROADMAP 5b).
+
+The scenario matrix that replaces bench.py's monolith: every workload
+family the repo claims speed on (GPT pretrain fused/unfused, MoE,
+long-context sequence-parallel, ResNet/MNIST vision, serve-mode decode)
+runs under one measurement discipline and emits ONE schema-versioned
+row into the append-only ``benchmarks/ledger.jsonl``.
+
+Layout::
+
+    schema.py     row schema v1: fingerprint, phase breakdown, validate
+    ledger.py     append-only ledger + checked-in golden + thresholds
+    harness.py    phase-timed step loop, compile window, bytes-on-wire
+    scenarios.py  the registered workload matrix
+    runner.py     scenario → row assembly → ledger append
+    diff.py       perfdiff: row-vs-row / row-vs-golden attribution
+    gate.py       the CI perf tier (rc 1 on regression; --write-golden)
+
+Entry points::
+
+    python -m paddle_tpu.bench --all --smoke     # run matrix, append rows
+    python -m paddle_tpu.bench.diff              # attribute a regression
+    python -m paddle_tpu.bench.gate              # enforce vs golden
+"""
+from __future__ import annotations
+
+from . import harness, ledger, schema
+from .ledger import (DEFAULT_THRESHOLDS, append_row, default_golden_path,
+                     default_ledger_path, latest_rows, load_golden,
+                     read_ledger, threshold, write_golden)
+from .schema import (KNOWN_SCHEMA_VERSIONS, PHASES, SCHEMA_VERSION,
+                     new_row, validate_row)
+
+__all__ = [
+    "schema", "ledger", "harness",
+    "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES",
+    "new_row", "validate_row",
+    "append_row", "read_ledger", "latest_rows", "load_golden",
+    "write_golden", "threshold", "default_ledger_path",
+    "default_golden_path", "DEFAULT_THRESHOLDS",
+    "run_scenarios",
+]
+
+
+def run_scenarios(*args, **kwargs):
+    """Lazy forward to :func:`runner.run_scenarios` (the runner imports
+    jax-heavy scenario code; keep ``import paddle_tpu.bench`` light for
+    tooling that only reads the ledger)."""
+    from .runner import run_scenarios as _run
+    return _run(*args, **kwargs)

@@ -1,0 +1,115 @@
+"""The CI perf tier (ISSUE 13): enforce the golden baseline.
+
+``run_gate`` reads the newest ledger row per scenario and compares each
+against ``benchmarks/golden.json``; a step-time p50 *strictly* more than
+``step_time_regression_frac`` (default 10%) above the blessed row fails
+rc 1 with the perfdiff attribution report.  Edge cases are deliberate:
+
+- golden missing entirely → rc 0 with an advisory (a fresh tree must
+  not fail CI before a baseline exists; run ``--write-golden``);
+- scenario in the ledger but not in golden → pass with a note (new
+  scenarios enter enforcement only when blessed);
+- exactly at the threshold → pass (strict inequality).
+
+``--write-golden`` is the ptlint-baseline-style update workflow: bless
+the newest ledger rows as the new golden (existing threshold overrides
+are preserved) and diff the file in review like any other change.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+from . import diff as perfdiff
+from . import ledger
+
+__all__ = ["run_gate", "main"]
+
+
+def _say(msg: str) -> None:
+    print(msg)  # noqa: print — the gate IS a CLI report
+
+
+def run_gate(ledger_path: Optional[str] = None,
+             golden_path: Optional[str] = None,
+             threshold_frac: Optional[float] = None,
+             write_golden: bool = False,
+             mode: Optional[str] = None) -> int:
+    """Returns the process rc: 0 pass, 1 regression, 2 usage error."""
+    drops: Dict[str, int] = {}
+    rows = ledger.read_ledger(ledger_path, drops=drops)
+    if drops.get("torn_lines") or drops.get("unknown_schema"):
+        _say(f"perf gate: note — skipped {drops['torn_lines']} torn / "
+             f"{drops['unknown_schema']} foreign-schema ledger line(s)")
+    latest = ledger.latest_rows(rows, mode=mode)
+
+    if write_golden:
+        if not latest:
+            _say("perf gate: no ledger rows to bless — run "
+                 "`python -m paddle_tpu.bench --all --smoke` first")
+            return 2
+        prior = ledger.load_golden(golden_path)
+        golden = ledger.golden_from_rows(
+            latest, thresholds=(prior or {}).get("thresholds"))
+        path = ledger.write_golden(golden, golden_path)
+        _say(f"perf gate: blessed {len(latest)} scenario row(s) -> {path}")
+        return 0
+
+    golden = ledger.load_golden(golden_path)
+    if golden is None:
+        _say("perf gate: no golden baseline — passing (advisory). "
+             "Bless one with: python -m paddle_tpu.bench.gate "
+             "--write-golden")
+        return 0
+    if not latest:
+        _say("perf gate: ledger has no rows to check — passing "
+             "(advisory); run the matrix first")
+        return 0
+    thr = (threshold_frac if threshold_frac is not None
+           else ledger.threshold(golden, "step_time_regression_frac"))
+
+    failures: List[Dict[str, Any]] = []
+    for name in sorted(latest):
+        if name not in golden["scenarios"]:
+            _say(f"perf gate: {name}: not in golden yet — passing "
+                 "(bless with --write-golden to enforce)")
+            continue
+        report = perfdiff.diff_rows(golden["scenarios"][name],
+                                    latest[name], thr)
+        if report["regression"]:
+            failures.append(report)
+            _say(perfdiff.render(report))
+        else:
+            ratio = report.get("ratio")
+            _say(f"perf gate: {name}: ok"
+                 + (f" ({ratio:.2f}x vs golden)"
+                    if ratio is not None else ""))
+    if failures:
+        _say(f"perf gate: FAIL — {len(failures)} scenario(s) regressed "
+             f"more than {thr:.0%} vs golden")
+        return 1
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.bench.gate",
+        description="perf gate: fail on >threshold step-time regression "
+                    "vs benchmarks/golden.json")
+    ap.add_argument("--ledger", default=None, help="ledger path override")
+    ap.add_argument("--golden", default=None, help="golden path override")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="regression fraction override (e.g. 0.10)")
+    ap.add_argument("--mode", default=None, choices=("smoke", "full"),
+                    help="only consider ledger rows of this mode")
+    ap.add_argument("--write-golden", action="store_true",
+                    help="bless the newest ledger rows as the golden")
+    args = ap.parse_args(argv)
+    return run_gate(ledger_path=args.ledger, golden_path=args.golden,
+                    threshold_frac=args.threshold,
+                    write_golden=args.write_golden, mode=args.mode)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,241 @@
+"""Shared scenario harness (ISSUE 13).
+
+One measurement discipline for every scenario, so rows are comparable:
+
+- :func:`measure_steps` — the timed loop.  Each step is decomposed into
+  the ledger's phase axes: **data** (host batch production), **compute**
+  (the dispatch call), **readback** (the host readback of the loss — on
+  tunneled TPU platforms ``block_until_ready`` returns at dispatch, so
+  the readback is the only true sync; see bench.py's module note).  The
+  **collective** phase comes from the ``collective.<op>.ms`` histogram
+  deltas the comm layer records across the timed window.
+- :class:`CompileWindow` — brackets a scenario with a compile-tracker
+  reset and registry-counter baselines, yielding the row's ``compile``
+  stats (wall, traces, retraces, in-process cache hits, persistent
+  disk-cache hits/requests from ``observability/compilecache``).
+- :func:`peak_hbm` — PJRT ``memory_stats()`` peak when the backend
+  exposes it, else the compiled program's memory analysis
+  (temp+argument+output bytes), the platform-independent proxy bench.py
+  has always used.
+- :func:`tpu_reachable` — the subprocess device probe (moved out of
+  bench.py's monolith; a dead TPU tunnel hangs ``jax.devices()``
+  indefinitely, which must never take the bench down with it).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["measure_steps", "CompileWindow", "peak_hbm", "xla_memory",
+           "bytes_on_wire", "tpu_reachable", "pct"]
+
+
+def pct(sorted_vals: List[float], p: float) -> Optional[float]:
+    """The percentile definition shared with ``aggregate._pct``."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _collective_ms_total(registry) -> float:
+    """Sum of all ``collective.<op>.ms`` histogram totals right now."""
+    total = 0.0
+    for name, snap in registry.snapshot().items():
+        if (name.startswith("collective.") and name.endswith(".ms")
+                and snap.get("type") == "histogram"):
+            total += float(snap.get("sum") or 0.0)
+    return total
+
+
+def measure_steps(step_fn: Callable[[int, Any], Any],
+                  make_batch: Callable[[int], Any],
+                  steps: int, warmup: int,
+                  registry=None) -> Dict[str, Any]:
+    """Run ``warmup + steps`` iterations; time the last ``steps`` with a
+    per-phase breakdown.
+
+    ``make_batch(i)`` produces one host-side batch (its wall time is the
+    **data** phase); ``step_fn(i, batch)`` dispatches one step, keeping
+    any state (params/opt) internal, and returns the scalar to read back
+    (**compute** = the dispatch call, **readback** = ``float(...)`` on
+    the result).  Returns per-step series plus phase p50s shaped for
+    ``schema.new_row``.
+    """
+    if registry is None:
+        from ..observability import get_registry
+        registry = get_registry()
+    t0 = time.perf_counter()
+    out = None
+    for i in range(warmup):
+        out = step_fn(i, make_batch(i))
+    if out is not None:
+        float(out)                      # true sync before the timed window
+    warm_s = time.perf_counter() - t0
+
+    total_ms: List[float] = []
+    data_ms: List[float] = []
+    compute_ms: List[float] = []
+    readback_ms: List[float] = []
+    coll0 = _collective_ms_total(registry)
+    last = None
+    for i in range(steps):
+        ta = time.perf_counter()
+        batch = make_batch(warmup + i)
+        tb = time.perf_counter()
+        out = step_fn(warmup + i, batch)
+        tc = time.perf_counter()
+        last = float(out) if out is not None else None
+        td = time.perf_counter()
+        data_ms.append((tb - ta) * 1e3)
+        compute_ms.append((tc - tb) * 1e3)
+        readback_ms.append((td - tc) * 1e3)
+        total_ms.append((td - ta) * 1e3)
+    collective_per_step = max(
+        0.0, _collective_ms_total(registry) - coll0) / max(1, steps)
+
+    def p50(series: List[float]) -> float:
+        return pct(sorted(series), 50) or 0.0
+
+    return {
+        "step_times_ms": total_ms,
+        "phases_ms": {"data": p50(data_ms), "compute": p50(compute_ms),
+                      "readback": p50(readback_ms),
+                      "collective": collective_per_step},
+        "warmup_s": warm_s,
+        "final_value": last,
+    }
+
+
+class CompileWindow:
+    """Bracket one scenario: tracker reset on entry, compile stats for
+    the row on :meth:`stats`.
+
+    Wall time is the delta of the ``compile.wall_ms[fn=...]`` histogram
+    totals (the registry is process-global and scenarios run back to
+    back); trace/retrace/hit counts come from the tracker, which IS
+    reset per scenario; persistent-cache hits/requests are the
+    ``observability/compilecache`` counter deltas.
+    """
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from ..observability import get_registry
+            registry = get_registry()
+        self._registry = registry
+
+    def __enter__(self) -> "CompileWindow":
+        from ..observability.compilation import reset_tracker
+        reset_tracker()
+        self._wall0 = self._compile_wall_total()
+        self._pc0 = self._persistent_counts()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def _compile_wall_total(self) -> float:
+        total = 0.0
+        for name, snap in self._registry.snapshot().items():
+            if (name.startswith("compile.wall_ms[")
+                    and snap.get("type") == "histogram"):
+                total += float(snap.get("sum") or 0.0)
+        return total
+
+    def _persistent_counts(self) -> Tuple[float, float]:
+        reg = self._registry
+        return (reg.counter("compile.persistent_cache_hits").value,
+                reg.counter("compile.persistent_cache_requests").value)
+
+    def stats(self) -> Dict[str, Any]:
+        from ..observability.compilation import get_tracker
+        tr = get_tracker()
+        traces = retraces = calls = storms = 0
+        for fn in tr.functions():
+            st = tr.stats(fn)
+            calls += st["calls"]
+            traces += st["traces"]
+            retraces += st["retraces"]
+            storms += st["storms"]
+        hits, reqs = self._persistent_counts()
+        return {
+            "wall_ms": max(0.0, self._compile_wall_total() - self._wall0),
+            "traces": traces,
+            "retraces": retraces,
+            "storms": storms,
+            "cache_hits": max(0, calls - traces),
+            "persistent_hits": int(hits - self._pc0[0]),
+            "persistent_requests": int(reqs - self._pc0[1]),
+        }
+
+
+def xla_memory(jitted, *args) -> Optional[Dict[str, int]]:
+    """Compiled-program memory analysis (temp/argument/output bytes) —
+    None when the backend doesn't expose it."""
+    try:
+        fn = getattr(jitted, "__wrapped_fn__", jitted)
+        mem = fn.lower(*args).compile().memory_analysis()
+        return {"temp_bytes": int(mem.temp_size_in_bytes),
+                "argument_bytes": int(mem.argument_size_in_bytes),
+                "output_bytes": int(mem.output_size_in_bytes)}
+    except Exception:
+        return None
+
+
+def peak_hbm(jitted=None, *args) -> Optional[int]:
+    """Peak device memory for the row: the live PJRT watermark when the
+    backend reports one, else the compiled program's static footprint."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("peak_bytes_in_use"):
+        return int(stats["peak_bytes_in_use"])
+    if jitted is not None:
+        mem = xla_memory(jitted, *args)
+        if mem:
+            return (mem["temp_bytes"] + mem["argument_bytes"]
+                    + mem["output_bytes"])
+    return None
+
+
+class BytesOnWire:
+    """Delta reader over the comm package's trace-time byte accounting
+    (PR 8): ``comm.compressed_bytes`` is what the run ships,
+    ``comm.bytes`` the exact-schedule equivalent."""
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from ..observability import get_registry
+            registry = get_registry()
+        self._registry = registry
+        self._raw0 = registry.counter("comm.bytes").value
+        self._wire0 = registry.counter("comm.compressed_bytes").value
+
+    def delta(self) -> int:
+        reg = self._registry
+        wire = reg.counter("comm.compressed_bytes").value - self._wire0
+        raw = reg.counter("comm.bytes").value - self._raw0
+        return int(wire if wire > 0 else raw)
+
+
+def bytes_on_wire(registry=None) -> BytesOnWire:
+    return BytesOnWire(registry)
+
+
+def tpu_reachable(timeout_s: int = 420) -> bool:
+    """Probe device init in a subprocess: a dead TPU tunnel makes
+    ``jax.devices()`` hang indefinitely, which must not take the bench
+    (and the driver's BENCH json) down with it."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return out.returncode == 0 and "tpu" in out.stdout

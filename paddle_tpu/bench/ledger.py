@@ -1,0 +1,175 @@
+"""Append-only perf ledger + checked-in golden (ISSUE 13).
+
+``benchmarks/ledger.jsonl`` is the observatory's history: one line per
+scenario run, append-only through ``utils/fsio.append_bytes`` (fsync'd;
+a mid-append death costs one torn line, never the file).  The reader
+carries the exact torn-tail semantics of
+``observability.aggregate.read_worker_stream``: unparseable lines and
+foreign ``schema_version`` rows are skipped with drop accounting, so a
+ledger written by a newer tree stays readable by older tooling.
+
+``benchmarks/golden.json`` is the enforcement baseline: the blessed row
+per scenario plus the ``thresholds`` table the CI gate (and the ci.sh
+A/B smokes) read — updated only through the explicit ``--write-golden``
+workflow, mirroring ptlint's baseline file.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import fsio
+from .schema import KNOWN_SCHEMA_VERSIONS, SCHEMA_VERSION, validate_row
+
+__all__ = ["default_ledger_path", "default_golden_path", "append_row",
+           "read_ledger", "latest_rows", "load_golden", "write_golden",
+           "golden_from_rows", "DEFAULT_THRESHOLDS"]
+
+# regression/quality thresholds the gate and the ci.sh smokes enforce.
+# These are the previously hard-coded ci.sh constants, moved behind the
+# golden so a recalibration is a --write-golden diff, not a script edit.
+DEFAULT_THRESHOLDS: Dict[str, float] = {
+    # >10% step-time p50 growth vs golden fails the perf tier (strictly
+    # greater: exactly 10% passes)
+    "step_time_regression_frac": 0.10,
+    # fused-block A/B: fused leg must not be slower than unfused
+    "fused_block_min_speedup": 1.0,
+    # comm A/B: int8+EF wire compression and loss-fidelity bounds,
+    # ZeRO-1 loss bound and per-replica state shrink factor
+    "comm_min_compress_ratio": 3.0,
+    "comm_int8_max_loss_rel": 0.01,
+    "comm_zero1_max_loss_rel": 1e-4,
+    "comm_zero1_min_state_shrink": 4.0,
+}
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_ledger_path() -> str:
+    return os.path.join(_repo_root(), "benchmarks", "ledger.jsonl")
+
+
+def default_golden_path() -> str:
+    return os.path.join(_repo_root(), "benchmarks", "golden.json")
+
+
+def append_row(row: Dict[str, Any],
+               path: Optional[str] = None) -> str:
+    """Validate + append one row; returns the ledger path.
+
+    Raises ``ValueError`` on a schema violation — an invalid row must
+    fail the producer, never poison the history.
+    """
+    errors = validate_row(row)
+    if errors:
+        raise ValueError(f"invalid ledger row for scenario "
+                         f"{row.get('scenario') if isinstance(row, dict) else row!r}: "
+                         + "; ".join(errors))
+    path = path or default_ledger_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fsio.append_bytes(path, (json.dumps(row, sort_keys=False)
+                             + "\n").encode("utf-8"))
+    return path
+
+
+def read_ledger(path: Optional[str] = None,
+                drops: Optional[Dict[str, int]] = None
+                ) -> List[Dict[str, Any]]:
+    """All readable rows, oldest first, with
+    ``read_worker_stream``-style torn-line / foreign-schema tolerance
+    (``drops`` accumulates ``torn_lines`` / ``unknown_schema``)."""
+    if drops is None:
+        drops = {}
+    drops.setdefault("torn_lines", 0)
+    drops.setdefault("unknown_schema", 0)
+    path = path or default_ledger_path()
+    try:
+        raw = fsio.read_bytes(path)
+    except OSError:
+        return []
+    rows: List[Dict[str, Any]] = []
+    for line in raw.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            drops["torn_lines"] += 1
+            continue  # torn tail from a mid-append death
+        if not isinstance(rec, dict):
+            drops["torn_lines"] += 1
+            continue
+        if rec.get("schema_version",
+                   SCHEMA_VERSION) not in KNOWN_SCHEMA_VERSIONS:
+            drops["unknown_schema"] += 1
+            continue
+        rows.append(rec)
+    return rows
+
+
+def latest_rows(rows: List[Dict[str, Any]],
+                mode: Optional[str] = None
+                ) -> Dict[str, Dict[str, Any]]:
+    """Newest row per scenario (ledger order; optionally one mode)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for r in rows:
+        if mode is not None and r.get("mode") != mode:
+            continue
+        name = r.get("scenario")
+        if isinstance(name, str):
+            out[name] = r
+    return out
+
+
+def load_golden(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """The checked-in baseline, or None when absent/unreadable."""
+    path = path or default_golden_path()
+    try:
+        payload = json.loads(fsio.read_bytes(path))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or "scenarios" not in payload:
+        return None
+    payload.setdefault("thresholds", {})
+    return payload
+
+
+def golden_from_rows(rows_by_scenario: Dict[str, Dict[str, Any]],
+                     thresholds: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Any]:
+    """Assemble a golden payload from the blessed rows."""
+    thr = dict(DEFAULT_THRESHOLDS)
+    thr.update(thresholds or {})
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "thresholds": thr,
+        "scenarios": {name: row for name, row
+                      in sorted(rows_by_scenario.items())},
+    }
+
+
+def write_golden(golden: Dict[str, Any],
+                 path: Optional[str] = None) -> str:
+    path = path or default_golden_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fsio.atomic_write_bytes(
+        path, json.dumps(golden, indent=1, sort_keys=False,
+                         default=str).encode("utf-8"))
+    return path
+
+
+def threshold(golden: Optional[Dict[str, Any]], name: str) -> float:
+    """One threshold, golden override first, defaults second."""
+    thr = (golden or {}).get("thresholds") or {}
+    v = thr.get(name, DEFAULT_THRESHOLDS.get(name))
+    if v is None:
+        raise KeyError(f"unknown threshold {name!r}")
+    return float(v)
+
+
+__all__.append("threshold")

@@ -1,0 +1,123 @@
+"""Scenario runner (ISSUE 13): registry entry → one validated ledger row.
+
+``run_scenario`` is the assembly point — it brackets the scenario with
+the compile window and bytes-on-wire baselines, stamps device/fallback
+provenance, and validates + appends the row.  Scenario code never
+touches the ledger; the runner never touches model code.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import harness, ledger, scenarios, schema
+
+__all__ = ["run_scenario", "run_scenarios", "ensure_devices"]
+
+
+def _emit_diag(msg: str) -> None:
+    sys.stderr.write(msg + "\n")
+    sys.stderr.flush()
+
+
+def ensure_devices() -> Tuple[str, Optional[str]]:
+    """Decide what the matrix runs on; returns ``(platform,
+    fallback_reason)`` for the rows' provenance fields.
+
+    Mirrors bench.py's doctrine — ``BENCH_CPU=1`` opts into the virtual
+    CPU mesh outright; otherwise a dead TPU tunnel is detected by the
+    subprocess probe and the run degrades to the CPU smoke *as data*
+    (``fallback_reason="tpu_unreachable"``), never as a stderr-only
+    note.  The CPU mesh is 8-wide so the meshed scenarios
+    (long_context's dp×sp axes) have devices to shard over.
+    """
+    from ..framework.vmesh import force_virtual_cpu_mesh
+
+    n_cpu = int(os.environ.get("BENCH_CPU_DEVICES", "8"))
+    if os.environ.get("BENCH_CPU") == "1":
+        force_virtual_cpu_mesh(n_cpu)
+        return "cpu", None
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        force_virtual_cpu_mesh(n_cpu)
+        return "cpu", None
+    if harness.tpu_reachable():
+        return "tpu", None
+    _emit_diag("[bench] tpu unreachable after probe timeout — running "
+               "the CPU smoke; rows carry fallback_reason=tpu_unreachable")
+    force_virtual_cpu_mesh(n_cpu)
+    return "cpu", "tpu_unreachable"
+
+
+def run_scenario(name: str, mode: str = "smoke",
+                 fallback_reason: Optional[str] = None,
+                 registry=None) -> Dict[str, Any]:
+    """Run one registered scenario and assemble its schema row."""
+    from ..observability import get_registry
+    from ..observability.compilecache import maybe_enable_persistent_cache
+
+    registry = registry or get_registry()
+    maybe_enable_persistent_cache(registry=registry)
+    fn = scenarios.get(name)
+    wire = harness.bytes_on_wire(registry)
+    with harness.CompileWindow(registry) as cw:
+        payload = fn(mode)
+    row = schema.new_row(
+        name, mode,
+        step_times_ms=payload["step_times_ms"],
+        phases_ms=payload.get("phases_ms") or {},
+        config=payload.get("config"),
+        tokens_per_sec=payload.get("tokens_per_sec"),
+        mfu=payload.get("mfu"),
+        compile_stats=cw.stats(),
+        bytes_on_wire=wire.delta(),
+        peak_hbm_bytes=payload.get("peak_hbm_bytes"),
+        fallback_reason=fallback_reason,
+        extra=payload.get("extra"),
+    )
+    # mirror the headline figures into the live registry so /statusz and
+    # the doctor see the freshest matrix without re-reading the ledger
+    p50 = row["step_time_ms"]["p50"]
+    if p50 is not None:
+        registry.gauge(f"perf.step_time_ms[scenario={name}]").set(p50)
+    if row["tokens_per_sec"] is not None:
+        registry.gauge(
+            f"perf.tokens_per_sec[scenario={name}]").set(
+                row["tokens_per_sec"])
+    for phase, ms in row["phases_ms"].items():
+        registry.gauge(
+            f"perf.phase_ms[scenario={name},phase={phase}]").set(ms)
+    registry.emit("bench.row", scenario=name, mode=mode,
+                  step_time_p50_ms=p50, phases_ms=row["phases_ms"],
+                  compile_wall_ms=row["compile"].get("wall_ms"),
+                  device_kind=row["device_kind"],
+                  fallback_reason=fallback_reason)
+    return row
+
+
+def run_scenarios(names: Optional[List[str]] = None, mode: str = "smoke",
+                  ledger_path: Optional[str] = None,
+                  append: bool = True) -> List[Dict[str, Any]]:
+    """Run the matrix; each scenario's row is validated and appended as
+    it lands (a later scenario crashing never loses earlier rows).
+    Scenario failures are reported and skipped, not fatal — the matrix
+    must degrade scenario-by-scenario, like the doctor's checks.
+    """
+    _platform, fallback = ensure_devices()
+    rows: List[Dict[str, Any]] = []
+    for name in (names or scenarios.names()):
+        _emit_diag(f"[bench] {name} ({mode}) ...")
+        try:
+            row = run_scenario(name, mode, fallback_reason=fallback)
+        except Exception:
+            _emit_diag(f"[bench] scenario {name!r} failed:\n"
+                       + traceback.format_exc())
+            continue
+        if append:
+            ledger.append_row(row, path=ledger_path)
+        rows.append(row)
+        _emit_diag(f"[bench] {name}: p50={row['step_time_ms']['p50']:.2f}ms"
+                   f" compile={row['compile'].get('wall_ms', 0):.0f}ms"
+                   f" device={row['device_kind']}")
+    return rows

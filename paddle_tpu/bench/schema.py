@@ -1,0 +1,184 @@
+"""Ledger row schema (ISSUE 13).
+
+Every scenario the observatory runs emits exactly ONE row shaped like
+this, so rows from different scenarios, machines, and months are
+comparable by construction:
+
+- ``schema_version`` — bumped on any incompatible shape change; the
+  reader drops foreign versions with accounting instead of mis-parsing
+  them (the same doctrine as ``observability/aggregate.py``);
+- ``fingerprint`` + ``git_sha`` — where the number came from: device
+  kind/count, jax/python versions, the commit that produced it;
+- ``device_kind`` / ``fallback_reason`` — the row is self-describing
+  about *what hardware actually ran* (a TPU-unreachable CPU fallback is
+  a field, not a stderr note);
+- ``step_time_ms`` p50/p99 plus the ``phases_ms`` breakdown
+  (data / compute / readback / collective) — the axes perfdiff
+  attributes a regression to;
+- ``compile`` — wall + trace counts from the PR 4 tracker and
+  persistent-cache hit/miss from ``observability/compilecache``;
+- ``tokens_per_sec`` / ``mfu`` — through the shared
+  ``observability/mfu`` definitions (never a per-scenario formula);
+- ``bytes_on_wire`` — the comm package's trace-time accounting (PR 8);
+- ``extra`` — scenario-specific figures (img/s, TTFT/TPOT, ...) that
+  must not leak into the comparable core.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES",
+           "fingerprint", "new_row", "validate_row"]
+
+SCHEMA_VERSION = 1
+KNOWN_SCHEMA_VERSIONS = (1,)
+
+# the step-time decomposition perfdiff attributes regressions to; every
+# row carries all four (0.0 when a scenario has no such phase)
+PHASES = ("data", "compute", "readback", "collective")
+
+_MODES = ("smoke", "full")
+
+
+def _git_sha() -> Optional[str]:
+    """Commit of the tree that produced the row (None outside a repo)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def fingerprint() -> Dict[str, Any]:
+    """Device / software environment stamp for one row."""
+    import jax
+    dev = jax.devices()[0]
+    return {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "device_count": jax.device_count(),
+        "jax": jax.__version__,
+        "python": "%d.%d.%d" % sys.version_info[:3],
+    }
+
+
+def new_row(scenario: str, mode: str, *,
+            step_times_ms: List[float],
+            phases_ms: Dict[str, float],
+            config: Optional[Dict[str, Any]] = None,
+            tokens_per_sec: Optional[float] = None,
+            mfu: Optional[float] = None,
+            compile_stats: Optional[Dict[str, Any]] = None,
+            bytes_on_wire: int = 0,
+            peak_hbm_bytes: Optional[int] = None,
+            fallback_reason: Optional[str] = None,
+            extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble one schema-v1 row from a scenario's measurements.
+
+    ``step_times_ms`` is the raw per-step series (percentiles are
+    computed here so every scenario uses the same definition);
+    ``phases_ms`` maps each :data:`PHASES` entry to its per-step p50.
+    """
+    times = sorted(float(t) for t in step_times_ms)
+
+    def pct(p: float) -> Optional[float]:
+        if not times:
+            return None
+        idx = min(len(times) - 1,
+                  max(0, int(round(p / 100.0 * (len(times) - 1)))))
+        return times[idx]
+
+    fp = fingerprint()
+    row: Dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "scenario": str(scenario),
+        "mode": str(mode),
+        "ts": time.time(),
+        "git_sha": _git_sha(),
+        "device_kind": fp["device_kind"],
+        "fallback_reason": fallback_reason,
+        "fingerprint": fp,
+        "config": dict(config or {}),
+        "steps": len(times),
+        "step_time_ms": {"p50": pct(50), "p99": pct(99),
+                         "mean": (sum(times) / len(times)) if times
+                         else None,
+                         "min": (times[0] if times else None)},
+        "phases_ms": {p: float(phases_ms.get(p, 0.0) or 0.0)
+                      for p in PHASES},
+        "tokens_per_sec": tokens_per_sec,
+        "mfu": mfu,
+        "compile": dict(compile_stats or {}),
+        "bytes_on_wire": int(bytes_on_wire),
+        "peak_hbm_bytes": (None if peak_hbm_bytes is None
+                           else int(peak_hbm_bytes)),
+        "extra": dict(extra or {}),
+    }
+    return row
+
+
+def validate_row(row: Any) -> List[str]:
+    """Schema check; returns the list of violations (empty = valid).
+
+    Mirrors the reader-side doctrine: a row that fails here must never
+    reach the ledger, so every row IN the ledger is loadable by tooling
+    of the same schema generation.
+    """
+    errors: List[str] = []
+    if not isinstance(row, dict):
+        return ["row is not an object"]
+    if row.get("schema_version") not in KNOWN_SCHEMA_VERSIONS:
+        errors.append(f"unknown schema_version "
+                      f"{row.get('schema_version')!r}")
+    if not row.get("scenario") or not isinstance(row.get("scenario"), str):
+        errors.append("missing/invalid scenario")
+    if row.get("mode") not in _MODES:
+        errors.append(f"mode must be one of {_MODES}, "
+                      f"got {row.get('mode')!r}")
+    if not isinstance(row.get("ts"), (int, float)):
+        errors.append("missing/invalid ts")
+    if not isinstance(row.get("device_kind"), str):
+        errors.append("missing/invalid device_kind")
+    fr = row.get("fallback_reason")
+    if fr is not None and not isinstance(fr, str):
+        errors.append("fallback_reason must be null or a string")
+    fp = row.get("fingerprint")
+    if not isinstance(fp, dict):
+        errors.append("missing fingerprint")
+    else:
+        for k in ("platform", "device_count", "jax"):
+            if k not in fp:
+                errors.append(f"fingerprint missing {k!r}")
+    st = row.get("step_time_ms")
+    if not isinstance(st, dict) or not isinstance(
+            st.get("p50"), (int, float)):
+        errors.append("step_time_ms.p50 missing (no timed steps?)")
+    elif not isinstance(st.get("p99"), (int, float)):
+        errors.append("step_time_ms.p99 missing")
+    ph = row.get("phases_ms")
+    if not isinstance(ph, dict):
+        errors.append("missing phases_ms")
+    else:
+        for p in PHASES:
+            if not isinstance(ph.get(p), (int, float)):
+                errors.append(f"phases_ms.{p} missing/invalid")
+    comp = row.get("compile")
+    if not isinstance(comp, dict):
+        errors.append("missing compile stats")
+    if not isinstance(row.get("bytes_on_wire"), int):
+        errors.append("bytes_on_wire must be an int")
+    for opt_num in ("tokens_per_sec", "mfu"):
+        v = row.get(opt_num)
+        if v is not None and not isinstance(v, (int, float)):
+            errors.append(f"{opt_num} must be null or a number")
+    if not isinstance(row.get("extra", {}), dict):
+        errors.append("extra must be an object")
+    return errors

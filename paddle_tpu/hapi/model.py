@@ -63,6 +63,10 @@ class Model:
         raises ``FloatingPointError``.  ``None`` (default) keeps the
         historical behavior: the update applies whatever the loss."""
         self._optimizer = optimizer
+        # opt-in persistent compile cache (PTPU_COMPILE_CACHE_DIR): the
+        # train step built below is the most expensive program the
+        # framework compiles — a warm process loads it from disk
+        obs.maybe_enable_persistent_cache()
         # ISSUE 8: a ZeRO-1 ShardedOptimizer (or a fleet wrapper over
         # one) resolves its mesh/axis/shard-count binding NOW, so the
         # fleet mesh active at prepare time is the one the jitted step's

@@ -48,7 +48,8 @@ flow only when a sink is attached — by the run supervisor under its
 Env knobs: ``PTPU_METRICS_DIR`` (auto-attach a JSONL writer),
 ``PTPU_METRICS_INTERVAL`` (sink flush/summary period, default 30s),
 ``PTPU_TRACE_BUFFER`` (span buffer bound, default 65536),
-``PTPU_MEM_SAMPLE_EVERY`` (HBM watermark cadence, default 16 steps).
+``PTPU_MEM_SAMPLE_EVERY`` (HBM watermark cadence, default 16 steps),
+``PTPU_COMPILE_CACHE_DIR`` (persistent compile cache, :mod:`compilecache`).
 See docs/ARCHITECTURE.md "Telemetry" and "Run doctor".
 """
 from __future__ import annotations
@@ -57,6 +58,7 @@ from .aggregate import (StreamTail, aggregate_run, read_worker_stream,
                         straggler_stats)
 from .compilation import (CompileTracker, arg_signature, diff_signatures,
                           get_tracker, track_jit)
+from .compilecache import maybe_enable_persistent_cache, persistent_cache_dir
 from .doctor import diagnose, render_report
 from .flight import FlightRecorder, flight_dir, read_flight_bundles
 from .memory import (MemorySampler, get_sampler, is_oom_error,
@@ -95,6 +97,8 @@ __all__ = [
     # compile/retrace tracking (ISSUE 4)
     "CompileTracker", "arg_signature", "diff_signatures", "get_tracker",
     "track_jit",
+    # persistent compile cache (ISSUE 13 / ROADMAP 5a)
+    "maybe_enable_persistent_cache", "persistent_cache_dir",
     # memory watermarks (ISSUE 4)
     "MemorySampler", "get_sampler", "is_oom_error", "oom_postmortem",
     # run doctor (ISSUE 4)

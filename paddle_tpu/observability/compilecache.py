@@ -1,0 +1,114 @@
+"""Persistent compilation cache (ISSUE 13 satellite; ROADMAP 5a).
+
+jax can persist compiled executables to disk so a *second process* with
+the same program shapes skips XLA entirely — on real pods that turns a
+multi-minute cold start into seconds.  This module is the one switch:
+
+- ``PTPU_COMPILE_CACHE_DIR=/path`` enables the cache; unset leaves jax
+  untouched (the cache is opt-in, never a surprise write to disk);
+- the min-compile-time floor is zeroed so even tiny functions persist —
+  without this the smoke-sized tests/benches would never populate the
+  cache and the warm-start guarantee would be untestable;
+- disk hit/miss traffic is surfaced as registry counters
+  ``compile.persistent_cache_hits`` / ``compile.persistent_cache_requests``
+  via jax's monitoring events, so the PR 4 compile tracker's in-process
+  view (calls − traces) composes with the cross-process view: a warm
+  start shows ``persistent_hits == persistent_requests > 0`` while the
+  tracker still counts one trace per function.
+
+Call sites: ``jit.to_static``, ``hapi.Model.prepare`` and the bench
+runner — i.e. every place the framework is about to hand jax a program
+worth caching.  The call is idempotent and cheap when the knob is unset.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+__all__ = ["maybe_enable_persistent_cache", "persistent_cache_dir",
+           "reset_for_tests"]
+
+_lock = threading.Lock()
+_state = {"configured": False, "dir": None, "listener": False}
+
+# jax monitoring event names (stable across the 0.4.x line; the listener
+# ignores anything else so a rename degrades to zero counters, not a crash)
+_EV_HIT = "/jax/compilation_cache/cache_hits"
+_EV_REQ = "/jax/compilation_cache/compile_requests_use_cache"
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The directory the cache was enabled with (None = disabled)."""
+    return _state["dir"]
+
+
+def _listener(event: str, **kwargs) -> None:
+    if event not in (_EV_HIT, _EV_REQ):
+        return
+    from .registry import get_registry
+    reg = get_registry()
+    if event == _EV_HIT:
+        reg.counter("compile.persistent_cache_hits").inc()
+    else:
+        reg.counter("compile.persistent_cache_requests").inc()
+
+
+def maybe_enable_persistent_cache(registry=None) -> Optional[str]:
+    """Enable jax's persistent compilation cache if
+    ``PTPU_COMPILE_CACHE_DIR`` is set.  Idempotent; returns the cache
+    dir in effect (None = knob unset, cache untouched).
+
+    ``registry`` is accepted for call-site symmetry; the event listener
+    always resolves the process-global registry at event time (events
+    fire long after this call, possibly under a different registry in
+    tests).
+    """
+    cache_dir = os.environ.get("PTPU_COMPILE_CACHE_DIR", "").strip()
+    if not cache_dir:
+        return None
+    with _lock:
+        if _state["configured"]:
+            return _state["dir"]
+        import jax
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # persist everything: the default floors (compile time / entry
+        # size) silently skip small programs, which breaks the
+        # warm-start contract for smoke-sized workloads
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:  # noqa: swallow
+            pass  # knob absent on older jax: compile-time floor suffices
+        # jax latches a cache-used decision on the process's FIRST
+        # compile (is_cache_used sets _cache_checked); any eager op
+        # before this call — model construction, pt.seed — freezes the
+        # cache OFF for the process even though the config above lands.
+        # reset_cache() clears the latch; the cache re-initializes
+        # lazily from the config on the next compile.
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:  # noqa: swallow
+            pass  # latch absent on this jax: config alone suffices
+        if not _state["listener"]:
+            try:
+                from jax._src import monitoring
+                monitoring.register_event_listener(_listener)
+                _state["listener"] = True
+            except Exception:  # noqa: swallow
+                pass  # cache still works; only the hit counters go dark
+        _state["configured"] = True
+        _state["dir"] = cache_dir
+        return cache_dir
+
+
+def reset_for_tests() -> None:
+    """Forget the configured state so a test can re-enable with a fresh
+    dir.  Does not unregister the jax listener (jax keeps listeners for
+    the process lifetime); re-enabling is still idempotent."""
+    with _lock:
+        _state["configured"] = False
+        _state["dir"] = None

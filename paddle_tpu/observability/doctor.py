@@ -54,7 +54,8 @@ from .sinks import metrics_dir
 
 __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_memory", "check_straggler", "check_data_starved",
-           "check_comm_bound", "check_supervisor"]
+           "check_comm_bound", "check_supervisor",
+           "check_perf_regression"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -388,6 +389,67 @@ def check_comm_bound(workers, frac: Optional[float] = None
     return findings
 
 
+def check_perf_regression(workers, golden=None) -> List[Dict[str, Any]]:
+    """ISSUE 13: ``bench.row`` records in the telemetry window vs the
+    checked-in ``benchmarks/golden.json`` — a row whose step p50 sits
+    more than the golden's ``step_time_regression_frac`` above the
+    blessed row becomes a ``perf_regression`` finding that NAMES the
+    dominant mover (the perfdiff attribution), so /statusz and the
+    post-run report say *which phase* slowed, not just "slower"."""
+    from ..bench import diff as perfdiff
+    from ..bench import ledger as bench_ledger
+    if golden is None:
+        golden = bench_ledger.load_golden()
+    if not golden:
+        return []
+    thr = bench_ledger.threshold(golden, "step_time_regression_frac")
+    latest: Dict[str, Dict[str, Any]] = {}
+    for records in workers.values():
+        for r in records:
+            if r.get("kind") != "bench.row":
+                continue
+            name = r.get("scenario")
+            if isinstance(name, str):
+                latest[name] = r   # newest record per scenario wins
+    findings = []
+    for name, rec in sorted(latest.items()):
+        base = (golden.get("scenarios") or {}).get(name)
+        p50 = rec.get("step_time_p50_ms")
+        if not base or not isinstance(p50, (int, float)):
+            continue
+        # reshape the telemetry record into a row-alike for perfdiff
+        cur = {"scenario": name, "step_time_ms": {"p50": p50, "p99": p50},
+               "phases_ms": rec.get("phases_ms") or {},
+               "compile": {"wall_ms": rec.get("compile_wall_ms")},
+               "device_kind": rec.get("device_kind")}
+        report = perfdiff.diff_rows(base, cur, thr)
+        if not report["regression"]:
+            continue
+        att = report["attribution"]
+        dom = att["dominant"] or "unattributed"
+        mover = next((m for m in att["movers"]
+                      if m["phase"] == att["dominant"]), None)
+        ev = [f"step p50 {report['base_p50_ms']:.2f}ms (golden) -> "
+              f"{report['cur_p50_ms']:.2f}ms "
+              f"({report['ratio']:.2f}x, threshold "
+              f"{1.0 + thr:.2f}x)"]
+        if mover:
+            ev.append(f"dominant mover: {dom} "
+                      f"{mover['base_ms']:.2f}ms -> {mover['cur_ms']:.2f}ms "
+                      f"({mover['delta_ms']:+.2f}ms/step)")
+        ev.append("full attribution: python -m paddle_tpu.bench.diff "
+                  f"--golden --scenario {name}")
+        ratio = report["ratio"] or 1.0
+        findings.append(_finding(
+            "perf_regression", 40 + 40 * min(1.0, ratio - 1.0 - thr),
+            f"perf regression in {name}: {dom} moved "
+            f"({ratio:.2f}x step time vs golden)",
+            ev, scenario=name, dominant=dom, ratio=ratio,
+            base_p50_ms=report["base_p50_ms"],
+            cur_p50_ms=report["cur_p50_ms"]))
+    return findings
+
+
 def check_supervisor(events) -> List[Dict[str, Any]]:
     if not events:
         return []
@@ -483,6 +545,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_straggler(workers, summary)
     findings += check_data_starved(workers)
     findings += check_comm_bound(workers)
+    findings += check_perf_regression(workers)
     findings += check_integrity(events)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))

@@ -245,6 +245,43 @@ class StatusServer:
                             is not None else None),
             })
         status["integrity"] = integrity or None
+        # performance observatory (ISSUE 13): present whenever the bench
+        # runner has mirrored matrix figures into the registry; carries
+        # the perf_regression verdict (dominant mover named) when a
+        # golden baseline exists to compare against
+        perf: Dict[str, Any] = {}
+        perf_gauges = {k: m for k, m in snap.items()
+                       if k.startswith("perf.") and m.get("type") == "gauge"}
+        if perf_gauges:
+            scen: Dict[str, Dict[str, Any]] = {}
+            for name, m in perf_gauges.items():
+                if "[scenario=" not in name:
+                    continue
+                metric, _, rest = name.partition("[scenario=")
+                label = rest[:-1]
+                if metric == "perf.phase_ms" and ",phase=" in label:
+                    sname, _, phase = label.partition(",phase=")
+                    scen.setdefault(sname, {}).setdefault(
+                        "phases_ms", {})[phase] = m["value"]
+                else:
+                    scen.setdefault(label, {})[
+                        metric[len("perf."):]] = m["value"]
+            perf["scenarios"] = scen
+            # row-alike records from the gauges → the doctor's verdict
+            recs = [{"kind": "bench.row", "scenario": sname,
+                     "step_time_p50_ms": v.get("step_time_ms"),
+                     "phases_ms": v.get("phases_ms") or {}}
+                    for sname, v in scen.items()]
+            try:
+                from .doctor import check_perf_regression
+                regressions = check_perf_regression({0: recs})
+            except Exception:  # noqa: swallow — statusz must render
+                regressions = []
+            perf["perf_regression"] = ([
+                {"scenario": f["data"].get("scenario"),
+                 "dominant": f["data"].get("dominant"),
+                 "title": f["title"]} for f in regressions] or None)
+        status["perf"] = perf or None
         if sup is not None:
             if status["step"] is None:
                 status["step"] = sup.gstep
@@ -478,6 +515,7 @@ class LiveAggregator:
         findings += doctor.check_straggler(workers)
         findings += doctor.check_data_starved(workers)
         findings += doctor.check_comm_bound(workers)
+        findings += doctor.check_perf_regression(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 
@@ -485,7 +523,7 @@ class LiveAggregator:
     def _alert_key(finding: Dict[str, Any]) -> tuple:
         data = finding.get("data") or {}
         return (finding["kind"], data.get("function"), data.get("device"),
-                data.get("worker"))
+                data.get("worker"), data.get("scenario"))
 
     def _raise_alerts(self, findings: List[Dict[str, Any]]) -> None:
         for f in findings:

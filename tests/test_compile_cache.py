@@ -1,0 +1,85 @@
+"""Persistent compile cache (ISSUE 13 / ROADMAP 5a): PTPU_COMPILE_CACHE_DIR
+wiring and the cross-process warm-start guarantee."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.observability import compilecache
+
+
+def test_disabled_without_knob(monkeypatch):
+    monkeypatch.delenv("PTPU_COMPILE_CACHE_DIR", raising=False)
+    compilecache.reset_for_tests()
+    assert compilecache.maybe_enable_persistent_cache() is None
+    assert compilecache.persistent_cache_dir() is None
+
+
+def test_enable_is_idempotent(tmp_path, monkeypatch):
+    cdir = str(tmp_path / "cc")
+    monkeypatch.setenv("PTPU_COMPILE_CACHE_DIR", cdir)
+    compilecache.reset_for_tests()
+    try:
+        assert compilecache.maybe_enable_persistent_cache() == cdir
+        assert os.path.isdir(cdir)
+        # second call: same answer, no reconfiguration
+        assert compilecache.maybe_enable_persistent_cache() == cdir
+        assert compilecache.persistent_cache_dir() == cdir
+        import jax
+        assert jax.config.jax_compilation_cache_dir == cdir
+    finally:
+        compilecache.reset_for_tests()
+
+
+_WORKLOAD = r"""
+import os, sys, json
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax, jax.numpy as jnp
+from paddle_tpu.observability import get_registry
+from paddle_tpu.observability.compilecache import maybe_enable_persistent_cache
+assert maybe_enable_persistent_cache() == os.environ["PTPU_COMPILE_CACHE_DIR"]
+
+@jax.jit
+def f(x, y):
+    return jnp.tanh(x @ y) + x.sum()
+
+@jax.jit
+def g(x):
+    return jnp.sort(x * 3.0)[::-1]
+
+x = jnp.ones((16, 16)); v = jnp.arange(32.0)
+f(x, x).block_until_ready()
+g(v).block_until_ready()
+reg = get_registry()
+print(json.dumps({
+    "hits": reg.counter("compile.persistent_cache_hits").value,
+    "requests": reg.counter("compile.persistent_cache_requests").value,
+}))
+"""
+
+
+@pytest.mark.slow
+def test_warm_start_compiles_nothing(tmp_path):
+    """The ROADMAP 5a contract: a second process with the same program
+    shapes loads every executable from disk — persistent hits equal the
+    cacheable compile requests and no XLA compilation runs fresh."""
+    env = dict(os.environ, PTPU_COMPILE_CACHE_DIR=str(tmp_path / "cc"),
+               JAX_PLATFORMS="cpu")
+    env.pop("PTPU_METRICS_DIR", None)
+
+    def run():
+        out = subprocess.run([sys.executable, "-c", _WORKLOAD],
+                             capture_output=True, text=True, timeout=300,
+                             env=env)
+        assert out.returncode == 0, out.stderr
+        import json
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    assert cold["hits"] == 0            # nothing cached yet
+    assert cold["requests"] >= 2        # both functions went to the cache
+    assert os.listdir(str(tmp_path / "cc"))  # executables persisted
+    warm = run()
+    assert warm["requests"] >= 2
+    assert warm["hits"] == warm["requests"]  # 0 fresh compiles

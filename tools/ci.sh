@@ -148,10 +148,15 @@ fused = rows["fused_block"]
 assert fused["compiles"] == 1, f"fused step compiled {fused['compiles']}x"
 assert fused["retraces"] == 0, f"fused step retraced: {fused}"
 assert fused["storms"] == 0, f"retrace storm on the fused path: {fused}"
+# threshold lives in benchmarks/golden.json (ISSUE 13), not this script:
+# recalibration is a --write-golden diff, reviewed like any change
+from paddle_tpu.bench.ledger import load_golden, threshold
+min_speedup = threshold(load_golden(), "fused_block_min_speedup")
 speedup = rows["speedup_fused_over_unfused"]
-assert speedup > 1.0, f"fused block lost the A/B: {speedup:.2f}x"
-print(f"fused-block smoke: {speedup:.2f}x over unfused, "
-      "1 compile, 0 retraces, 0 storms")
+assert speedup > min_speedup, \
+    f"fused block lost the A/B: {speedup:.2f}x <= {min_speedup:.2f}x"
+print(f"fused-block smoke: {speedup:.2f}x over unfused "
+      f"(floor {min_speedup:.2f}x), 1 compile, 0 retraces, 0 storms")
 PYEOF
     # comm tier (ISSUE 8): blockwise quantization bounds, compressed
     # collectives, error-feedback sync, ZeRO-1 ShardedOptimizer parity
@@ -178,12 +183,21 @@ for mode in ("fp32", "int8_ef", "zero1"):
     r = rows[mode]
     assert r["compiles"] == 1, f"{mode} leg compiled {r['compiles']}x"
     assert r["retraces"] == 0 and r["storms"] == 0, (mode, r)
-assert rows["int8_ef"]["compress_ratio"] >= 3.0, \
-    f"int8 leg ratio {rows['int8_ef']['compress_ratio']:.2f}x < 3x"
-assert rows["int8_vs_fp32_loss_rel"] < 0.01, \
+# quality bounds read from benchmarks/golden.json (ISSUE 13) — the
+# historical hard-coded constants are now the golden's defaults
+from paddle_tpu.bench.ledger import load_golden, threshold
+golden = load_golden()
+min_ratio = threshold(golden, "comm_min_compress_ratio")
+max_int8_loss = threshold(golden, "comm_int8_max_loss_rel")
+max_zero1_loss = threshold(golden, "comm_zero1_max_loss_rel")
+min_shrink = threshold(golden, "comm_zero1_min_state_shrink")
+assert rows["int8_ef"]["compress_ratio"] >= min_ratio, \
+    f"int8 leg ratio {rows['int8_ef']['compress_ratio']:.2f}x < {min_ratio}x"
+assert rows["int8_vs_fp32_loss_rel"] < max_int8_loss, \
     f"int8+EF loss drifted {rows['int8_vs_fp32_loss_rel']:.2%} from fp32"
-assert rows["zero1_vs_fp32_loss_rel"] < 1e-4, rows["zero1_vs_fp32_loss_rel"]
-assert rows["zero1"]["opt_state_bytes_per_replica"] * 4 < \
+assert rows["zero1_vs_fp32_loss_rel"] < max_zero1_loss, \
+    rows["zero1_vs_fp32_loss_rel"]
+assert rows["zero1"]["opt_state_bytes_per_replica"] * min_shrink < \
     rows["fp32"]["opt_state_bytes_per_replica"], "ZeRO-1 state not sharded"
 
 # param-level parity drill: ZeRO-1 through the fleet one-config-line
@@ -352,10 +366,24 @@ print(f"integrity overhead: {frac:.3%} of step time (< 1% bound)")
 PYEOF
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
+    # perf tier (ISSUE 13): the scenario matrix in smoke mode against a
+    # throwaway ledger, gated on benchmarks/golden.json — >10% step-time
+    # p50 regression on any blessed scenario fails rc 1 with the
+    # perfdiff attribution report (re-bless after an intentional change:
+    # python -m paddle_tpu.bench.gate --write-golden)
+    PERF_TMP=$(mktemp -d)
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench --all --smoke \
+        --ledger "$PERF_TMP/ledger.jsonl" > /dev/null
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench.gate \
+        --ledger "$PERF_TMP/ledger.jsonl"
+    rm -rf "$PERF_TMP"
+    # warm-start drill (ROADMAP 5a): the persistent-compile-cache test is
+    # `slow` (two fresh jax processes), so tier-1 skips it — run it here
+    python -m pytest -q -m slow tests/test_compile_cache.py
     echo "api-guard + ptlint + faults tier + telemetry tier + doctor" \
          "smoke + monitor smoke + serving tier + serve smoke + kernels" \
          "tier + fused-block smoke + comm tier + comm smoke + elastic" \
          "tier + elastic smoke + integrity tier + integrity smoke +" \
-         "integrity overhead + bench smoke ok"
+         "integrity overhead + bench smoke + perf tier + warm-start ok"
 fi
 echo "shard ${SHARD} green"
