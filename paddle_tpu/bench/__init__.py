@@ -9,35 +9,43 @@ row into the append-only ``benchmarks/ledger.jsonl``.
 Layout::
 
     schema.py     row schema v1: fingerprint, phase breakdown, validate
-    ledger.py     append-only ledger + checked-in golden + thresholds
+    ledger.py     append-only ledger + golden + series view + --compact
     harness.py    phase-timed step loop, compile window, bytes-on-wire
     scenarios.py  the registered workload matrix
     runner.py     scenario → row assembly → ledger append
-    diff.py       perfdiff: row-vs-row / row-vs-golden attribution
-    gate.py       the CI perf tier (rc 1 on regression; --write-golden)
+    diff.py       perfdiff: row-vs-row / golden / trailing-median
+    gate.py       the CI perf tier (noise-aware; --write-golden)
+    trends.py     series model: noise floors, changepoints, drift (14)
+    report.py     self-contained HTML dashboard (inline SVG) (14)
 
 Entry points::
 
     python -m paddle_tpu.bench --all --smoke     # run matrix, append rows
     python -m paddle_tpu.bench.diff              # attribute a regression
-    python -m paddle_tpu.bench.gate              # enforce vs golden
+    python -m paddle_tpu.bench.gate              # enforce, noise-aware
+    python -m paddle_tpu.bench.trends            # series report
+    python -m paddle_tpu.bench.report            # HTML dashboard
+    python -m paddle_tpu.bench.ledger --compact  # bound history
 """
 from __future__ import annotations
 
 from . import harness, ledger, schema
-from .ledger import (DEFAULT_THRESHOLDS, append_row, default_golden_path,
+from .ledger import (DEFAULT_LEDGER_KEEP, DEFAULT_THRESHOLDS, append_row,
+                     compact_ledger, default_golden_path,
                      default_ledger_path, latest_rows, load_golden,
-                     read_ledger, threshold, write_golden)
-from .schema import (KNOWN_SCHEMA_VERSIONS, PHASES, SCHEMA_VERSION,
+                     read_ledger, read_series, threshold, write_golden)
+from .schema import (KNOWN_SCHEMA_VERSIONS, METRICS, PHASES,
+                     SCHEMA_VERSION, fingerprint_key, metric_value,
                      new_row, validate_row)
 
 __all__ = [
     "schema", "ledger", "harness",
-    "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES",
-    "new_row", "validate_row",
-    "append_row", "read_ledger", "latest_rows", "load_golden",
+    "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES", "METRICS",
+    "new_row", "validate_row", "fingerprint_key", "metric_value",
+    "append_row", "read_ledger", "latest_rows", "read_series",
+    "compact_ledger", "load_golden",
     "write_golden", "threshold", "default_ledger_path",
-    "default_golden_path", "DEFAULT_THRESHOLDS",
+    "default_golden_path", "DEFAULT_THRESHOLDS", "DEFAULT_LEDGER_KEEP",
     "run_scenarios",
 ]
 

@@ -11,11 +11,18 @@ CLI::
 
     python -m paddle_tpu.bench.diff ROW_A.json ROW_B.json
     python -m paddle_tpu.bench.diff --golden [--scenario gpt_pretrain_fused]
+    python -m paddle_tpu.bench.diff --baseline median:8   # vs trailing median
+
+``--baseline median:N`` (ISSUE 14) compares each scenario's newest
+ledger row against the **median pseudo-row of its trailing N prior
+rows** instead of a single (possibly noisy) golden or prior row — the
+same baseline the noise-aware gate enforces against.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -175,13 +182,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--golden-path", default=None,
                     help="golden path override")
     ap.add_argument("--scenario", default=None,
-                    help="restrict --golden mode to one scenario")
+                    help="restrict --golden/--baseline mode to one "
+                         "scenario")
+    ap.add_argument("--baseline", default=None, metavar="median:N",
+                    help="compare each newest ledger row against the "
+                         "median pseudo-row of its trailing N prior "
+                         "rows instead of the golden")
     ap.add_argument("--json", action="store_true",
                     help="emit the report(s) as JSON")
     args = ap.parse_args(argv)
 
     reports: List[Dict[str, Any]] = []
-    if args.golden or not args.rows:
+    if args.baseline is not None:
+        from . import trends
+        m = re.fullmatch(r"median:(\d+)", args.baseline)
+        if not m or int(m.group(1)) < 1:
+            ap.error("--baseline must look like median:N with N >= 1")
+        n = int(m.group(1))
+        rows = ledger.read_ledger(args.ledger)
+        latest = ledger.latest_rows(rows)
+        names = ([args.scenario] if args.scenario else sorted(latest))
+        thr = ledger.threshold(ledger.load_golden(args.golden_path),
+                               "step_time_regression_frac")
+        for name in names:
+            cur = latest.get(name)
+            if cur is None:
+                sys.stderr.write(f"perfdiff: {name}: not in ledger, "
+                                 "skipped\n")
+                continue
+            pts = ledger.read_series(name, str(cur.get("mode")),
+                                     rows=rows, dedupe_sha=False)
+            if len(pts) < 2:
+                sys.stderr.write(f"perfdiff: {name}: fewer than 2 rows "
+                                 "— no trailing median to compare "
+                                 "against, skipped\n")
+                continue
+            base = trends.median_row([p["row"] for p in pts[:-1][-n:]])
+            reports.append(diff_rows(base, cur, thr))
+    elif args.golden or not args.rows:
         golden = ledger.load_golden(args.golden_path)
         if golden is None:
             sys.stderr.write("perfdiff: no golden baseline "

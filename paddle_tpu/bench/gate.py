@@ -1,30 +1,43 @@
-"""The CI perf tier (ISSUE 13): enforce the golden baseline.
+"""The CI perf tier: noise-aware enforcement (ISSUE 13 → 14).
 
-``run_gate`` reads the newest ledger row per scenario and compares each
-against ``benchmarks/golden.json``; a step-time p50 *strictly* more than
-``step_time_regression_frac`` (default 10%) above the blessed row fails
-rc 1 with the perfdiff attribution report.  Edge cases are deliberate:
+``run_gate`` compares each scenario's **newest** ledger row against the
+**trailing-window median** of its own run history (``read_series`` with
+sha-dedup off — the gate wants rerun jitter, not one point per commit),
+with a threshold of::
+
+    max(golden step_time_regression_frac,  k * 1.4826 * MAD / median)
+
+so a jittery scenario stops false-alarming at a fixed 10% while a quiet
+one is enforced tighter than the golden's blanket number would dare.
+``PTPU_TREND_WINDOW`` bounds the window, ``PTPU_TREND_K`` scales the
+noise term.  Edge cases are deliberate:
 
 - golden missing entirely → rc 0 with an advisory (a fresh tree must
   not fail CI before a baseline exists; run ``--write-golden``);
 - scenario in the ledger but not in golden → pass with a note (new
   scenarios enter enforcement only when blessed);
+- **fewer than 3 ledger rows for a scenario → rc 0 with an explicit
+  "insufficient history" advisory** — never a silent fallback to a raw
+  golden comparison (ISSUE 14 fix);
 - exactly at the threshold → pass (strict inequality).
 
-``--write-golden`` is the ptlint-baseline-style update workflow: bless
-the newest ledger rows as the new golden (existing threshold overrides
-are preserved) and diff the file in review like any other change.
+``--write-golden`` is unchanged: bless the newest ledger rows (existing
+threshold overrides preserved), diff the file in review.
 """
 from __future__ import annotations
 
 import argparse
-import sys
 from typing import Any, Dict, List, Optional
 
 from . import diff as perfdiff
 from . import ledger
+from . import trends
 
-__all__ = ["run_gate", "main"]
+__all__ = ["MIN_HISTORY", "run_gate", "main"]
+
+# below this many rows for a scenario the gate reports "insufficient
+# history" as an advisory (rc 0) — a 1-row median is not a baseline
+MIN_HISTORY = 3
 
 
 def _say(msg: str) -> None:
@@ -35,8 +48,18 @@ def run_gate(ledger_path: Optional[str] = None,
              golden_path: Optional[str] = None,
              threshold_frac: Optional[float] = None,
              write_golden: bool = False,
-             mode: Optional[str] = None) -> int:
-    """Returns the process rc: 0 pass, 1 regression, 2 usage error."""
+             mode: Optional[str] = None,
+             window: Optional[int] = None,
+             k: Optional[float] = None) -> int:
+    """Returns the process rc: 0 pass, 1 regression, 2 usage error.
+
+    ``threshold_frac`` (or ``--threshold``) overrides the whole
+    noise-aware computation — an explicit number is an explicit number.
+    """
+    if window is None:
+        window = trends.trend_window()
+    if k is None:
+        k = trends.trend_k()
     drops: Dict[str, int] = {}
     rows = ledger.read_ledger(ledger_path, drops=drops)
     if drops.get("torn_lines") or drops.get("unknown_schema"):
@@ -66,8 +89,7 @@ def run_gate(ledger_path: Optional[str] = None,
         _say("perf gate: ledger has no rows to check — passing "
              "(advisory); run the matrix first")
         return 0
-    thr = (threshold_frac if threshold_frac is not None
-           else ledger.threshold(golden, "step_time_regression_frac"))
+    golden_frac = ledger.threshold(golden, "step_time_regression_frac")
 
     failures: List[Dict[str, Any]] = []
     for name in sorted(latest):
@@ -75,19 +97,40 @@ def run_gate(ledger_path: Optional[str] = None,
             _say(f"perf gate: {name}: not in golden yet — passing "
                  "(bless with --write-golden to enforce)")
             continue
-        report = perfdiff.diff_rows(golden["scenarios"][name],
-                                    latest[name], thr)
+        cur = latest[name]
+        # run-level series (reruns kept, sha-dedup off): the newest
+        # point is `cur`, everything before it is the baseline window
+        points = ledger.read_series(name, str(cur.get("mode")),
+                                    "step_p50", rows=rows,
+                                    dedupe_sha=False)
+        if len(points) < MIN_HISTORY:
+            _say(f"perf gate: {name}: insufficient history "
+                 f"({len(points)} row(s), need {MIN_HISTORY}) — "
+                 "advisory only, not enforced")
+            continue
+        prior_pts = points[:-1][-window:]
+        prior_vals = [p["value"] for p in prior_pts]
+        base_row = trends.median_row([p["row"] for p in prior_pts])
+        med = trends.median(prior_vals) or 0.0
+        madv = trends.mad(prior_vals) or 0.0
+        noise_frac = (k * 1.4826 * madv / med) if med > 0 else 0.0
+        thr = (threshold_frac if threshold_frac is not None
+               else max(golden_frac, noise_frac))
+        report = perfdiff.diff_rows(base_row, cur, thr)
         if report["regression"]:
             failures.append(report)
             _say(perfdiff.render(report))
         else:
             ratio = report.get("ratio")
             _say(f"perf gate: {name}: ok"
-                 + (f" ({ratio:.2f}x vs golden)"
+                 + (f" ({ratio:.2f}x vs trailing median of "
+                    f"{len(prior_pts)}, threshold {thr:.1%}"
+                    + (", noise-raised" if thr > golden_frac else "")
+                    + ")"
                     if ratio is not None else ""))
     if failures:
         _say(f"perf gate: FAIL — {len(failures)} scenario(s) regressed "
-             f"more than {thr:.0%} vs golden")
+             "beyond their noise-aware threshold vs the trailing median")
         return 1
     return 0
 
@@ -95,20 +138,27 @@ def run_gate(ledger_path: Optional[str] = None,
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.bench.gate",
-        description="perf gate: fail on >threshold step-time regression "
-                    "vs benchmarks/golden.json")
+        description="perf gate: fail on a step-time regression beyond "
+                    "max(golden threshold, k*MAD noise floor) vs the "
+                    "trailing-window median")
     ap.add_argument("--ledger", default=None, help="ledger path override")
     ap.add_argument("--golden", default=None, help="golden path override")
     ap.add_argument("--threshold", type=float, default=None,
-                    help="regression fraction override (e.g. 0.10)")
+                    help="explicit regression fraction (disables the "
+                         "noise-aware computation)")
     ap.add_argument("--mode", default=None, choices=("smoke", "full"),
                     help="only consider ledger rows of this mode")
+    ap.add_argument("--window", type=int, default=None,
+                    help="trailing window (default PTPU_TREND_WINDOW)")
+    ap.add_argument("--k", type=float, default=None,
+                    help="noise multiplier (default PTPU_TREND_K)")
     ap.add_argument("--write-golden", action="store_true",
                     help="bless the newest ledger rows as the golden")
     args = ap.parse_args(argv)
     return run_gate(ledger_path=args.ledger, golden_path=args.golden,
                     threshold_frac=args.threshold,
-                    write_golden=args.write_golden, mode=args.mode)
+                    write_golden=args.write_golden, mode=args.mode,
+                    window=args.window, k=args.k)
 
 
 if __name__ == "__main__":

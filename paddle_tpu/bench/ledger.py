@@ -15,16 +15,23 @@ workflow, mirroring ptlint's baseline file.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils import fsio
-from .schema import KNOWN_SCHEMA_VERSIONS, SCHEMA_VERSION, validate_row
+from .schema import (KNOWN_SCHEMA_VERSIONS, SCHEMA_VERSION, fingerprint_key,
+                     metric_value, validate_row)
 
 __all__ = ["default_ledger_path", "default_golden_path", "append_row",
-           "read_ledger", "latest_rows", "load_golden", "write_golden",
-           "golden_from_rows", "DEFAULT_THRESHOLDS"]
+           "read_ledger", "latest_rows", "read_series", "compact_ledger",
+           "load_golden", "write_golden", "golden_from_rows",
+           "DEFAULT_THRESHOLDS", "DEFAULT_LEDGER_KEEP"]
+
+# --compact bound: newest rows kept per (scenario, mode) partition
+# (override with PTPU_LEDGER_KEEP)
+DEFAULT_LEDGER_KEEP = 256
 
 # regression/quality thresholds the gate and the ci.sh smokes enforce.
 # These are the previously hard-coded ci.sh constants, moved behind the
@@ -126,6 +133,87 @@ def latest_rows(rows: List[Dict[str, Any]],
     return out
 
 
+def read_series(scenario: str, mode: str, metric: str = "step_p50", *,
+                path: Optional[str] = None,
+                rows: Optional[List[Dict[str, Any]]] = None,
+                partition: Optional[str] = None,
+                dedupe_sha: bool = True) -> List[Dict[str, Any]]:
+    """The trend engine's series view of the ledger (ISSUE 14): one
+    scenario/mode/metric as an oldest-first list of points
+    ``{"sha", "ts", "value", "row"}``.
+
+    - **fingerprint-partitioned**: only rows whose
+      :func:`schema.fingerprint_key` matches ``partition`` (default: the
+      partition of the scenario's newest row) enter the series — a
+      CPU-smoke point never mixes into a TPU series;
+    - **sha-deduped** (``dedupe_sha=True``): when one commit produced
+      several rows (CI reruns), the newest row wins — the series is
+      indexed by commit, which is what changepoint → sha-range
+      attribution needs.  Rows without a ``git_sha`` are kept as-is.
+      Pass ``dedupe_sha=False`` for run-level statistics (the
+      noise-aware gate wants rerun jitter, not one point per commit);
+    - rows whose ``metric`` field is absent/null are skipped.
+    """
+    if rows is None:
+        rows = read_ledger(path)
+    cand = [r for r in rows
+            if r.get("scenario") == scenario and r.get("mode") == mode]
+    cand.sort(key=lambda r: (r.get("ts") or 0.0))
+    if not cand:
+        return []
+    if partition is None:
+        partition = fingerprint_key(cand[-1])
+    cand = [r for r in cand if fingerprint_key(r) == partition]
+    if dedupe_sha:
+        newest_at: Dict[str, int] = {}
+        for i, r in enumerate(cand):
+            sha = r.get("git_sha")
+            if isinstance(sha, str):
+                newest_at[sha] = i          # later index = newer row wins
+        cand = [r for i, r in enumerate(cand)
+                if not isinstance(r.get("git_sha"), str)
+                or newest_at[r["git_sha"]] == i]
+    points = []
+    for r in cand:
+        v = metric_value(r, metric)
+        if v is None:
+            continue
+        points.append({"sha": r.get("git_sha"), "ts": r.get("ts"),
+                       "value": v, "row": r})
+    return points
+
+
+def compact_ledger(path: Optional[str] = None,
+                   keep: Optional[int] = None) -> Tuple[int, int]:
+    """Bound per-(scenario, mode) history to the newest ``keep`` rows
+    (default ``PTPU_LEDGER_KEEP``, else :data:`DEFAULT_LEDGER_KEEP`),
+    rewriting the ledger atomically in original order.  Torn/foreign
+    lines are dropped by the rewrite (they were invisible to readers
+    anyway).  Returns ``(kept, dropped)`` row counts."""
+    if keep is None:
+        keep = int(os.environ.get("PTPU_LEDGER_KEEP", DEFAULT_LEDGER_KEEP))
+    if keep < 1:
+        raise ValueError(f"PTPU_LEDGER_KEEP must be >= 1, got {keep}")
+    path = path or default_ledger_path()
+    rows = read_ledger(path)
+    per_key: Dict[Tuple[str, str], int] = {}
+    for r in rows:
+        k = (str(r.get("scenario")), str(r.get("mode")))
+        per_key[k] = per_key.get(k, 0) + 1
+    seen: Dict[Tuple[str, str], int] = {}
+    kept: List[Dict[str, Any]] = []
+    for r in rows:                      # ledger order ≈ oldest first
+        k = (str(r.get("scenario")), str(r.get("mode")))
+        seen[k] = seen.get(k, 0) + 1
+        if per_key[k] - seen[k] < keep:     # one of the newest `keep`
+            kept.append(r)
+    if len(kept) != len(rows):
+        payload = "".join(json.dumps(r, sort_keys=False) + "\n"
+                          for r in kept)
+        fsio.atomic_write_bytes(path, payload.encode("utf-8"))
+    return len(kept), len(rows) - len(kept)
+
+
 def load_golden(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
     """The checked-in baseline, or None when absent/unreadable."""
     path = path or default_golden_path()
@@ -173,3 +261,42 @@ def threshold(golden: Optional[Dict[str, Any]], name: str) -> float:
 
 
 __all__.append("threshold")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m paddle_tpu.bench.ledger`` — inspect / compact the
+    ledger.  ``--compact`` bounds per-(scenario, mode) history to the
+    newest ``--keep`` (default ``PTPU_LEDGER_KEEP``) rows."""
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.bench.ledger",
+        description="perf ledger maintenance: summarize row counts, "
+                    "or --compact to bound per-scenario history")
+    ap.add_argument("--ledger", default=None, help="ledger path override")
+    ap.add_argument("--compact", action="store_true",
+                    help="rewrite the ledger keeping only the newest "
+                         "--keep rows per (scenario, mode)")
+    ap.add_argument("--keep", type=int, default=None,
+                    help="history bound (default PTPU_LEDGER_KEEP, "
+                         f"else {DEFAULT_LEDGER_KEEP})")
+    args = ap.parse_args(argv)
+    path = args.ledger or default_ledger_path()
+    if args.compact:
+        kept, dropped = compact_ledger(path, keep=args.keep)
+        print(f"ledger: kept {kept} row(s), dropped {dropped} -> {path}")  # noqa: print — CLI report
+        return 0
+    drops: Dict[str, int] = {}
+    rows = read_ledger(path, drops=drops)
+    per_key: Dict[Tuple[str, str], int] = {}
+    for r in rows:
+        k = (str(r.get("scenario")), str(r.get("mode")))
+        per_key[k] = per_key.get(k, 0) + 1
+    print(f"ledger: {len(rows)} row(s) at {path} "  # noqa: print — CLI report
+          f"(skipped {drops['torn_lines']} torn / "
+          f"{drops['unknown_schema']} foreign-schema)")
+    for (scenario, mode), n in sorted(per_key.items()):
+        print(f"  {scenario:<22} {mode:<6} {n:4d} row(s)")  # noqa: print — CLI report
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

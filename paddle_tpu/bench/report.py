@@ -1,0 +1,295 @@
+"""Static perf dashboard (ISSUE 14): the trend engine, rendered.
+
+``python -m paddle_tpu.bench.report`` turns ``trends.scan_ledger`` into
+one **self-contained** HTML file (default ``benchmarks/report.html``):
+inline CSS, inline SVG sparklines — no JS, no fonts, no CDN, no network
+fetch of any kind, so the artifact is archivable and opens identically
+from a laptop, a CI artifact store, or ``file://`` on an air-gapped
+machine.
+
+Per scenario/mode: a sparkline per metric axis (step p50, MFU, compile
+wall, bytes-on-wire, peak HBM) with detected changepoints marked on the
+line, the latest value vs the trailing-window median, and the trend
+direction.  Below: the regression table (changepoints + flagged drifts
+with sha ranges and dominant phases) and the flakiness ranking the
+noise-aware gate calibrates against.
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import os
+from typing import Any, Dict, List, Optional
+
+from ..utils import fsio
+from . import ledger, trends
+from .schema import METRICS
+
+__all__ = ["sparkline_svg", "render_html", "write_report", "main"]
+
+_METRIC_LABEL = {
+    "step_p50": "step p50 (ms)",
+    "mfu": "MFU",
+    "compile_wall_ms": "compile wall (ms)",
+    "bytes_on_wire": "bytes on wire",
+    "peak_hbm_bytes": "peak HBM",
+}
+
+_CSS = """
+body { font: 14px/1.45 system-ui, sans-serif; margin: 24px;
+       color: #1a202c; background: #fff; }
+h1 { font-size: 20px; margin: 0 0 4px; }
+h2 { font-size: 16px; margin: 28px 0 8px; }
+.meta { color: #718096; margin-bottom: 18px; }
+table { border-collapse: collapse; margin: 8px 0 16px; }
+th, td { border: 1px solid #e2e8f0; padding: 4px 10px;
+         text-align: left; vertical-align: middle; }
+th { background: #f7fafc; font-weight: 600; }
+td.num { text-align: right; font-variant-numeric: tabular-nums; }
+.up { color: #c53030; font-weight: 600; }
+.down { color: #2f855a; font-weight: 600; }
+.flat { color: #718096; }
+.spark { display: block; }
+.cards { display: flex; gap: 12px; margin: 12px 0 4px; }
+.card { border: 1px solid #e2e8f0; border-radius: 6px;
+        padding: 8px 14px; min-width: 110px; }
+.card b { display: block; font-size: 18px; }
+.ok { color: #2f855a; }
+.bad { color: #c53030; }
+"""
+
+
+def sparkline_svg(values: List[float],
+                  changepoints: Optional[List[Dict[str, Any]]] = None,
+                  width: int = 220, height: int = 44) -> str:
+    """One inline SVG sparkline; changepoint indices get a marker dot on
+    the line and a vertical rule (red = up/regression, green = down)."""
+    n = len(values)
+    if n == 0:
+        return "<svg class='spark' width='%d' height='%d'></svg>" % (
+            width, height)
+    pad = 3.0
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or max(abs(hi), 1e-12) * 0.1 or 1.0
+
+    def x(i: int) -> float:
+        return pad + (width - 2 * pad) * (i / max(1, n - 1))
+
+    def y(v: float) -> float:
+        return pad + (height - 2 * pad) * (1.0 - (v - lo) / span)
+
+    pts = " ".join(f"{x(i):.1f},{y(v):.1f}" for i, v in enumerate(values))
+    parts = [f"<svg class='spark' width='{width}' height='{height}' "
+             f"viewBox='0 0 {width} {height}' role='img'>"]
+    for cp in changepoints or []:
+        i = cp.get("index")
+        if not isinstance(i, int) or not (0 <= i < n):
+            continue
+        color = "#c53030" if cp.get("direction") == "up" else "#2f855a"
+        parts.append(f"<line x1='{x(i):.1f}' y1='0' x2='{x(i):.1f}' "
+                     f"y2='{height}' stroke='{color}' stroke-width='1' "
+                     "stroke-dasharray='3,2'/>")
+        parts.append(f"<circle cx='{x(i):.1f}' cy='{y(values[i]):.1f}' "
+                     f"r='3' fill='{color}'/>")
+    if n == 1:
+        parts.append(f"<circle cx='{x(0):.1f}' cy='{y(values[0]):.1f}' "
+                     "r='2.5' fill='#3182ce'/>")
+    else:
+        parts.append(f"<polyline points='{pts}' fill='none' "
+                     "stroke='#3182ce' stroke-width='1.5'/>")
+        parts.append(f"<circle cx='{x(n - 1):.1f}' "
+                     f"cy='{y(values[-1]):.1f}' r='2.5' fill='#3182ce'/>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _esc(v: Any) -> str:
+    return html.escape(str(v))
+
+
+def _short(sha: Optional[str]) -> str:
+    return sha[:8] if isinstance(sha, str) else "?"
+
+
+def _trend_cell(trend: Optional[str]) -> str:
+    if trend == "up":
+        return "<span class='up'>&#9650; up</span>"
+    if trend == "down":
+        return "<span class='down'>&#9660; down</span>"
+    if trend == "flat":
+        return "<span class='flat'>&#8596; flat</span>"
+    return "<span class='flat'>—</span>"
+
+
+def _collect_events(analyses: List[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Changepoints + flagged drifts across all scenarios/metrics, for
+    the regression table (step-time upward moves first)."""
+    events: List[Dict[str, Any]] = []
+    for a in analyses:
+        for metric, an in a["metrics"].items():
+            for cp in an.get("changepoints") or []:
+                events.append({
+                    "kind": "changepoint", "scenario": a["scenario"],
+                    "mode": a["mode"], "metric": metric,
+                    "delta_frac": cp["delta_frac"],
+                    "direction": cp["direction"],
+                    "sha_range": cp.get("sha_range") or (None, None),
+                    "dominant_phase": cp.get("dominant_phase"),
+                })
+            drift = an.get("drift")
+            if drift and drift.get("flagged"):
+                events.append({
+                    "kind": "drift", "scenario": a["scenario"],
+                    "mode": a["mode"], "metric": metric,
+                    "delta_frac": drift["total_frac"],
+                    "direction": drift["direction"],
+                    "sha_range": (None, None), "dominant_phase": None,
+                })
+    events.sort(key=lambda e: (
+        0 if (e["metric"] == "step_p50" and e["direction"] == "up") else 1,
+        -abs(e["delta_frac"])))
+    return events
+
+
+def render_html(analyses: List[Dict[str, Any]],
+                ledger_path: Optional[str] = None) -> str:
+    """The whole dashboard as one HTML string (no external assets)."""
+    window = trends.trend_window()
+    k = trends.trend_k()
+    events = _collect_events(analyses)
+    n_up = sum(1 for e in events
+               if e["metric"] == "step_p50" and e["direction"] == "up")
+    flaky = [(a["scenario"], a["mode"], a["flakiness"])
+             for a in analyses if a.get("flakiness") is not None]
+    worst_flaky = max((f for _, _, f in flaky), default=None)
+
+    out: List[str] = []
+    out.append("<!DOCTYPE html><html lang='en'><head>"
+               "<meta charset='utf-8'>"
+               "<title>paddle_tpu perf trends</title>"
+               f"<style>{_CSS}</style></head><body>")
+    out.append("<h1>paddle_tpu perf trends</h1>")
+    out.append(f"<div class='meta'>ledger: "
+               f"{_esc(ledger_path or ledger.default_ledger_path())} "
+               f"&middot; trailing window {window} &middot; k={k:g} "
+               "&middot; self-contained (no external assets)</div>")
+
+    out.append("<div class='cards'>")
+    out.append(f"<div class='card'><b>{len(analyses)}</b>series</div>")
+    cls = "bad" if n_up else "ok"
+    out.append(f"<div class='card'><b class='{cls}'>{n_up}</b>"
+               "step-time regressions</div>")
+    out.append(f"<div class='card'><b>{len(events)}</b>"
+               "events (all metrics)</div>")
+    out.append("<div class='card'><b>"
+               + (f"{worst_flaky:.1%}" if worst_flaky is not None else "—")
+               + "</b>worst flakiness</div>")
+    out.append("</div>")
+
+    # per-scenario sparkline matrix
+    out.append("<h2>Series</h2><table><tr><th>scenario</th><th>mode</th>"
+               "<th>partition</th>"
+               + "".join(f"<th>{_esc(_METRIC_LABEL[m])}</th>"
+                         for m in METRICS)
+               + "<th>trend</th></tr>")
+    for a in analyses:
+        out.append(f"<tr><td>{_esc(a['scenario'])}</td>"
+                   f"<td>{_esc(a['mode'])}</td>"
+                   f"<td>{_esc(a.get('partition') or '—')}</td>")
+        for m in METRICS:
+            an = a["metrics"].get(m) or {}
+            vals = an.get("values") or []
+            if not vals:
+                out.append("<td class='flat'>—</td>")
+                continue
+            spark = sparkline_svg(vals, an.get("changepoints"))
+            latest = trends._fmt_metric(m, an.get("latest"))
+            med = trends._fmt_metric(m, an.get("median"))
+            out.append(f"<td>{spark}<small>{_esc(latest)} "
+                       f"(median {_esc(med)}, n={an.get('n')})"
+                       "</small></td>")
+        step = a["metrics"].get("step_p50") or {}
+        out.append(f"<td>{_trend_cell(step.get('trend'))}</td></tr>")
+    out.append("</table>")
+
+    # regression / event table
+    out.append("<h2>Changepoints &amp; drifts</h2>")
+    if not events:
+        out.append("<p class='ok'>none detected — the ledger looks "
+                   "healthy.</p>")
+    else:
+        out.append("<table><tr><th>kind</th><th>scenario</th>"
+                   "<th>metric</th><th>shift</th><th>sha range</th>"
+                   "<th>dominant phase</th></tr>")
+        for e in events:
+            cls = "up" if e["direction"] == "up" else "down"
+            before, at = e["sha_range"]
+            rng = (f"{_short(before)}..{_short(at)}"
+                   if at else "—")
+            out.append(
+                f"<tr><td>{e['kind']}</td>"
+                f"<td>{_esc(e['scenario'])} ({_esc(e['mode'])})</td>"
+                f"<td>{_esc(_METRIC_LABEL.get(e['metric'], e['metric']))}"
+                f"</td><td class='num {cls}'>{e['delta_frac']:+.1%}</td>"
+                f"<td>{_esc(rng)}</td>"
+                f"<td>{_esc(e['dominant_phase'] or '—')}</td></tr>")
+        out.append("</table>")
+
+    # flakiness ranking
+    out.append("<h2>Flakiness (noise sigma / median)</h2>")
+    if not flaky:
+        out.append("<p class='flat'>no series long enough yet.</p>")
+    else:
+        out.append("<table><tr><th>scenario</th><th>mode</th>"
+                   "<th>flakiness</th></tr>")
+        for scenario, mode, f in sorted(flaky, key=lambda r: -r[2]):
+            out.append(f"<tr><td>{_esc(scenario)}</td><td>{_esc(mode)}"
+                       f"</td><td class='num'>{f:.1%}</td></tr>")
+        out.append("</table>")
+
+    out.append("</body></html>")
+    return "".join(out)
+
+
+def default_report_path() -> str:
+    return os.path.join(os.path.dirname(ledger.default_ledger_path()),
+                        "report.html")
+
+
+def write_report(path: Optional[str] = None,
+                 ledger_path: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 window: Optional[int] = None,
+                 k: Optional[float] = None) -> str:
+    """Render the dashboard to ``path`` (atomic write); returns it."""
+    analyses = trends.scan_ledger(path=ledger_path, mode=mode,
+                                  window=window, k=k)
+    doc = render_html(analyses, ledger_path=ledger_path)
+    path = path or default_report_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fsio.atomic_write_bytes(path, doc.encode("utf-8"))
+    return path
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.bench.report",
+        description="render the self-contained perf trend dashboard "
+                    "(inline SVG, no external assets)")
+    ap.add_argument("--ledger", default=None, help="ledger path override")
+    ap.add_argument("--out", default=None,
+                    help="output path (default benchmarks/report.html)")
+    ap.add_argument("--mode", default=None, choices=("smoke", "full"),
+                    help="only render rows of this mode")
+    ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--k", type=float, default=None)
+    args = ap.parse_args(argv)
+    path = write_report(path=args.out, ledger_path=args.ledger,
+                        mode=args.mode, window=args.window, k=args.k)
+    print(f"perf dashboard -> {path}")  # noqa: print — CLI report
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,8 +31,9 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES",
-           "fingerprint", "new_row", "validate_row"]
+__all__ = ["SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES", "METRICS",
+           "fingerprint", "fingerprint_key", "metric_value", "new_row",
+           "validate_row"]
 
 SCHEMA_VERSION = 1
 KNOWN_SCHEMA_VERSIONS = (1,)
@@ -41,7 +42,41 @@ KNOWN_SCHEMA_VERSIONS = (1,)
 # row carries all four (0.0 when a scenario has no such phase)
 PHASES = ("data", "compute", "readback", "collective")
 
+# the metric axes the trend engine models as per-scenario series
+# (ISSUE 14); each maps to one numeric field of the row via
+# :func:`metric_value`
+METRICS = ("step_p50", "mfu", "compile_wall_ms", "bytes_on_wire",
+           "peak_hbm_bytes")
+
 _MODES = ("smoke", "full")
+
+
+def metric_value(row: Dict[str, Any], metric: str) -> Optional[float]:
+    """One :data:`METRICS` axis out of a row (None when the row doesn't
+    carry it — e.g. ``mfu`` on a vision scenario)."""
+    if metric == "step_p50":
+        v = (row.get("step_time_ms") or {}).get("p50")
+    elif metric == "mfu":
+        v = row.get("mfu")
+    elif metric == "compile_wall_ms":
+        v = (row.get("compile") or {}).get("wall_ms")
+    elif metric == "bytes_on_wire":
+        v = row.get("bytes_on_wire")
+    elif metric == "peak_hbm_bytes":
+        v = row.get("peak_hbm_bytes")
+    else:
+        raise KeyError(f"unknown metric {metric!r}; have {METRICS}")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def fingerprint_key(row: Dict[str, Any]) -> str:
+    """The series-partition key (ISSUE 14): rows from different hardware
+    or device counts never mix into one trend series — a CPU-smoke point
+    in a TPU series would read as a catastrophic changepoint."""
+    fp = row.get("fingerprint") or {}
+    return "%s/%s/x%s" % (fp.get("platform", "?"),
+                          fp.get("device_kind", row.get("device_kind", "?")),
+                          fp.get("device_count", "?"))
 
 
 def _git_sha() -> Optional[str]:

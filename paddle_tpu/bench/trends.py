@@ -1,0 +1,495 @@
+"""Perf trend engine (ISSUE 14): the ledger as a *series*, not pairs.
+
+perfdiff (ISSUE 13) compares two rows; this module models the whole
+ledger per scenario/mode/metric — sha-deduped, fingerprint-partitioned
+series (``ledger.read_series``) for step p50, MFU, compile wall,
+bytes-on-wire and peak HBM — and answers the questions a pair can't:
+
+- **noise floor** — median/MAD over a trailing window
+  (``PTPU_TREND_WINDOW``), so "how jittery is this scenario" is a
+  number, not folklore.  The robust per-point noise sigma comes from
+  first differences (MAD of diffs / sqrt(2)), which a single mean shift
+  cannot inflate the way a whole-series stddev can;
+- **changepoints** — robust mean-shift detection (binary segmentation;
+  a split fires only when the between-segment median gap clears
+  ``max(k * sigma, 5%)``, ``k`` = ``PTPU_TREND_K``), each attributed to
+  the **git-sha range** it landed in and — for step time — the
+  **dominant phase** via perfdiff's attribution, so a slow multi-commit
+  regression that pairwise perfdiff is blind to by construction gets a
+  name;
+- **drift** — a Theil–Sen slope whose cumulative movement is tested
+  against the noise of its own residuals, catching the creep that never
+  jumps;
+- **flakiness** — per-scenario noise-sigma / median, the score the
+  noise-aware gate (``bench.gate``) calibrates its threshold with.
+
+CLI::
+
+    python -m paddle_tpu.bench.trends [--mode smoke] [--scenario moe]
+                                      [--window N] [--k K] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import ledger
+from .schema import METRICS, PHASES
+
+__all__ = ["DEFAULT_WINDOW", "DEFAULT_K", "MIN_SHIFT_FRAC",
+           "SMALL_SERIES_FLOOR", "trend_window", "trend_k", "median",
+           "mad", "sigma_from_diffs", "noise_floor", "detect_changepoints",
+           "theil_sen", "median_row", "analyze_series", "scan_ledger",
+           "render_report", "main"]
+
+# trailing-window length for the noise floor / gate baseline
+# (override with PTPU_TREND_WINDOW)
+DEFAULT_WINDOW = 16
+# noise multiplier: a shift / threshold is k robust-sigmas
+# (override with PTPU_TREND_K)
+DEFAULT_K = 3.0
+# no shift smaller than this fraction is ever a changepoint, however
+# quiet the series (measurement resolution floor)
+MIN_SHIFT_FRAC = 0.05
+# when a segment is too short to estimate sigma from its diffs, demand a
+# shift this large instead (tiny series: evidence must be loud)
+SMALL_SERIES_FLOOR = 0.12
+# MAD → sigma for normal noise; diffs of iid noise carry sqrt(2) sigma
+_MAD_SCALE = 1.4826
+_EPS = 1e-12
+
+
+def trend_window() -> int:
+    return max(2, int(os.environ.get("PTPU_TREND_WINDOW", DEFAULT_WINDOW)))
+
+
+def trend_k() -> float:
+    return float(os.environ.get("PTPU_TREND_K", DEFAULT_K))
+
+
+# -- robust statistics ------------------------------------------------------
+def median(vals: Sequence[float]) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def mad(vals: Sequence[float]) -> Optional[float]:
+    """Raw median absolute deviation (unscaled)."""
+    m = median(vals)
+    if m is None:
+        return None
+    return median([abs(v - m) for v in vals])
+
+
+def sigma_from_diffs(values: Sequence[float],
+                     exclude: Optional[int] = None) -> Optional[float]:
+    """Robust per-point noise sigma from first differences.
+
+    A single mean shift contaminates exactly one diff, which the MAD
+    shrugs off (and ``exclude`` drops a candidate changepoint's own
+    jump before estimating).  Returns None below 3 usable diffs — too
+    little data to call anything noise.
+    """
+    diffs = [values[i + 1] - values[i] for i in range(len(values) - 1)]
+    if exclude is not None and 0 <= exclude < len(diffs):
+        diffs = diffs[:exclude] + diffs[exclude + 1:]
+    if len(diffs) < 3:
+        return None
+    m = mad(diffs)
+    return _MAD_SCALE * m / math.sqrt(2.0) if m is not None else None
+
+
+def noise_floor(values: Sequence[float],
+                window: Optional[int] = None
+                ) -> Tuple[Optional[float], Optional[float]]:
+    """(median, MAD) over the trailing ``window`` points — the gate's
+    baseline and its noise calibration."""
+    if window is None:
+        window = trend_window()
+    win = list(values)[-window:]
+    return median(win), mad(win)
+
+
+def theil_sen(values: Sequence[float]) -> float:
+    """Median of pairwise slopes — a robust per-point drift rate."""
+    n = len(values)
+    slopes = [(values[j] - values[i]) / (j - i)
+              for i in range(n) for j in range(i + 1, n)]
+    return median(slopes) or 0.0
+
+
+# -- changepoints -----------------------------------------------------------
+def detect_changepoints(values: Sequence[float],
+                        k: Optional[float] = None,
+                        min_frac: float = MIN_SHIFT_FRAC
+                        ) -> List[Dict[str, Any]]:
+    """Mean-shift changepoints by robust binary segmentation.
+
+    A split at ``t`` (the first index of the new regime) fires when the
+    gap between segment medians exceeds ``max(k * sigma, min_frac *
+    level)`` — sigma from the segment's first differences with the
+    candidate jump excluded, so the shift can't hide itself in its own
+    noise estimate.  Segments too short for a sigma estimate fall back
+    to the louder :data:`SMALL_SERIES_FLOOR`.  Pure noise produces no
+    changepoints at any window length; recursion finds multiple shifts.
+    """
+    if k is None:
+        k = trend_k()
+    values = [float(v) for v in values]
+    found: List[Dict[str, Any]] = []
+
+    def sad(vals: Sequence[float]) -> float:
+        m = median(vals)
+        return sum(abs(v - m) for v in vals)
+
+    def scan(lo: int, hi: int) -> None:
+        if hi - lo < 3:
+            return
+        seg = values[lo:hi]
+        base_cost = sad(seg)
+        # best split = largest reduction in within-segment spread — the
+        # classic binseg objective, which lands on the regime boundary
+        # instead of whichever noise excursion has the loudest median gap
+        best: Optional[Tuple[float, int]] = None
+        for t in range(lo + 1, hi):
+            gain = base_cost - (sad(values[lo:t]) + sad(values[t:hi]))
+            if best is None or gain > best[0]:
+                best = (gain, t)
+        if best is None:
+            return
+        t = best[1]
+        ml = median(values[lo:t])
+        mr = median(values[t:hi])
+        level = max(abs(ml), _EPS)
+        rel = abs(mr - ml) / level
+        sigma = sigma_from_diffs(seg, exclude=t - 1 - lo)
+        if sigma is not None:
+            # the gap of two segment *medians* is much tighter than one
+            # point (std of a median shrinks with sqrt(n)); the sqrt(ln)
+            # factor pays for testing the *best* of ~n candidate splits
+            # instead of one chosen a priori.  The overall constant is
+            # Monte-Carlo calibrated: ~4% false positives on pure +-8%
+            # jitter at k=3, <10% misses on a 20% shift under the same.
+            gap_sigma = (sigma
+                         * math.sqrt(1.0 / (t - lo) + 1.0 / (hi - t))
+                         * math.sqrt(max(1.0, math.log(hi - lo))))
+            thr = max(k * gap_sigma / level, min_frac)
+        else:
+            thr = max(min_frac, SMALL_SERIES_FLOOR)
+        if rel <= thr:
+            return
+        found.append({
+            "index": t,
+            "before_median": ml,
+            "after_median": mr,
+            "delta_frac": (mr - ml) / max(abs(ml), _EPS),
+            "direction": "up" if mr > ml else "down",
+        })
+        scan(lo, t)
+        scan(t, hi)
+
+    scan(0, len(values))
+    found.sort(key=lambda c: c["index"])
+    return found
+
+
+# -- series → analysis ------------------------------------------------------
+def median_row(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """A pseudo-row of per-field medians over ``rows`` — the
+    trailing-window baseline the noise-aware gate and
+    ``perfdiff --baseline median:N`` compare against.  Carries every
+    field perfdiff's attribution reads."""
+    if not rows:
+        raise ValueError("median_row of an empty window")
+
+    def med_of(get) -> Optional[float]:
+        vals = [v for v in (get(r) for r in rows)
+                if isinstance(v, (int, float))]
+        return median(vals)
+
+    newest = rows[-1]
+    return {
+        "scenario": newest.get("scenario"),
+        "mode": newest.get("mode"),
+        "git_sha": f"median:{len(rows)}",
+        "device_kind": newest.get("device_kind"),
+        "fingerprint": newest.get("fingerprint"),
+        "step_time_ms": {
+            "p50": med_of(lambda r: (r.get("step_time_ms") or {}).get("p50")),
+            "p99": med_of(lambda r: (r.get("step_time_ms") or {}).get("p99")),
+        },
+        "phases_ms": {p: (med_of(lambda r, _p=p:
+                                 (r.get("phases_ms") or {}).get(_p)) or 0.0)
+                      for p in PHASES},
+        "compile": {"wall_ms": med_of(
+            lambda r: (r.get("compile") or {}).get("wall_ms"))},
+        "mfu": med_of(lambda r: r.get("mfu")),
+        "bytes_on_wire": med_of(lambda r: r.get("bytes_on_wire")),
+        "peak_hbm_bytes": med_of(lambda r: r.get("peak_hbm_bytes")),
+    }
+
+
+def _short(sha: Optional[str]) -> str:
+    return sha[:8] if isinstance(sha, str) else "?"
+
+
+def analyze_series(points: List[Dict[str, Any]],
+                   window: Optional[int] = None,
+                   k: Optional[float] = None) -> Dict[str, Any]:
+    """Full trend analysis of one ``read_series`` result: trailing
+    noise floor, trend direction of the newest point, changepoints with
+    sha-range attribution, Theil–Sen drift, flakiness."""
+    if window is None:
+        window = trend_window()
+    if k is None:
+        k = trend_k()
+    values = [p["value"] for p in points]
+    n = len(values)
+    out: Dict[str, Any] = {"n": n, "values": values,
+                           "shas": [p.get("sha") for p in points],
+                           "window": window, "k": k}
+    if n == 0:
+        out.update({"median": None, "mad": None, "noise_frac": None,
+                    "flakiness": None, "latest": None, "trend": None,
+                    "changepoints": [], "drift": None})
+        return out
+    med, madv = noise_floor(values, window)
+    level = max(abs(med), _EPS)
+    noise_frac = (_MAD_SCALE * madv / level) if madv is not None else None
+    sigma = sigma_from_diffs(values)
+    flakiness = (sigma / level) if sigma is not None else noise_frac
+    out.update({"median": med, "mad": madv, "noise_frac": noise_frac,
+                "flakiness": flakiness, "latest": values[-1]})
+
+    # trend direction of the newest point vs the trailing median of what
+    # came before it, with a noise-calibrated dead band
+    trend = None
+    if n >= 2:
+        prior_med, prior_mad = noise_floor(values[:-1], window)
+        base = max(abs(prior_med), _EPS)
+        band = max(0.02, k * _MAD_SCALE * (prior_mad or 0.0) / base)
+        rel = (values[-1] - prior_med) / base
+        trend = "up" if rel > band else ("down" if rel < -band else "flat")
+        out["trend_rel"] = rel
+    out["trend"] = trend
+
+    cps = detect_changepoints(values, k=k)
+    for cp in cps:
+        i = cp["index"]
+        cp["sha_range"] = (points[i - 1].get("sha") if i > 0 else None,
+                           points[i].get("sha"))
+        cp["ts"] = points[i].get("ts")
+    out["changepoints"] = cps
+
+    drift = None
+    if n >= 5:
+        slope = theil_sen(values)
+        resid = [v - slope * i for i, v in enumerate(values)]
+        resid_mad = mad(resid) or 0.0
+        sigma_r = _MAD_SCALE * resid_mad
+        total = slope * (n - 1)
+        total_frac = total / level
+        drift = {
+            "slope_per_point": slope,
+            "total_frac": total_frac,
+            "residual_sigma_frac": sigma_r / level,
+            "flagged": abs(total_frac) > max(MIN_SHIFT_FRAC,
+                                             k * sigma_r / level),
+            "direction": "up" if slope > 0 else "down",
+        }
+    out["drift"] = drift
+    return out
+
+
+def scan_ledger(path: Optional[str] = None,
+                rows: Optional[List[Dict[str, Any]]] = None,
+                mode: Optional[str] = None,
+                scenario_names: Optional[List[str]] = None,
+                window: Optional[int] = None,
+                k: Optional[float] = None,
+                metrics: Sequence[str] = METRICS) -> List[Dict[str, Any]]:
+    """Analyze every (scenario, mode) series in the ledger.  Returns one
+    entry per scenario/mode with a per-metric analysis; step-time
+    changepoints are additionally attributed to their dominant phase
+    via perfdiff over the segment medians."""
+    from . import diff as perfdiff
+    if rows is None:
+        rows = ledger.read_ledger(path)
+    keys = sorted({(str(r.get("scenario")), str(r.get("mode")))
+                   for r in rows
+                   if isinstance(r.get("scenario"), str)})
+    analyses: List[Dict[str, Any]] = []
+    for scenario, m in keys:
+        if mode is not None and m != mode:
+            continue
+        if scenario_names and scenario not in scenario_names:
+            continue
+        per_metric: Dict[str, Dict[str, Any]] = {}
+        step_points: List[Dict[str, Any]] = []
+        for metric in metrics:
+            points = ledger.read_series(scenario, m, metric, rows=rows)
+            if metric == "step_p50":
+                step_points = points
+            per_metric[metric] = analyze_series(points, window=window, k=k)
+        # dominant-phase attribution for step-time changepoints: compare
+        # the median pseudo-rows of the segments either side of the shift
+        step = per_metric.get("step_p50") or {}
+        cps = step.get("changepoints") or []
+        bounds = [0] + [cp["index"] for cp in cps] + [len(step_points)]
+        for ci, cp in enumerate(cps):
+            before = [p["row"] for p in step_points[bounds[ci]:cp["index"]]]
+            after = [p["row"]
+                     for p in step_points[cp["index"]:bounds[ci + 2]]]
+            if before and after:
+                att = perfdiff.attribute(median_row(before),
+                                         median_row(after))
+                cp["dominant_phase"] = att["dominant"]
+                cp["movers"] = att["movers"]
+        entry = {
+            "scenario": scenario,
+            "mode": m,
+            "partition": (step_points and
+                          _partition_of(step_points[-1]["row"])) or None,
+            "metrics": per_metric,
+            "flakiness": step.get("flakiness"),
+            "trend": step.get("trend"),
+            "last_changepoint": (cps[-1] if cps else None),
+        }
+        analyses.append(entry)
+    return analyses
+
+
+def _partition_of(row: Dict[str, Any]) -> str:
+    from .schema import fingerprint_key
+    return fingerprint_key(row)
+
+
+# -- report -----------------------------------------------------------------
+_METRIC_FMT = {
+    "step_p50": ("step p50", lambda v: f"{v:.2f}ms"),
+    "mfu": ("MFU", lambda v: f"{v:.4%}"),
+    "compile_wall_ms": ("compile wall", lambda v: f"{v:.0f}ms"),
+    "bytes_on_wire": ("bytes on wire", lambda v: f"{v:,.0f}B"),
+    "peak_hbm_bytes": ("peak HBM", lambda v: f"{v / (1 << 20):.1f}MiB"),
+}
+
+
+def _fmt_metric(metric: str, v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    return _METRIC_FMT.get(metric, (metric, lambda x: f"{x:.3g}"))[1](v)
+
+
+def render_report(analyses: List[Dict[str, Any]]) -> str:
+    """The doctor-style text report of ``python -m
+    paddle_tpu.bench.trends``."""
+    lines: List[str] = []
+    if not analyses:
+        return ("perf trends: no ledger series yet — run "
+                "`python -m paddle_tpu.bench --all --smoke` first")
+    regressions = 0
+    for a in analyses:
+        step = a["metrics"].get("step_p50") or {}
+        n = step.get("n", 0)
+        head = (f"{a['scenario']} ({a['mode']}"
+                + (f", {a['partition']}" if a.get("partition") else "")
+                + f"): {n} point(s)")
+        if n == 0:
+            lines.append(head)
+            continue
+        noise = step.get("noise_frac")
+        flaky = step.get("flakiness")
+        head += (f", step p50 {_fmt_metric('step_p50', step.get('latest'))}"
+                 f" vs trailing median "
+                 f"{_fmt_metric('step_p50', step.get('median'))}"
+                 + (f", noise floor ±{noise:.1%}" if noise is not None
+                    else "")
+                 + (f", flakiness {flaky:.1%}" if flaky is not None else "")
+                 + (f", trend {step.get('trend')}" if step.get("trend")
+                    else ""))
+        lines.append(head)
+        for metric, an in a["metrics"].items():
+            for cp in an.get("changepoints") or []:
+                label = _METRIC_FMT.get(metric, (metric, None))[0]
+                before, at = cp["sha_range"]
+                seg = (f"  changepoint in {label}: sha range "
+                       f"{_short(before)}..{_short(at)} "
+                       f"(point {cp['index'] + 1}/{an['n']}): "
+                       f"{_fmt_metric(metric, cp['before_median'])} -> "
+                       f"{_fmt_metric(metric, cp['after_median'])} "
+                       f"({cp['delta_frac']:+.1%})")
+                if cp.get("dominant_phase"):
+                    seg += f", dominant phase: {cp['dominant_phase']}"
+                lines.append(seg)
+                if metric == "step_p50" and cp["direction"] == "up":
+                    regressions += 1
+            drift = an.get("drift")
+            if drift and drift.get("flagged"):
+                label = _METRIC_FMT.get(metric, (metric, None))[0]
+                lines.append(
+                    f"  drift in {label}: {drift['total_frac']:+.1%} over "
+                    f"{an['n']} points "
+                    f"({drift['slope_per_point']:+.3g}/point, residual "
+                    f"noise ±{drift['residual_sigma_frac']:.1%})")
+                if metric == "step_p50" and drift["direction"] == "up":
+                    regressions += 1
+    flaky_rows = [(a["scenario"], a["mode"], a.get("flakiness"))
+                  for a in analyses if a.get("flakiness") is not None]
+    if flaky_rows:
+        lines.append("scenario flakiness (noise sigma / median, "
+                     "worst first):")
+        for scenario, m, f in sorted(flaky_rows, key=lambda r: -r[2]):
+            lines.append(f"  {scenario:<22} {m:<6} {f:6.1%}")
+    lines.append(f"{regressions} upward step-time shift(s)/drift(s) "
+                 "across the ledger"
+                 if regressions else
+                 "no upward step-time shifts or drifts — the ledger "
+                 "looks healthy")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.bench.trends",
+        description="perf trend engine: noise floors, changepoints with "
+                    "sha-range + phase attribution, drift, flakiness")
+    ap.add_argument("--ledger", default=None, help="ledger path override")
+    ap.add_argument("--mode", default=None, choices=("smoke", "full"),
+                    help="only analyze rows of this mode")
+    ap.add_argument("--scenario", action="append", default=[],
+                    metavar="NAME", help="restrict to one scenario "
+                                         "(repeatable)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="trailing window (default PTPU_TREND_WINDOW, "
+                         f"else {DEFAULT_WINDOW})")
+    ap.add_argument("--k", type=float, default=None,
+                    help="noise multiplier (default PTPU_TREND_K, "
+                         f"else {DEFAULT_K})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analyses as JSON")
+    args = ap.parse_args(argv)
+    analyses = scan_ledger(path=args.ledger, mode=args.mode,
+                           scenario_names=args.scenario or None,
+                           window=args.window, k=args.k)
+    if args.json:
+        slim = []
+        for a in analyses:
+            slim.append({**a, "metrics": {
+                m: {k2: v for k2, v in an.items() if k2 != "values"}
+                for m, an in a["metrics"].items()}})
+        print(json.dumps(slim, indent=1, default=str))  # noqa: print — CLI report
+    else:
+        print(render_report(analyses))  # noqa: print — CLI report
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -108,7 +108,29 @@ def maybe_enable_persistent_cache(registry=None) -> Optional[str]:
 def reset_for_tests() -> None:
     """Forget the configured state so a test can re-enable with a fresh
     dir.  Does not unregister the jax listener (jax keeps listeners for
-    the process lifetime); re-enabling is still idempotent."""
+    the process lifetime); re-enabling is still idempotent.
+
+    Also undoes the jax-side config when we had enabled it: leaving
+    ``jax_compilation_cache_dir`` latched bleeds disk-cache warm starts
+    into every later compile in the process — concretely, a test that
+    enabled the cache made the doctor-e2e straggler drill misattribute
+    the slow worker (worker 0 paid cold compiles, worker 1 got warm
+    hits and outran its injected delay)."""
     with _lock:
+        was_enabled = _state["configured"] and _state["dir"]
         _state["configured"] = False
         _state["dir"] = None
+        if not was_enabled:
+            return
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:  # noqa: swallow
+            pass  # knob absent on older jax
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:  # noqa: swallow
+            pass  # no latch to clear on this jax

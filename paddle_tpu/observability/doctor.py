@@ -31,6 +31,11 @@ evidence lines):
                        compress the dp sync or shard the weight update
                        (``distributed/comm``, ISSUE 8).
 - ``data_starved``   — data-wait dominates the step-time breakdown.
+- ``perf_trend``     — the ledger *series* for a benched scenario shows
+                       an upward step-time changepoint (named by git-sha
+                       range and dominant phase, via ``bench.trends``)
+                       or a flagged upward drift — the multi-commit
+                       creep a pairwise golden comparison can't see.
 - ``unstable``       — the supervisor logged rollbacks / watchdog
                        timeouts / step failures (corroborating context,
                        ranked below the causes above).
@@ -55,7 +60,7 @@ from .sinks import metrics_dir
 __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_memory", "check_straggler", "check_data_starved",
            "check_comm_bound", "check_supervisor",
-           "check_perf_regression"]
+           "check_perf_regression", "check_perf_trend"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -450,6 +455,71 @@ def check_perf_regression(workers, golden=None) -> List[Dict[str, Any]]:
     return findings
 
 
+def check_perf_trend(workers, rows=None) -> List[Dict[str, Any]]:
+    """ISSUE 14: series-aware verdicts over the perf ledger, gated on
+    ``bench.row`` records in the telemetry window (a run that benched
+    nothing gets no trend findings — the global ledger is someone else's
+    history).  For each benched scenario, ``bench.trends`` analyzes its
+    sha-deduped series; the newest upward step-time changepoint (named
+    by git-sha range and dominant phase) and/or a flagged upward drift
+    become one ``perf_trend`` finding with the drift magnitude."""
+    scenarios = set()
+    for records in workers.values():
+        for r in records:
+            if (r.get("kind") == "bench.row"
+                    and isinstance(r.get("scenario"), str)):
+                scenarios.add(r["scenario"])
+    if not scenarios:
+        return []
+    from ..bench import trends
+    findings: List[Dict[str, Any]] = []
+    for a in trends.scan_ledger(rows=rows,
+                                scenario_names=sorted(scenarios)):
+        step = a["metrics"].get("step_p50") or {}
+        ups = [cp for cp in (step.get("changepoints") or [])
+               if cp["direction"] == "up"]
+        cp = ups[-1] if ups else None
+        drift = step.get("drift")
+        drifting = bool(drift and drift.get("flagged")
+                        and drift["direction"] == "up")
+        if cp is None and not drifting:
+            continue
+        ev: List[str] = []
+        magnitude = 0.0
+        title_bits: List[str] = []
+        if cp is not None:
+            before, at = cp.get("sha_range") or (None, None)
+            dom = cp.get("dominant_phase") or "unattributed"
+            ev.append(
+                f"step p50 shifted {cp['delta_frac']:+.1%} at sha range "
+                f"{(before or '?')[:8]}..{(at or '?')[:8]} "
+                f"({cp['before_median']:.2f}ms -> "
+                f"{cp['after_median']:.2f}ms), dominant phase: {dom}")
+            magnitude = max(magnitude, cp["delta_frac"])
+            title_bits.append(f"{cp['delta_frac']:+.1%} shift "
+                              f"ending at {(at or '?')[:8]} ({dom})")
+        if drifting:
+            ev.append(
+                f"step p50 drifting {drift['total_frac']:+.1%} across "
+                f"{step.get('n')} commits "
+                f"({drift['slope_per_point']:+.3g}ms/commit, residual "
+                f"noise ±{drift['residual_sigma_frac']:.1%})")
+            magnitude = max(magnitude, drift["total_frac"])
+            title_bits.append(f"{drift['total_frac']:+.1%} drift")
+        ev.append("series report: python -m paddle_tpu.bench.trends "
+                  f"--scenario {a['scenario']}")
+        findings.append(_finding(
+            "perf_trend", 35 + 45 * min(1.0, magnitude / 0.5),
+            f"perf trend in {a['scenario']}: " + ", ".join(title_bits),
+            ev, scenario=a["scenario"], mode=a["mode"],
+            delta_frac=magnitude,
+            sha_range=(cp.get("sha_range") if cp else None),
+            dominant=(cp.get("dominant_phase") if cp else None),
+            drift_frac=(drift.get("total_frac") if drifting else None),
+            flakiness=a.get("flakiness")))
+    return findings
+
+
 def check_supervisor(events) -> List[Dict[str, Any]]:
     if not events:
         return []
@@ -546,6 +616,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_data_starved(workers)
     findings += check_comm_bound(workers)
     findings += check_perf_regression(workers)
+    findings += check_perf_trend(workers)
     findings += check_integrity(events)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))

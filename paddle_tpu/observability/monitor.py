@@ -281,6 +281,31 @@ class StatusServer:
                 {"scenario": f["data"].get("scenario"),
                  "dominant": f["data"].get("dominant"),
                  "title": f["title"]} for f in regressions] or None)
+            # trend engine (ISSUE 14): per-scenario direction vs the
+            # trailing median plus the last detected changepoint, from
+            # the ledger series (step-time axis only — statusz is a
+            # glance, the full report is `python -m paddle_tpu.bench
+            # .trends` / bench.report)
+            try:
+                from ..bench import trends as bench_trends
+                trend_info: Dict[str, Any] = {}
+                for a in bench_trends.scan_ledger(
+                        scenario_names=sorted(scen),
+                        metrics=("step_p50",)):
+                    cp = a.get("last_changepoint")
+                    trend_info[f"{a['scenario']}/{a['mode']}"] = {
+                        "trend": a.get("trend"),
+                        "flakiness": a.get("flakiness"),
+                        "last_changepoint": ({
+                            "sha_range": cp.get("sha_range"),
+                            "delta_frac": cp.get("delta_frac"),
+                            "direction": cp.get("direction"),
+                            "dominant_phase": cp.get("dominant_phase"),
+                        } if cp else None),
+                    }
+                perf["trends"] = trend_info or None
+            except Exception:  # noqa: swallow — statusz must render
+                perf["trends"] = None
         status["perf"] = perf or None
         if sup is not None:
             if status["step"] is None:
@@ -516,6 +541,7 @@ class LiveAggregator:
         findings += doctor.check_data_starved(workers)
         findings += doctor.check_comm_bound(workers)
         findings += doctor.check_perf_regression(workers)
+        findings += doctor.check_perf_trend(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

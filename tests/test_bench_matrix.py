@@ -188,6 +188,11 @@ def _setup_gate(tmp_path, base_p50=40.0, cur_p50=40.0, scenario="moe"):
     gpath = str(tmp_path / "golden.json")
     ledger.write_golden(ledger.golden_from_rows(
         {scenario: _mk_row(scenario=scenario, p50=base_p50)}), gpath)
+    # three identical prior rows give the noise-aware gate its history:
+    # trailing median = base_p50, MAD = 0, so the threshold collapses to
+    # the golden fraction and the edge-case contracts below stay exact
+    for _ in range(3):
+        ledger.append_row(_mk_row(scenario=scenario, p50=base_p50), lpath)
     ledger.append_row(_mk_row(scenario=scenario, p50=cur_p50), lpath)
     return lpath, gpath
 

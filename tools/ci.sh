@@ -366,17 +366,29 @@ print(f"integrity overhead: {frac:.3%} of step time (< 1% bound)")
 PYEOF
     BENCH_CPU=1 BENCH_SKIP_SLICE=1 python bench.py > /dev/null
     BENCH_CPU=1 python examples/gpt_generate.py --bench_serve > /dev/null
-    # perf tier (ISSUE 13): the scenario matrix in smoke mode against a
-    # throwaway ledger, gated on benchmarks/golden.json — >10% step-time
-    # p50 regression on any blessed scenario fails rc 1 with the
-    # perfdiff attribution report (re-bless after an intentional change:
-    # python -m paddle_tpu.bench.gate --write-golden)
-    PERF_TMP=$(mktemp -d)
-    JAX_PLATFORMS=cpu python -m paddle_tpu.bench --all --smoke \
-        --ledger "$PERF_TMP/ledger.jsonl" > /dev/null
-    JAX_PLATFORMS=cpu python -m paddle_tpu.bench.gate \
-        --ledger "$PERF_TMP/ledger.jsonl"
-    rm -rf "$PERF_TMP"
+    # perf tier (ISSUE 13 → 14): the scenario matrix in smoke mode
+    # appends this run's rows to the REAL ledger (benchmarks/
+    # ledger.jsonl is the project's performance memory, not a throwaway),
+    # then the trend engine + dashboard smokes and the noise-aware gate
+    # run against the accumulated series (re-bless after an intentional
+    # change: python -m paddle_tpu.bench.gate --write-golden)
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench --all --smoke > /dev/null
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench.trends
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench.report
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+from paddle_tpu.bench.report import default_report_path
+from paddle_tpu.bench.scenarios import names
+doc = open(default_report_path(), encoding="utf-8").read()
+assert doc.strip(), "dashboard rendered empty"
+missing = [n for n in names() if n not in doc]
+assert not missing, f"dashboard missing scenario(s): {missing}"
+for banned in ("http://", "https://", "<script", "@import"):
+    assert banned not in doc, f"dashboard not self-contained: {banned}"
+print(f"dashboard: {len(doc)} bytes, all {len(names())} scenarios, "
+      "self-contained")
+PYEOF
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench.gate
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench.ledger --compact
     # warm-start drill (ROADMAP 5a): the persistent-compile-cache test is
     # `slow` (two fresh jax processes), so tier-1 skips it — run it here
     python -m pytest -q -m slow tests/test_compile_cache.py
@@ -384,6 +396,7 @@ PYEOF
          "smoke + monitor smoke + serving tier + serve smoke + kernels" \
          "tier + fused-block smoke + comm tier + comm smoke + elastic" \
          "tier + elastic smoke + integrity tier + integrity smoke +" \
-         "integrity overhead + bench smoke + perf tier + warm-start ok"
+         "integrity overhead + bench smoke + perf tier + trends +" \
+         "dashboard + warm-start ok"
 fi
 echo "shard ${SHARD} green"
