@@ -15,6 +15,13 @@ Run: python examples/gpt_generate.py              (~1 min on CPU)
         skip training; push 8 concurrent synthetic streams through the
         engine and print one JSON row (tokens/s, TTFT/TPOT p50/p99,
         serve-mode MFU via the shared observability/mfu definitions).
+     python examples/gpt_generate.py --chaos_serve
+        the ISSUE 15 resilience drill: poison one of 8 concurrent
+        ragged streams mid-batch and prove the engine quarantines
+        exactly that request (durable record), every peer's output is
+        token-identical to the un-faulted run, and the KV allocator
+        returns to baseline; then drain under load, spill, and resume
+        the spill on a fresh engine to completion.
 """
 import json
 import os
@@ -182,10 +189,95 @@ def bench_serve(n_streams: int = 8, max_new_tokens: int = 10):
     return row
 
 
+def chaos_serve(n_streams: int = 8, max_new_tokens: int = 8):
+    """The serving-resilience drill (ISSUE 15), two acts:
+
+    1. **Quarantine**: run ``n_streams`` ragged streams clean, then the
+       same traffic with ``faults.poison_request`` on stream 3 — the
+       engine must evict exactly that stream (``reason="poisoned"``,
+       durable record under run_dir), every other stream token-exact vs
+       the clean run, allocator occupancy back to baseline.
+    2. **Drain/resume**: under fresh load, ``drain(timeout=)`` finishes
+       the running set, spills the rest, and a brand-new engine
+       ``resume()``s the spill to completion.
+    """
+    import tempfile
+
+    from paddle_tpu.observability.registry import MetricsRegistry
+    from paddle_tpu.testing import faults
+
+    cfg = _tiny_config()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(7)
+    prompts = [[BOS] + rng.randint(1, 4, rng.randint(2, 6)).tolist()
+               for _ in range(n_streams)]
+
+    def run_traffic(step_fault=None, run_dir=None):
+        eng = ServingEngine(model, max_seqs=n_streams, kv_block_size=4,
+                            registry=MetricsRegistry(), run_dir=run_dir,
+                            step_fault=step_fault)
+        baseline = eng.cache.allocator.num_used
+        rids = [eng.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        eng.run(max_steps=2000)
+        outs = {i: eng.collect(r) for i, r in enumerate(rids)}
+        return eng, outs, baseline
+
+    # act 1: clean reference, then the poisoned replay
+    _eng, clean, _ = run_traffic()
+    with tempfile.TemporaryDirectory() as run_dir:
+        injector = faults.poison_request(3, mode="raise")
+        eng, poisoned, baseline = run_traffic(step_fault=injector,
+                                              run_dir=run_dir)
+        assert poisoned[3]["finish_reason"] == "poisoned", poisoned[3]
+        assert list(eng.quarantined) == [eng._submit_order[3]]
+        qdir = os.path.join(run_dir, "serve_quarantine")
+        assert len(os.listdir(qdir)) == 1, os.listdir(qdir)
+        exact = sum(poisoned[i]["tokens"] == clean[i]["tokens"]
+                    for i in range(n_streams) if i != 3)
+        assert exact == n_streams - 1, \
+            f"only {exact}/{n_streams - 1} peers token-exact"
+        assert eng.cache.allocator.num_used == baseline, \
+            eng.cache.leak_report()
+        print(f"chaos_serve: poisoned stream quarantined ({injector.fired}"
+              f" injections), {exact}/{n_streams - 1} peers token-exact, "
+              f"allocator back to baseline")
+
+    # act 2: drain under load, resume the spill on a fresh engine
+    with tempfile.TemporaryDirectory() as run_dir:
+        eng = ServingEngine(model, max_seqs=2, kv_block_size=4,
+                            registry=MetricsRegistry(), run_dir=run_dir)
+        rids = [eng.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        for _ in range(3):
+            eng.step()            # start some work, leave the rest queued
+        report = eng.drain(timeout=30.0)
+        assert eng.state == "stopped"
+        assert not report["timed_out"], report
+        done = sum(1 for r in rids if eng.sched.finished[r].finish_reason
+                   in ("eos", "max_new_tokens"))
+        assert done + report["spilled"] == n_streams, (done, report)
+        fresh = ServingEngine(model, max_seqs=2, kv_block_size=4,
+                              registry=MetricsRegistry())
+        if report["spilled"]:
+            resumed = fresh.resume(report["spill_path"])
+            fresh.run(max_steps=2000)
+            for r in resumed:
+                out = fresh.collect(r)
+                assert out["finish_reason"] in ("eos", "max_new_tokens")
+        print(f"chaos_serve: drain finished {report['finished']}, "
+              f"spilled {report['spilled']}, resumed to completion")
+    print("chaos_serve OK")
+
+
 def main():
     pt.seed(11)
     if "--bench_serve" in sys.argv:
         bench_serve()
+        return
+    if "--chaos_serve" in sys.argv:
+        chaos_serve()
         return
     model = GPTForCausalLM(_tiny_config())
     params = train(model)

@@ -278,11 +278,11 @@ __all__ += ["DataType", "PlaceType", "PrecisionType", "Tensor",
 
 
 # -- the serving subsystem (ISSUE 6) ----------------------------------------
-from .engine import ServingEngine  # noqa: E402
+from .engine import CollectTimeout, ServingEngine  # noqa: E402
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: E402
 from .paged_attention import paged_attention  # noqa: E402
 from .scheduler import ContinuousBatchingScheduler  # noqa: E402
 
-__all__ += ["ServingEngine", "PagedKVCache", "BlockAllocator",
-            "ContinuousBatchingScheduler", "paged_attention",
-            "EnginePredictor"]
+__all__ += ["ServingEngine", "CollectTimeout", "PagedKVCache",
+            "BlockAllocator", "ContinuousBatchingScheduler",
+            "paged_attention", "EnginePredictor"]

@@ -36,17 +36,43 @@ the admission queue exceeds ``PTPU_SHED_QUEUE_DEPTH`` — load shedding).
 
 Token callbacks (``submit(..., on_token=fn)``) are dispatched from a
 separate drain thread: a slow consumer (``testing/faults.slow_call``)
-delays its own stream, never the batch.
+delays its own stream, never the batch.  Consumer exceptions are
+counted (``serve.callback_errors``) and timelined, never fatal.
+
+The request-lifecycle guard (ISSUE 15) wraps all of the above in the
+same robustness treatment the training path earned:
+
+- **deadlines & cancellation** — ``submit(deadline_ms=,
+  ttft_deadline_ms=)`` and ``cancel(rid)``; a between-steps reaper
+  evicts expired/cancelled sequences with every KV block returned and a
+  terminal reason (``deadline`` / ``cancelled``) through ``collect()``
+  and the callback path;
+- **poisoned-request quarantine** — the jitted step runs inside a fault
+  boundary; a step exception (or a nonfinite logits row under
+  ``PTPU_SERVE_NAN_GUARD``) bisects the batch, evicts the culprit(s)
+  with ``reason="poisoned"`` plus a durable record under
+  ``<run_dir>/serve_quarantine/``, and replays the step so every other
+  request completes token-exact (decode rows are independent);
+- **supervision + graceful drain** — ``step()`` arms the PR 2 watchdog
+  (a hung step gets a stack dump; the engine rebuilds its jitted fns
+  and re-admits the running set via recompute-prefill), and
+  ``drain(timeout=)`` stops admission (``/healthz`` → 503 ``draining``),
+  finishes what it can, spills the rest to a JSON file a fresh engine
+  ``resume()``s from, then stops the callback thread.
 
 Env knobs: ``PTPU_MAX_SEQS``, ``PTPU_KV_BLOCK_SIZE``,
-``PTPU_SHED_QUEUE_DEPTH``.  Single-host by design: the page scatter and
-the Pallas kernel are opaque to GSPMD (the engine enforces no mesh).
+``PTPU_SHED_QUEUE_DEPTH``, ``PTPU_SERVE_NAN_GUARD``,
+``PTPU_SERVE_DEADLINE_MS``, ``PTPU_SERVE_DRAIN_SECS``.  Single-host by
+design: the page scatter and the Pallas kernel are opaque to GSPMD (the
+engine enforces no mesh).
 """
 from __future__ import annotations
 
 import itertools
+import json
 import os
 import queue
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -58,17 +84,26 @@ import jax.numpy as jnp
 
 from ..framework.errors import enforce
 from ..observability.compilation import track_jit
+from ..supervisor.watchdog import StepTimeout, Watchdog, guarded
+from ..utils import fsio
 from .kv_cache import PagedKVCache, default_kv_block_size
 from .scheduler import (ContinuousBatchingScheduler, SequenceState,
                         StepPlan)
 
-__all__ = ["MAX_SEQS_ENV", "SHED_QUEUE_DEPTH_ENV", "default_max_seqs",
-           "default_shed_queue_depth", "ServingEngine"]
+__all__ = ["MAX_SEQS_ENV", "SHED_QUEUE_DEPTH_ENV", "NAN_GUARD_ENV",
+           "DEADLINE_MS_ENV", "DRAIN_SECS_ENV", "default_max_seqs",
+           "default_shed_queue_depth", "default_nan_guard",
+           "default_deadline_ms", "default_drain_secs", "CollectTimeout",
+           "ServingEngine"]
 
 MAX_SEQS_ENV = "PTPU_MAX_SEQS"
 SHED_QUEUE_DEPTH_ENV = "PTPU_SHED_QUEUE_DEPTH"
+NAN_GUARD_ENV = "PTPU_SERVE_NAN_GUARD"
+DEADLINE_MS_ENV = "PTPU_SERVE_DEADLINE_MS"
+DRAIN_SECS_ENV = "PTPU_SERVE_DRAIN_SECS"
 
 _PAD_SEQ = "__pad__"          # never a real request id
+_CB_STOP = object()           # callback-thread shutdown sentinel
 
 
 def default_max_seqs() -> int:
@@ -77,6 +112,34 @@ def default_max_seqs() -> int:
 
 def default_shed_queue_depth() -> int:
     return int(os.environ.get(SHED_QUEUE_DEPTH_ENV, "64"))
+
+
+def default_nan_guard() -> bool:
+    return os.environ.get(NAN_GUARD_ENV, "0").lower() in ("1", "true",
+                                                          "yes", "on")
+
+
+def default_deadline_ms() -> Optional[float]:
+    raw = os.environ.get(DEADLINE_MS_ENV)
+    return None if raw is None else float(raw)
+
+
+def default_drain_secs() -> float:
+    return float(os.environ.get(DRAIN_SECS_ENV, "30"))
+
+
+class CollectTimeout(TimeoutError):
+    """``collect(timeout=)`` expired before the request finished; the
+    message names the request's current scheduler state."""
+
+
+class _NonfiniteLogits(RuntimeError):
+    """NaN-guard verdict: the named rows came back nonfinite — unlike a
+    raised step error this carries the culprits, no bisection needed."""
+
+    def __init__(self, request_ids: List[str]):
+        super().__init__(f"nonfinite logits for {request_ids}")
+        self.request_ids = list(request_ids)
 
 
 class ServingEngine:
@@ -92,6 +155,17 @@ class ServingEngine:
     per-request temperatures would multiply the compile set).
     ``capture_logits=True`` keeps every sampled position's logits row on
     the host per request — the numerics-equality hook for tests.
+
+    Resilience knobs (ISSUE 15): ``nan_guard`` enables the per-step
+    nonfinite-logits check (env ``PTPU_SERVE_NAN_GUARD``);
+    ``step_timeout`` arms a watchdog around every step (or pass a shared
+    ``watchdog``) — set it above the worst-case COLD compile of your
+    shape set (the watchdog cannot tell XLA compiling from a wedged
+    device), or warm the shapes first; ``run_dir`` is where quarantine
+    records and the drain spill file land; ``step_fault`` is the test
+    seam the ``testing/faults.poison_request`` injector plugs into — it
+    is called as ``fault(engine, kind, request_ids, logits)`` on every
+    executed step, bisection probes included.
     """
 
     def __init__(self, model, *, max_seqs: Optional[int] = None,
@@ -102,7 +176,12 @@ class ServingEngine:
                  capture_logits: bool = False,
                  shed_queue_depth: Optional[int] = None,
                  registry=None, seed: int = 0,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 nan_guard: Optional[bool] = None,
+                 step_timeout: Optional[float] = None,
+                 watchdog: Optional[Watchdog] = None,
+                 run_dir: Optional[str] = None,
+                 step_fault: Optional[Callable] = None):
         from ..distributed.topology import get_mesh
         enforce(get_mesh() is None,
                 "ServingEngine is single-host (the paged path is opaque "
@@ -147,6 +226,24 @@ class ServingEngine:
         self._prefill_tracked: Dict[int, Callable] = {}
         self._cb_queue: Optional[queue.Queue] = None
         self._cb_thread: Optional[threading.Thread] = None
+        # request-lifecycle guard (ISSUE 15)
+        self.nan_guard = (default_nan_guard() if nan_guard is None
+                          else bool(nan_guard))
+        self.run_dir = run_dir
+        self.step_fault = step_fault      # fault seam for the drills
+        self.step_timeout = step_timeout
+        self._owns_watchdog = watchdog is None and step_timeout is not None
+        self._watchdog = (Watchdog(timeout=step_timeout)
+                          if self._owns_watchdog else watchdog)
+        self._state = "serving"           # serving | draining | stopped
+        self._submit_order: List[str] = []
+        self.quarantined: Dict[str, Dict[str, Any]] = {}
+        self.watchdog_restarts = 0
+        self.lifecycle_counts = {"deadline": 0, "cancelled": 0,
+                                 "poisoned": 0, "spilled": 0}
+        self._cb_dispatched = 0
+        self._cb_errors = 0
+        self._last_callback_error: Optional[str] = None
 
     # -- plumbing ----------------------------------------------------------
     def _reg(self):
@@ -205,19 +302,38 @@ class ServingEngine:
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
                request_id: Optional[str] = None,
                eos_token_id: Optional[int] = None,
-               on_token: Optional[Callable] = None) -> str:
+               on_token: Optional[Callable] = None,
+               deadline_ms: Optional[float] = None,
+               ttft_deadline_ms: Optional[float] = None) -> str:
         """Queue one request; returns its id.  ``on_token(request_id,
         token, finished)`` — when given — is invoked from the callback
-        drain thread, decoupled from the step loop."""
+        drain thread, decoupled from the step loop.
+
+        ``deadline_ms`` bounds the whole request (default from
+        ``PTPU_SERVE_DEADLINE_MS``; None = no deadline);
+        ``ttft_deadline_ms`` bounds the wait for the FIRST token only —
+        both relative to now, enforced by the between-steps reaper with
+        terminal ``reason="deadline"``."""
+        enforce(self._state == "serving",
+                f"engine is {self._state} — not accepting new requests")
         rid = request_id or f"req-{next(self._ids)}"
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        now = float(self.clock())
+        if deadline_ms is None:
+            deadline_ms = default_deadline_ms()
         seq = SequenceState(request_id=rid, prompt=prompt,
                             max_new_tokens=int(max_new_tokens),
                             eos_token_id=eos_token_id,
-                            arrival=float(self.clock()),
+                            arrival=now,
                             on_token=on_token,
-                            capture_logits=self.capture_logits)
+                            capture_logits=self.capture_logits,
+                            deadline=(None if deadline_ms is None
+                                      else now + float(deadline_ms) / 1e3),
+                            ttft_deadline=(
+                                None if ttft_deadline_ms is None
+                                else now + float(ttft_deadline_ms) / 1e3))
         self.sched.submit(seq)
+        self._submit_order.append(rid)
         reg = self._reg()
         reg.counter("serve.requests").inc()
         reg.emit("serve.request", request_id=rid, prompt_len=len(prompt),
@@ -225,16 +341,86 @@ class ServingEngine:
         self._update_gauges()
         return rid
 
+    def cancel(self, request_id: str) -> bool:
+        """Flag a live request for eviction at the next step boundary
+        (terminal ``reason="cancelled"``, KV blocks returned).  False
+        when the request already finished or was never submitted."""
+        for seq in list(self.sched.running) + list(self.sched.waiting):
+            if seq.request_id == request_id:
+                seq.cancelled = True
+                return True
+        return False
+
     def should_shed(self) -> bool:
         """Load-shed signal: the admission queue is past the knob —
         ``/healthz`` turns 503 so the balancer drains elsewhere."""
         return self.sched.queue_depth > self.shed_queue_depth
 
     # -- the step ----------------------------------------------------------
+    def _evict(self, seq: SequenceState, reason: str) -> Dict[str, Any]:
+        """Terminal eviction with reason ``deadline`` / ``cancelled``:
+        free blocks, bump counters, emit the timeline record, and deliver
+        the terminal event down the callback path."""
+        self.sched.evict(seq, reason)
+        self.lifecycle_counts[reason] += 1
+        reg = self._reg()
+        if reason == "cancelled":
+            reg.counter("serve.cancelled").inc()
+            reg.emit("serve.cancel", request_id=seq.request_id,
+                     generated=len(seq.output))
+        else:
+            reg.counter("serve.deadline_misses").inc()
+            reg.emit("serve.deadline_miss", request_id=seq.request_id,
+                     generated=len(seq.output),
+                     miss=("ttft" if seq.first_token_time is None
+                           and seq.ttft_deadline is not None else "total"))
+        event = {"request_id": seq.request_id, "token": None,
+                 "finished": True, "reason": reason}
+        if seq.on_token is not None:
+            self._dispatch_callback(seq.on_token, event)
+        return event
+
+    def _reap(self) -> List[Dict[str, Any]]:
+        """Between-steps lifecycle sweep: evict cancelled and
+        deadline-expired sequences (running or waiting) before the
+        scheduler plans this step — their blocks fund the admissions."""
+        now = float(self.clock())
+        events = []
+        for seq in list(self.sched.running) + list(self.sched.waiting):
+            if seq.cancelled:
+                events.append(self._evict(seq, "cancelled"))
+            elif seq.deadline is not None and now >= seq.deadline:
+                events.append(self._evict(seq, "deadline"))
+            elif (seq.ttft_deadline is not None
+                    and seq.first_token_time is None
+                    and now >= seq.ttft_deadline):
+                events.append(self._evict(seq, "deadline"))
+        return events
+
+    def _step_guard(self):
+        if self._watchdog is not None:
+            return self._watchdog.armed("serve_step",
+                                        timeout=self.step_timeout)
+        return guarded("serve_step")
+
     def step(self) -> List[Dict[str, Any]]:
         """Run one scheduler-chosen unit of work (one prefill or one
-        decode batch).  Returns the token events it produced; empty when
-        idle AND no queued work remains."""
+        decode batch) inside the lifecycle guard: reap expired/cancelled
+        requests first, arm the watchdog around the device work, recover
+        from a hung step by rebuilding the jitted fns and re-admitting
+        the running set (recompute-prefill).  Returns the token events
+        produced; empty when idle AND no queued work remains."""
+        events = self._reap()
+        try:
+            with self._step_guard():
+                events += self._step_inner()
+        except StepTimeout:
+            events += self._recover_from_hang()
+        self.steps += 1
+        self._update_gauges()
+        return events
+
+    def _step_inner(self) -> List[Dict[str, Any]]:
         plan = self.sched.schedule()
         reg = self._reg()
         for victim in plan.preempted:
@@ -242,14 +428,27 @@ class ServingEngine:
             reg.emit("serve.preempt", request_id=victim.request_id,
                      generated=len(victim.output))
         if plan.kind == "prefill":
-            events = self._run_prefill(plan)
-        elif plan.kind == "decode":
-            events = self._run_decode(plan)
-        else:
-            events = []
-        self.steps += 1
-        self._update_gauges()
-        return events
+            return self._run_prefill(plan)
+        if plan.kind == "decode":
+            return self._run_decode(plan)
+        return []
+
+    def _recover_from_hang(self) -> List[Dict[str, Any]]:
+        """Hung-step recovery: the watchdog already dumped every thread's
+        stack.  Device work in flight is abandoned — host state is still
+        consistent (marks/pages only mutate after a step returns) — so
+        rebuild the jitted fns and preempt the running set back to the
+        queue; recompute-prefill replays them token-exact."""
+        self._jit_step = None
+        self._decode_tracked = None
+        self._prefill_tracked = {}
+        victims = self.sched.preempt_all()
+        self.watchdog_restarts += 1
+        reg = self._reg()
+        reg.counter("serve.watchdog_restarts").inc()
+        reg.emit("serve.watchdog_restart", step=self.steps,
+                 victims=[s.request_id for s in victims])
+        return []
 
     def has_work(self) -> bool:
         return self.sched.has_work()
@@ -261,15 +460,41 @@ class ServingEngine:
         while self.sched.has_work():
             self.step()
             taken += 1
-            enforce(max_steps is None or taken <= max_steps,
-                    f"engine did not drain in {max_steps} steps")
+            if max_steps is not None and taken > max_steps:
+                stuck = ([s.request_id for s in self.sched.running]
+                         + [s.request_id for s in self.sched.waiting])
+                raise RuntimeError(
+                    f"engine did not drain in {max_steps} steps; stuck "
+                    f"requests: {', '.join(stuck) or 'none'}")
         return taken
 
     # -- prefill / decode execution ---------------------------------------
-    def _run_prefill(self, plan: StepPlan) -> List[Dict[str, Any]]:
-        seq = plan.seqs[0]
+    # The _apply_* helpers run the jitted step and read the result back
+    # to host WITHOUT mutating any host state (no update_pages, no
+    # scheduler marks) — that purity is what makes the quarantine
+    # bisection probes and the post-eviction replay safe: a failed or
+    # probed step leaves nothing behind.
+
+    def _apply_fault(self, kind: str, seqs: List[SequenceState],
+                     logits_np: np.ndarray) -> np.ndarray:
+        """Fault seam + NaN guard, applied to every executed step
+        (bisection probes included — injected faults must re-fire on the
+        subset that still contains the target)."""
+        if self.step_fault is not None:
+            out = self.step_fault(self, kind,
+                                  [s.request_id for s in seqs], logits_np)
+            if out is not None:
+                logits_np = np.asarray(out)
+        if self.nan_guard:
+            bad = [s.request_id for i, s in enumerate(seqs)
+                   if not np.isfinite(logits_np[i]).all()]
+            if bad:
+                raise _NonfiniteLogits(bad)
+        return logits_np
+
+    def _apply_prefill(self, seq: SequenceState, bucket: int, key):
         ctx = seq.context()
-        L, bucket = len(ctx), plan.bucket
+        L = len(ctx)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :L] = ctx
         tables = self.cache.table_array([seq.request_id],
@@ -279,20 +504,13 @@ class ServingEngine:
         caches = self.cache.layer_caches(tables, lens, slots)
         nxt, logits, new_caches = self._prefill_fn(bucket)(
             self._params, jnp.asarray(ids), jnp.zeros((1,), jnp.int32),
-            jnp.asarray(L - 1, jnp.int32), caches, self._next_key())
-        self.cache.update_pages(new_caches)
-        self.sched.mark_prefilled(seq)
-        self._reg().counter("serve.prefills").inc()
-        if seq.pending is not None:
-            # recompute prefill after preemption: the next token was
-            # already sampled (and streamed) before eviction — only the
-            # KV was rebuilt; nothing new to emit
-            return []
-        return [self._accept_token(seq, int(np.asarray(nxt)[0]),
-                                   logits[0], first=True)]
+            jnp.asarray(L - 1, jnp.int32), caches, key)
+        nxt_np = np.asarray(nxt)
+        logits_np = self._apply_fault("prefill", [seq],
+                                      np.asarray(logits))
+        return nxt_np, logits_np, new_caches
 
-    def _run_decode(self, plan: StepPlan) -> List[Dict[str, Any]]:
-        seqs = plan.seqs
+    def _apply_decode(self, seqs: List[SequenceState], key):
         B = self.max_seqs
         enforce(len(seqs) <= B, f"{len(seqs)} decode rows > max_seqs {B}")
         sids = [s.request_id for s in seqs] + \
@@ -314,18 +532,131 @@ class ServingEngine:
         caches = self.cache.layer_caches(tables, lens, slots)
         nxt, logits, new_caches = self._decode_fn()(
             self._params, jnp.asarray(ids), jnp.asarray(positions),
-            jnp.asarray(0, jnp.int32), caches, self._next_key())
-        self.cache.update_pages(new_caches)
+            jnp.asarray(0, jnp.int32), caches, key)
         nxt_np = np.asarray(nxt)
+        logits_np = self._apply_fault("decode", seqs, np.asarray(logits))
+        return nxt_np, logits_np, new_caches
+
+    def _run_prefill(self, plan: StepPlan) -> List[Dict[str, Any]]:
+        seq = plan.seqs[0]
+        key = self._next_key()
+        try:
+            nxt_np, logits_np, new_caches = self._apply_prefill(
+                seq, plan.bucket, key)
+        except StepTimeout:
+            raise                      # the watchdog owns this one
+        except Exception as e:
+            self._quarantine_step("prefill", [seq], e, key)
+            return []
+        self.cache.update_pages(new_caches)
+        self.sched.mark_prefilled(seq)
+        self._reg().counter("serve.prefills").inc()
+        if seq.pending is not None:
+            # recompute prefill after preemption: the next token was
+            # already sampled (and streamed) before eviction — only the
+            # KV was rebuilt; nothing new to emit
+            return []
+        return [self._accept_token(seq, int(nxt_np[0]),
+                                   logits_np[0], first=True)]
+
+    def _run_decode(self, plan: StepPlan) -> List[Dict[str, Any]]:
+        seqs = plan.seqs
+        key = self._next_key()
+        try:
+            nxt_np, logits_np, new_caches = self._apply_decode(seqs, key)
+        except StepTimeout:
+            raise
+        except Exception as e:
+            survivors = self._quarantine_step("decode", seqs, e, key)
+            if not survivors:
+                return []
+            # replay: the culprit rows are gone, every surviving row is
+            # re-run with the same pending tokens — per-row paged
+            # attention makes the survivors' logits (and, greedy,
+            # their tokens) identical to the un-faulted step
+            return self._run_decode(StepPlan("decode", survivors))
+        self.cache.update_pages(new_caches)
         reg = self._reg()
         reg.counter("serve.decode_steps").inc()
         reg.histogram("serve.decode_batch").observe(float(len(seqs)))
         events = []
         for i, s in enumerate(seqs):
             self.sched.mark_decoded(s)
-            events.append(self._accept_token(s, int(nxt_np[i]), logits[i],
-                                             first=False))
+            events.append(self._accept_token(s, int(nxt_np[i]),
+                                             logits_np[i], first=False))
         return events
+
+    # -- poisoned-request quarantine ---------------------------------------
+    def _probe(self, seqs: List[SequenceState], key) -> bool:
+        """Re-run the decode step on a subset; True when it faults.
+        Pure — no host state mutates — so probing is free to repeat."""
+        try:
+            self._apply_decode(seqs, key)
+        except StepTimeout:
+            raise
+        except Exception:
+            return True
+        return False
+
+    def _bisect(self, seqs: List[SequenceState],
+                key) -> List[SequenceState]:
+        """Find the faulting sequence(s) by halving.  A passing half is
+        exonerated (faults here are deterministic per-row).  When the
+        whole group faults but neither half does, the fault is an
+        interaction — quarantine the whole group rather than loop."""
+        if len(seqs) == 1:
+            return seqs
+        mid = len(seqs) // 2
+        left, right = seqs[:mid], seqs[mid:]
+        culprits: List[SequenceState] = []
+        if self._probe(left, key):
+            culprits += self._bisect(left, key)
+        if self._probe(right, key):
+            culprits += self._bisect(right, key)
+        return culprits or seqs
+
+    def _quarantine_step(self, kind: str, seqs: List[SequenceState],
+                         error: Exception, key) -> List[SequenceState]:
+        """Fault-boundary handler: identify the culprit rows, evict each
+        with ``reason="poisoned"`` and a durable record, return the
+        surviving sequences for replay."""
+        if isinstance(error, _NonfiniteLogits):
+            bad = set(error.request_ids)
+            culprits = [s for s in seqs if s.request_id in bad]
+        elif kind == "prefill" or len(seqs) == 1:
+            culprits = list(seqs)
+        else:
+            culprits = self._bisect(seqs, key)
+        for seq in culprits:
+            self._quarantine(seq, error, kind)
+        return [s for s in seqs if s not in culprits]
+
+    def _quarantine(self, seq: SequenceState, error: Exception,
+                    kind: str) -> None:
+        self.sched.evict(seq, "poisoned")
+        self.lifecycle_counts["poisoned"] += 1
+        record = {"request_id": seq.request_id, "reason": "poisoned",
+                  "step_kind": kind, "error": repr(error),
+                  "engine_step": self.steps,
+                  "prompt_len": len(seq.prompt),
+                  "generated": len(seq.output),
+                  "output": list(seq.output),
+                  "time": float(self.clock())}
+        self.quarantined[seq.request_id] = record
+        reg = self._reg()
+        reg.counter("serve.poisoned").inc()
+        reg.emit("serve.quarantine", **record)
+        if self.run_dir is not None:
+            qdir = os.path.join(self.run_dir, "serve_quarantine")
+            os.makedirs(qdir, exist_ok=True)
+            fname = re.sub(r"[^\w.-]", "_", seq.request_id) + ".json"
+            fsio.atomic_write_bytes(
+                os.path.join(qdir, fname),
+                json.dumps(record, indent=1).encode())
+        event = {"request_id": seq.request_id, "token": None,
+                 "finished": True, "reason": "poisoned"}
+        if seq.on_token is not None:
+            self._dispatch_callback(seq.on_token, event)
 
     def _accept_token(self, seq: SequenceState, token: int, logits_row,
                       first: bool) -> Dict[str, Any]:
@@ -366,19 +697,45 @@ class ServingEngine:
                 target=self._cb_worker, name="ptpu-serve-callbacks",
                 daemon=True)
             self._cb_thread.start()
+        self._cb_dispatched += 1
         self._cb_queue.put((cb, event))
 
     def _cb_worker(self) -> None:
         while True:
-            cb, event = self._cb_queue.get()
+            item = self._cb_queue.get()
             try:
-                cb(event["request_id"], event["token"], event["finished"])
-            except Exception as e:  # a consumer bug must not kill serving
-                from ..framework.log import vlog
-                vlog(0, "serving: on_token callback failed for %s: %r",
-                     event["request_id"], e)
+                if item is _CB_STOP:
+                    return
+                cb, event = item
+                try:
+                    cb(event["request_id"], event["token"],
+                       event["finished"])
+                except Exception as e:  # consumer bug must not kill serving
+                    self._cb_errors += 1
+                    self._last_callback_error = \
+                        f"{event['request_id']}: {e!r}"
+                    reg = self._reg()
+                    reg.counter("serve.callback_errors").inc()
+                    reg.emit("serve.callback_error",
+                             request_id=event["request_id"], error=repr(e))
+                    from ..framework.log import vlog
+                    vlog(0, "serving: on_token callback failed for %s: %r",
+                         event["request_id"], e)
             finally:
                 self._cb_queue.task_done()
+
+    def _stop_callbacks(self, timeout: Optional[float] = None) -> bool:
+        """Stop the callback thread after it drains the queue; True when
+        it exited within the timeout (or was never started)."""
+        if self._cb_thread is None:
+            return True
+        self._cb_queue.put(_CB_STOP)
+        self._cb_thread.join(timeout=timeout)
+        alive = self._cb_thread.is_alive()
+        if not alive:
+            self._cb_thread = None
+            self._cb_queue = None
+        return not alive
 
     def drain_callbacks(self, timeout: Optional[float] = None) -> bool:
         """Block until every queued on_token callback ran (tests); True
@@ -393,13 +750,35 @@ class ServingEngine:
         return True
 
     # -- results ------------------------------------------------------------
+    def _request_state(self, request_id: str) -> str:
+        """Human-readable scheduler state for timeout/stuck messages."""
+        for seq in self.sched.running:
+            if seq.request_id == request_id:
+                return (f"state=running, generated={len(seq.output)}/"
+                        f"{seq.max_new_tokens}, "
+                        f"computed_len={seq.computed_len}")
+        for pos, seq in enumerate(self.sched.waiting):
+            if seq.request_id == request_id:
+                return (f"state={seq.state}, queue_position={pos}, "
+                        f"queue_depth={len(self.sched.waiting)}")
+        return "state=unknown (never submitted?)"
+
     def collect(self, request_id: str,
-                max_steps: Optional[int] = None) -> Dict[str, Any]:
+                max_steps: Optional[int] = None,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
         """Drive the engine until ``request_id`` finishes; return its
-        result record."""
+        result record.  ``timeout`` (seconds, wall clock) bounds the
+        wait — on expiry raises :class:`CollectTimeout` naming the
+        request's current scheduler state instead of spinning forever."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         while request_id not in self.sched.finished:
             enforce(self.sched.has_work(),
                     f"{request_id}: unknown request (never submitted?)")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise CollectTimeout(
+                    f"{request_id}: not finished after {timeout}s "
+                    f"({self._request_state(request_id)})")
             self.step()
             if max_steps is not None:
                 max_steps -= 1
@@ -430,6 +809,112 @@ class ServingEngine:
         self.run()
         return [self.collect(r)["tokens"] for r in rids]
 
+    # -- graceful drain / resume -------------------------------------------
+    @property
+    def state(self) -> str:
+        """``serving`` | ``draining`` | ``stopped`` — mirrored on
+        ``/healthz`` (503 once not ``serving``)."""
+        return self._state
+
+    def begin_drain(self) -> None:
+        """Stop admission without blocking: new ``submit()`` calls are
+        refused, ``/healthz`` goes 503 ``draining``, but already-admitted
+        work keeps stepping.  Idempotent; ``drain()`` calls it first."""
+        if self._state != "serving":
+            return
+        self._state = "draining"
+        self.sched.admission_open = False
+        c = self.sched.counts()
+        self._reg().emit("serve.drain_begin", running=c["running"],
+                         waiting=c["waiting"])
+
+    def drain(self, timeout: Optional[float] = None,
+              spill_path: Optional[str] = None) -> Dict[str, Any]:
+        """Graceful shutdown: stop admission, finish what fits inside
+        ``timeout`` (default ``PTPU_SERVE_DRAIN_SECS``), spill the rest
+        to ``spill_path`` (default ``<run_dir>/serve_spill.json``) as a
+        JSON file a fresh engine can :meth:`resume` from, stop the
+        callback thread, and mark the engine ``stopped``."""
+        if timeout is None:
+            timeout = default_drain_secs()
+        self.begin_drain()
+        hard = time.monotonic() + float(timeout)
+        timed_out = False
+        finished = 0
+        while (self.sched.running
+               or any(s.output for s in self.sched.waiting)):
+            if time.monotonic() >= hard:
+                timed_out = True
+                break
+            before = len(self.sched.finished)
+            self.step()
+            finished += len(self.sched.finished) - before
+        # spill whatever is still live — running sequences that ran out
+        # of time spill too (their generated tokens ride along, resume
+        # recomputes their KV and continues decoding)
+        leftovers = list(self.sched.running) + list(self.sched.waiting)
+        spilled = []
+        for seq in leftovers:
+            spilled.append({"request_id": seq.request_id,
+                            "prompt": list(seq.prompt),
+                            "output": list(seq.output),
+                            "max_new_tokens": seq.max_new_tokens,
+                            "eos_token_id": seq.eos_token_id,
+                            "preemptions": seq.preemptions})
+            self.sched.evict(seq, "spilled")
+            self.lifecycle_counts["spilled"] += 1
+            self._reg().counter("serve.spilled").inc()
+        if spilled:
+            if spill_path is None and self.run_dir is not None:
+                spill_path = os.path.join(self.run_dir,
+                                          "serve_spill.json")
+            enforce(spill_path is not None,
+                    "drain spilled requests but no spill_path was given "
+                    "and the engine has no run_dir")
+            fsio.atomic_write_bytes(
+                spill_path,
+                json.dumps({"version": 1, "spilled": spilled},
+                           indent=1).encode())
+        callbacks_stopped = self._stop_callbacks(timeout=5.0)
+        self._state = "stopped"
+        self._reg().emit("serve.drain_end", finished=finished,
+                         spilled=len(spilled), timed_out=timed_out)
+        self._update_gauges()
+        return {"finished": finished, "spilled": len(spilled),
+                "spill_path": spill_path if spilled else None,
+                "timed_out": timed_out,
+                "callbacks_stopped": callbacks_stopped}
+
+    def resume(self, spill_path: str) -> List[str]:
+        """Re-admit a drain spill file into THIS (fresh, serving)
+        engine.  Sequences resume exactly where they left off: generated
+        output is preserved and the newest token becomes ``pending``, so
+        the recompute-prefill path rebuilds the KV and decoding
+        continues token-exact.  Returns the resumed request ids."""
+        enforce(self._state == "serving",
+                f"resume() needs a serving engine (state={self._state})")
+        payload = json.loads(fsio.read_bytes(spill_path).decode())
+        enforce(payload.get("version") == 1,
+                f"unknown spill-file version {payload.get('version')!r}")
+        rids = []
+        for rec in payload["spilled"]:
+            seq = SequenceState(
+                request_id=rec["request_id"],
+                prompt=[int(t) for t in rec["prompt"]],
+                max_new_tokens=int(rec["max_new_tokens"]),
+                eos_token_id=rec.get("eos_token_id"),
+                arrival=float(self.clock()),
+                capture_logits=self.capture_logits)
+            seq.output = [int(t) for t in rec.get("output", [])]
+            seq.pending = seq.output[-1] if seq.output else None
+            seq.preemptions = int(rec.get("preemptions", 0))
+            self.sched.submit(seq)
+            self._submit_order.append(seq.request_id)
+            self._reg().counter("serve.resumed").inc()
+            rids.append(seq.request_id)
+        self._update_gauges()
+        return rids
+
     # -- observability ------------------------------------------------------
     def _update_gauges(self) -> None:
         reg = self._reg()
@@ -444,8 +929,10 @@ class ServingEngine:
 
     def stats(self) -> Dict[str, Any]:
         """Engine-state snapshot for ``/statusz`` (counts the registry
-        cannot derive: pool geometry, scheduler queues, shed state)."""
+        cannot derive: pool geometry, scheduler queues, shed state, the
+        resilience section)."""
         c = self.sched.counts()
+        leak = self.cache.leak_report()
         return {
             "steps": self.steps,
             "queue_depth": self.sched.queue_depth,
@@ -458,9 +945,24 @@ class ServingEngine:
             "kv_block_size": self.cache.block_size,
             "kv_blocks": {"total": self.cache.num_blocks,
                           "used": self.cache.allocator.num_used,
-                          "occupancy": self.cache.occupancy()},
+                          "occupancy": self.cache.occupancy(),
+                          "high_water": leak["high_water"],
+                          "leaked": leak["leaked_blocks"],
+                          "balanced": leak["balanced"]},
             "load_shed": {"active": self.should_shed(),
                           "queue_threshold": self.shed_queue_depth},
+            "resilience": {
+                "state": self._state,
+                "deadline_misses": self.lifecycle_counts["deadline"],
+                "cancelled": self.lifecycle_counts["cancelled"],
+                "poisoned": self.lifecycle_counts["poisoned"],
+                "spilled": self.lifecycle_counts["spilled"],
+                "watchdog_restarts": self.watchdog_restarts,
+                "quarantined": sorted(self.quarantined),
+                "callbacks": {"dispatched": self._cb_dispatched,
+                              "errors": self._cb_errors,
+                              "last_error": self._last_callback_error},
+            },
         }
 
     def defrag(self) -> bool:
@@ -477,6 +979,11 @@ class ServingEngine:
         return self.status_server
 
     def stop(self) -> None:
+        self._stop_callbacks(timeout=1.0)
+        if self._owns_watchdog and self._watchdog is not None:
+            self._watchdog.close()
+            self._watchdog = None
         if self.status_server is not None:
             self.status_server.stop()
             self.status_server = None
+        self._state = "stopped"

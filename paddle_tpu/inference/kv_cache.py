@@ -71,6 +71,13 @@ class BlockAllocator:
         self.block_size = int(block_size)
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._live: set = set()
+        # eviction accounting (ISSUE 15): every grant and return counted
+        # for the whole pool lifetime — `total_allocs - total_frees ==
+        # num_used` is the invariant the leak-freedom drills pin after
+        # any interleaving of finish/cancel/deadline/preempt/quarantine
+        self.total_allocs = 0
+        self.total_frees = 0
+        self.high_water = 0
 
     # -- accounting --------------------------------------------------------
     @property
@@ -99,6 +106,8 @@ class BlockAllocator:
             return None
         got = [self._free.pop() for _ in range(n)]
         self._live.update(got)
+        self.total_allocs += len(got)
+        self.high_water = max(self.high_water, self.num_used)
         return got
 
     def free(self, blocks: Sequence[int]) -> None:
@@ -106,8 +115,21 @@ class BlockAllocator:
             enforce(b in self._live, f"double/foreign free of block {b}")
             self._live.discard(b)
             self._free.append(b)
+        self.total_frees += len(blocks)
         # keep lowest-id-first hand-out after churn
         self._free.sort(reverse=True)
+
+    def stats(self) -> Dict[str, object]:
+        """Lifetime accounting snapshot; ``balanced`` is the
+        leak-freedom invariant (allocs minus frees equals live)."""
+        return {"num_blocks": self.num_blocks,
+                "num_used": self.num_used,
+                "num_free": self.num_free,
+                "total_allocs": self.total_allocs,
+                "total_frees": self.total_frees,
+                "high_water": self.high_water,
+                "balanced": (self.total_allocs - self.total_frees
+                             == self.num_used)}
 
     # -- defrag ------------------------------------------------------------
     def defrag(self, tables: Dict[object, List[int]]
@@ -258,6 +280,19 @@ class PagedKVCache:
 
     def occupancy(self) -> float:
         return self.allocator.occupancy()
+
+    def leak_report(self) -> Dict[str, object]:
+        """Eviction-accounting view (ISSUE 15): allocator lifetime
+        counters plus the table-coverage cross-check.  A nonzero
+        ``leaked_blocks`` means some blocks are marked used but no
+        sequence's table covers them — exactly the state a missed
+        eviction path (cancel/deadline/quarantine) would leave."""
+        report = self.allocator.stats()
+        tabled = sum(len(t) for t in self._tables.values())
+        report["live_seqs"] = len(self._tables)
+        report["tabled_blocks"] = tabled
+        report["leaked_blocks"] = int(report["num_used"]) - tabled
+        return report
 
     # -- fixed-shape step inputs ------------------------------------------
     def table_array(self, seq_ids: Sequence[object],

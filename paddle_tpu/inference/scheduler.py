@@ -16,8 +16,18 @@ testable with a fake clock and a tiny pool (``tests/test_serving.py``).
 Sequence lifecycle::
 
     WAITING --admit(prefill)--> RUNNING --eos/max_tokens--> FINISHED
-       ^                          |
-       +------- PREEMPTED <-- OOM on next-token block
+       ^                          |                            ^
+       +------- PREEMPTED <-- OOM on next-token block          |
+       |                                                       |
+       +--- cancel / deadline / poisoned / spilled (evict) ----+
+
+Terminal reasons beyond ``eos`` / ``max_new_tokens`` (ISSUE 15):
+``cancelled`` and ``deadline`` land through the engine's between-steps
+reaper, ``poisoned`` through the fault-boundary quarantine, ``spilled``
+through graceful drain.  All of them go through :meth:`evict`, which
+frees the sequence's blocks from *any* live state — waiting sequences
+hold no blocks, but removing them from the queue here keeps the
+lifecycle single-exit.
 
 - **Admission** is by KV-block budget: a sequence is admitted only when
   the allocator can hold its whole prefill context *now* (all-or-nothing
@@ -87,6 +97,12 @@ class SequenceState:
     on_token: Optional[Callable] = None
     capture_logits: bool = False
 
+    # request-lifecycle guard (ISSUE 15): absolute clock() times — the
+    # engine computes them from submit()'s relative deadline_ms knobs
+    deadline: Optional[float] = None
+    ttft_deadline: Optional[float] = None
+    cancelled: bool = False
+
     state: str = WAITING
     output: List[int] = dataclasses.field(default_factory=list)
     pending: Optional[int] = None       # sampled, KV not yet cached
@@ -148,6 +164,10 @@ class ContinuousBatchingScheduler:
         self.running: List[SequenceState] = []
         self.finished: Dict[str, SequenceState] = {}
         self.preemptions = 0
+        # drain gate (ISSUE 15): closed admission still lets preempted
+        # sequences (anything that already produced output) re-admit —
+        # drain must finish started work, only fresh arrivals wait out
+        self.admission_open = True
 
     # -- intake ------------------------------------------------------------
     def submit(self, seq: SequenceState) -> None:
@@ -181,7 +201,8 @@ class ContinuousBatchingScheduler:
         at most one interleaved step per admission."""
         plan_preempted: List[SequenceState] = []
 
-        if self.waiting and len(self.running) < self.max_seqs:
+        if (self.waiting and len(self.running) < self.max_seqs
+                and (self.admission_open or self.waiting[0].output)):
             seq = self.waiting[0]
             ctx = len(seq.context())
             need = self.cache.allocator.blocks_for_tokens(ctx)
@@ -225,6 +246,17 @@ class ContinuousBatchingScheduler:
         # head of the queue: preempted work re-admits before new arrivals
         self.waiting.appendleft(seq)
 
+    def preempt_all(self) -> List[SequenceState]:
+        """Evict every running sequence back to the queue (recompute) —
+        the engine's hang-recovery path.  Device-side work in flight is
+        abandoned; host state stays consistent because engine feedback
+        (``mark_*``) only lands after a step returns.  Newest-first so
+        re-admission replays in the original admission order."""
+        victims = list(reversed(self.running))
+        for seq in victims:
+            self._preempt(seq)
+        return victims
+
     # -- engine feedback ---------------------------------------------------
     def mark_prefilled(self, seq: SequenceState) -> None:
         seq.computed_len = len(seq.context())
@@ -235,8 +267,20 @@ class ContinuousBatchingScheduler:
     def complete(self, seq: SequenceState, reason: str) -> None:
         """Evict a finished sequence: free its blocks immediately so the
         next schedule() can admit into the reclaimed space."""
+        self.evict(seq, reason)
+
+    def evict(self, seq: SequenceState, reason: str) -> None:
+        """Terminal eviction from ANY live state — finish, cancel,
+        deadline, quarantine and drain-spill all exit through here:
+        remove the sequence from whichever queue holds it, free its
+        blocks, record the reason, file it under ``finished``."""
         if seq in self.running:
             self.running.remove(seq)
+        else:
+            try:
+                self.waiting.remove(seq)
+            except ValueError:
+                pass
         self.cache.free_seq(seq.request_id)
         seq.state = FINISHED
         seq.finish_reason = reason

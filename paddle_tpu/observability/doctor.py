@@ -39,6 +39,14 @@ evidence lines):
 - ``unstable``       — the supervisor logged rollbacks / watchdog
                        timeouts / step failures (corroborating context,
                        ranked below the causes above).
+- ``serve_poisoned`` — the serving engine quarantined request(s)
+                       (``serve.quarantine`` records name the step kind
+                       and error; durable records land under
+                       ``<run_dir>/serve_quarantine/``).
+- ``serve_deadline_misses`` — requests were evicted past their
+                       deadline: the engine is underprovisioned for its
+                       SLO (raise ``max_seqs`` / the KV pool, or shed
+                       earlier).
 
 Verdicts are mirrored into ``supervisor_report.json`` (kind
 ``doctor.verdict``) so the run's one post-mortem file carries the
@@ -60,7 +68,7 @@ from .sinks import metrics_dir
 __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_memory", "check_straggler", "check_data_starved",
            "check_comm_bound", "check_supervisor",
-           "check_perf_regression", "check_perf_trend"]
+           "check_perf_regression", "check_perf_trend", "check_serving"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -591,6 +599,51 @@ def check_integrity(events) -> List[Dict[str, Any]]:
     return findings
 
 
+def check_serving(workers) -> List[Dict[str, Any]]:
+    """Serving-resilience verdicts (ISSUE 15): ``serve_poisoned`` when
+    the engine quarantined requests (each left a ``serve.quarantine``
+    timeline record naming the step kind and error), and
+    ``serve_deadline_misses`` when requests were evicted past their
+    deadline — sustained misses mean the engine is underprovisioned for
+    its SLO, not that requests are broken."""
+    findings: List[Dict[str, Any]] = []
+    quarantines: List[Dict[str, Any]] = []
+    misses: List[Dict[str, Any]] = []
+    for recs in workers.values():
+        for r in recs:
+            k = r.get("kind")
+            if k == "serve.quarantine":
+                quarantines.append(r)
+            elif k == "serve.deadline_miss":
+                misses.append(r)
+    if quarantines:
+        errors: Dict[str, int] = {}
+        for q in quarantines:
+            e = str(q.get("error"))
+            errors[e] = errors.get(e, 0) + 1
+        ev = [f"{q.get('request_id')}: {q.get('step_kind')} step — "
+              f"{q.get('error')}" for q in quarantines[:4]]
+        ev.append("durable records under <run_dir>/serve_quarantine/; "
+                  "every co-batched request completed token-exact")
+        findings.append(_finding(
+            "serve_poisoned", 55 + 5 * min(6, len(quarantines)),
+            f"{len(quarantines)} request(s) quarantined as poisoned",
+            ev, count=len(quarantines), errors=errors))
+    if misses:
+        ttft = sum(1 for m in misses if m.get("miss") == "ttft")
+        ev = [f"{len(misses)}× deadline eviction "
+              f"({ttft} before first token)"]
+        ev.append("requests: " + ", ".join(
+            str(m.get("request_id")) for m in misses[:6]))
+        ev.append("sustained misses = engine underprovisioned for the "
+                  "SLO: raise max_seqs / the KV pool, or shed earlier")
+        findings.append(_finding(
+            "serve_deadline_misses", 30 + 5 * min(8, len(misses)),
+            f"{len(misses)} request(s) evicted past their deadline",
+            ev, count=len(misses), ttft_misses=ttft))
+    return findings
+
+
 def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     """Run every check against ``run_dir``; returns the diagnosis dict
     (findings ranked most-severe first) or ``None`` when the run left no
@@ -618,6 +671,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_perf_regression(workers)
     findings += check_perf_trend(workers)
     findings += check_integrity(events)
+    findings += check_serving(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

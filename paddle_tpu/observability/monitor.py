@@ -112,9 +112,15 @@ class StatusServer:
         should route to or wait on quietly.  With a serving engine
         attached, an admission queue past ``PTPU_SHED_QUEUE_DEPTH``
         also answers 503 — the load-shedding signal a balancer drains
-        on (requests already queued still complete)."""
+        on (requests already queued still complete) — and an engine in
+        ``draining`` / ``stopped`` state answers 503 for the whole
+        drain window (ISSUE 15) so the balancer routes elsewhere while
+        in-flight work finishes."""
         if self.engine is not None:
             try:
+                estate = getattr(self.engine, "state", "serving")
+                if estate != "serving":
+                    return 503, estate
                 if self.engine.should_shed():
                     depth = self.engine.sched.queue_depth
                     return 503, f"load-shed:queue_depth={depth}"
@@ -165,6 +171,10 @@ class StatusServer:
         # serving SLOs (ISSUE 6): present whenever a serving engine is
         # attached or serve.* instruments exist in the registry
         serving: Dict[str, Any] = {}
+        def counter(name):
+            m = snap.get(name)
+            return m["value"] if m and m.get("type") == "counter" else 0
+
         if any(k.startswith("serve.") for k in snap):
             serving = {
                 "queue_depth": gauge("serve.queue_depth"),
@@ -174,6 +184,18 @@ class StatusServer:
                 "kv_blocks_used": gauge("serve.kv_blocks_used"),
                 "ttft_ms": hist("serve.ttft_ms"),
                 "tpot_ms": hist("serve.tpot_ms"),
+                # lifecycle-guard counters (ISSUE 15) — registry-derived
+                # so they render even without an attached engine; the
+                # engine's richer "resilience" dict wins when present
+                "resilience": {
+                    "deadline_misses": counter("serve.deadline_misses"),
+                    "cancelled": counter("serve.cancelled"),
+                    "poisoned": counter("serve.poisoned"),
+                    "spilled": counter("serve.spilled"),
+                    "watchdog_restarts":
+                        counter("serve.watchdog_restarts"),
+                    "callback_errors": counter("serve.callback_errors"),
+                },
             }
         if self.engine is not None:
             try:
@@ -542,6 +564,7 @@ class LiveAggregator:
         findings += doctor.check_comm_bound(workers)
         findings += doctor.check_perf_regression(workers)
         findings += doctor.check_perf_trend(workers)
+        findings += doctor.check_serving(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

@@ -26,6 +26,12 @@ catch.  They bypass the seam on purpose (corruption is not a write).
 
 :func:`fast_retries` swaps every module-level retry policy for a
 sleepless one so fault tests measure behavior, not backoff time.
+
+Serving-side injectors (ISSUE 15): :class:`poison_request` plugs into
+``ServingEngine(step_fault=...)`` to poison one request's step
+(raise / NaN logits / hang) so the quarantine, NaN-guard and watchdog
+paths are drillable without real hardware faults; :class:`expire_clock`
+is a hand-advanced clock for deadline-eviction tests.
 """
 from __future__ import annotations
 
@@ -41,7 +47,7 @@ from ..utils.retry import RetryPolicy
 __all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
            "corrupt_manifest", "fast_retries", "hang", "slow_call",
            "diverge_after", "sigkill_self", "sigkill_at", "bitflip",
-           "flip_tree_bit"]
+           "flip_tree_bit", "poison_request", "expire_clock"]
 
 
 def _default_transient() -> OSError:
@@ -349,6 +355,87 @@ class bitflip:
         return bitflip(os.environ["PTPU_TEST_BITFLIP_LEAF"],
                        bit=int(os.environ.get("PTPU_TEST_BITFLIP_BIT", "0")),
                        step=int(step), worker=target)
+
+
+# -- serving-resilience injectors (ISSUE 15: quarantine/deadline drills) ---
+class poison_request:
+    """Step-fault injector for the ServingEngine quarantine drill: plug
+    into ``ServingEngine(step_fault=...)``; the engine calls it as
+    ``fault(engine, kind, request_ids, logits)`` on every executed step
+    — bisection probes included.
+
+    ``target`` is a request id (str) or a submit-order index (int,
+    resolved lazily against ``engine._submit_order``).  Modes:
+
+    - ``"raise"`` — raise a RuntimeError whenever the target is in the
+      batch (the allocator-error / kernel-crash shape; re-fires on every
+      probe subset containing the target, which is what lets the
+      engine's bisection converge on it);
+    - ``"nan"`` — overwrite the target's logits row with NaN (the
+      silent-corruption shape ``PTPU_SERVE_NAN_GUARD`` must catch);
+    - ``"hang"`` — stall interruptibly for ``seconds`` (watchdog drill);
+      fires at most ``count`` times (default 1) since the target stays
+      in the batch after hang recovery.
+
+    ``fired`` counts activations.  The injector goes quiet on its own
+    once the target is quarantined — it is simply no longer in the
+    batch."""
+
+    def __init__(self, target, mode: str = "raise",
+                 seconds: float = 1.0, count: Optional[int] = None,
+                 kinds: Tuple[str, ...] = ("prefill", "decode")):
+        if mode not in ("raise", "nan", "hang"):
+            raise ValueError(f"unknown poison mode {mode!r}")
+        self.target = target
+        self.mode = mode
+        self.seconds = float(seconds)
+        self.count = (1 if mode == "hang" else None) \
+            if count is None else int(count)
+        self.kinds = tuple(kinds)   # restrict to decode to drill bisection
+        self.fired = 0
+
+    def _target_id(self, engine) -> Optional[str]:
+        if isinstance(self.target, str):
+            return self.target
+        order = engine._submit_order
+        idx = int(self.target)
+        return order[idx] if 0 <= idx < len(order) else None
+
+    def __call__(self, engine, kind: str, request_ids, logits):
+        if kind not in self.kinds:
+            return None
+        rid = self._target_id(engine)
+        if rid is None or rid not in request_ids:
+            return None
+        if self.count is not None and self.fired >= self.count:
+            return None
+        self.fired += 1
+        if self.mode == "raise":
+            raise RuntimeError(f"injected poisoned step ({rid})")
+        if self.mode == "hang":
+            hang(self.seconds)
+            return None
+        import numpy as np
+        out = np.array(logits, copy=True)
+        out[request_ids.index(rid)] = np.nan
+        return out
+
+
+class expire_clock:
+    """Controllable clock for deadline drills: pass as
+    ``ServingEngine(clock=...)``, then ``advance(secs)`` to expire
+    deadlines without real waiting.  Starts at ``start`` (default 1000.0
+    — any fixed epoch; deadline math is all relative)."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = float(start)
+
+    def advance(self, seconds: float) -> float:
+        self.now += float(seconds)
+        return self.now
+
+    def __call__(self) -> float:
+        return self.now
 
 
 @contextlib.contextmanager

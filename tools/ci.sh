@@ -95,9 +95,11 @@ kinds = {f["kind"] for f in diag["findings"]}
 assert {"retrace_storm", "straggler"} <= kinds, f"doctor smoke: {kinds}"
 PYEOF
     rm -rf "$DOCTOR_TMP"
-    # serving tier (ISSUE 6): paged-KV cache invariants, scheduler policy,
-    # ragged-vs-dense numerics, compile contract, facade routing
-    python -m pytest -q -m serving tests/test_serving.py
+    # serving tier (ISSUE 6 + 15): paged-KV cache invariants, scheduler
+    # policy, ragged-vs-dense numerics, compile contract, facade routing,
+    # and the resilience layer (deadlines/cancel, quarantine, drain)
+    python -m pytest -q -m serving tests/test_serving.py \
+        tests/test_serving_resilience.py
     # serve smoke: engine + status server on an ephemeral port, 8
     # concurrent synthetic streams; /statusz must report nonzero TTFT
     # percentiles and KV occupancy mid-flight
@@ -131,6 +133,45 @@ assert hz["ok"] is True, hz
 engine.run(max_steps=500)
 engine.stop()
 print("serve smoke: 8 streams, /statusz TTFT p50/p99 + KV occupancy ok")
+PYEOF
+    # serving chaos drill (ISSUE 15): poison one of 8 ragged streams →
+    # exactly that request quarantined with a durable record, peers
+    # token-exact, allocator back to baseline; then drain under load →
+    # spill → fresh-engine resume to completion
+    JAX_PLATFORMS=cpu python examples/gpt_generate.py --chaos_serve
+    # drain-state smoke: /healthz must flip to 503 draining the moment
+    # admission closes, then report a clean stop
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, urllib.request
+import paddle_tpu as pt
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+pt.seed(0)
+cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+                ffn_hidden_size=64, max_position_embeddings=32,
+                hidden_dropout=0.0, attention_dropout=0.0)
+engine = ServingEngine(GPTForCausalLM(cfg), max_seqs=4, kv_block_size=4)
+srv = engine.start_status_server(port=0, host="127.0.0.1")
+for i in range(4):
+    engine.submit([1 + i] * 3, max_new_tokens=4)
+base = f"http://127.0.0.1:{srv.port}"
+for _ in range(4):
+    engine.step()
+engine.begin_drain()
+try:
+    urllib.request.urlopen(base + "/healthz", timeout=5)
+    raise AssertionError("healthz should be 503 while draining")
+except urllib.error.HTTPError as e:
+    assert e.code == 503, e.code
+    hz = json.loads(e.read())
+    assert hz["state"] == "draining", hz
+report = engine.drain(timeout=60.0)
+assert not report["timed_out"] and report["spilled"] == 0, report
+sz = json.loads(urllib.request.urlopen(base + "/statusz", timeout=5).read())
+assert sz["serving"]["resilience"]["state"] == "stopped", sz["serving"]
+engine.stop()
+print("drain smoke: healthz 503 draining -> clean stop, 4 streams finished")
 PYEOF
     # kernels tier (ISSUE 7): Pallas/fused-op parity — flash attention,
     # fused block (both routes), fused CE, rope cache
@@ -393,10 +434,10 @@ PYEOF
     # `slow` (two fresh jax processes), so tier-1 skips it — run it here
     python -m pytest -q -m slow tests/test_compile_cache.py
     echo "api-guard + ptlint + faults tier + telemetry tier + doctor" \
-         "smoke + monitor smoke + serving tier + serve smoke + kernels" \
-         "tier + fused-block smoke + comm tier + comm smoke + elastic" \
-         "tier + elastic smoke + integrity tier + integrity smoke +" \
-         "integrity overhead + bench smoke + perf tier + trends +" \
-         "dashboard + warm-start ok"
+         "smoke + monitor smoke + serving tier + serve smoke + serve" \
+         "chaos drill + drain smoke + kernels tier + fused-block smoke" \
+         "+ comm tier + comm smoke + elastic tier + elastic smoke +" \
+         "integrity tier + integrity smoke + integrity overhead +" \
+         "bench smoke + perf tier + trends + dashboard + warm-start ok"
 fi
 echo "shard ${SHARD} green"
