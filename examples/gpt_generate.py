@@ -232,7 +232,7 @@ def chaos_serve(n_streams: int = 8, max_new_tokens: int = 8):
                                               run_dir=run_dir)
         assert poisoned[3]["finish_reason"] == "poisoned", poisoned[3]
         assert list(eng.quarantined) == [eng._submit_order[3]]
-        qdir = os.path.join(run_dir, "serve_quarantine")
+        qdir = os.path.join(run_dir, "serve", "replica-0", "quarantine")
         assert len(os.listdir(qdir)) == 1, os.listdir(qdir)
         exact = sum(poisoned[i]["tokens"] == clean[i]["tokens"]
                     for i in range(n_streams) if i != 3)

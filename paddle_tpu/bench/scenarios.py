@@ -17,6 +17,7 @@ long_context         Ulysses sequence-parallel GPT over the ``sp`` axis
 resnet               ResNet train step (18 smoke / 50 ImageNet-config)
 mnist                LeNet MNIST-shape train step
 serve                continuous-batching decode through the PR 6 engine
+serve_fleet          routed decode over 2 replicas incl. one failover
 ==================== =====================================================
 """
 from __future__ import annotations
@@ -422,4 +423,105 @@ def serve(mode: str) -> Dict[str, Any]:
                   "tpot_ms_p50": hpct("serve.tpot_ms", "p50"),
                   "tpot_ms_p99": hpct("serve.tpot_ms", "p99"),
                   "preemptions": engine.sched.preemptions},
+    }
+
+
+@register("serve_fleet")
+def serve_fleet(mode: str) -> Dict[str, Any]:
+    """Routed decode through the ISSUE 16 fleet: two in-process
+    replicas behind the Router, one mid-run failover.  A bench "step"
+    is one router pump (poll + step every live replica); the timed
+    window includes journal replay of the failed-over streams, so the
+    figure prices what resilience costs, not just the happy path."""
+    import time as _time
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.inference.fleet import LocalReplica, Router
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability.mfu import (flops_per_token, mfu,
+                                              param_count)
+    from paddle_tpu.observability.registry import MetricsRegistry
+
+    n_streams = 8 if mode == "full" else 4
+    max_new = 48 if mode == "full" else 12
+    cfg = GPTConfig(vocab_size=512,
+                    hidden_size=128 if mode == "full" else 64,
+                    num_layers=2, num_heads=4,
+                    ffn_hidden_size=256 if mode == "full" else 128,
+                    max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+
+    def build_engine(reg, i):
+        pt.seed(0)                    # identical weights per replica
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        return model, ServingEngine(model, max_seqs=n_streams,
+                                    kv_block_size=4, registry=reg,
+                                    replica_id=i)
+
+    reg = MetricsRegistry()
+    models, replicas = [], []
+    for i in range(2):
+        model, eng = build_engine(reg, i)
+        models.append(model)
+        replicas.append(LocalReplica(eng, replica_id=i))
+    router = Router(replicas, registry=reg)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           rng.randint(3, 8)).tolist()
+               for _ in range(n_streams)]
+    # warm both replicas' compile caches outside the timed window
+    for r in replicas:
+        r.engine.generate([prompts[0][:3]], max_new_tokens=2)
+    rids = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+
+    kill_after = 3                    # pumps before the failover drill
+    step_ms: List[float] = []
+    t0 = _time.perf_counter()
+    while len(step_ms) < 4096:
+        ta = _time.perf_counter()
+        live = router.pump()
+        step_ms.append((_time.perf_counter() - ta) * 1e3)
+        if len(step_ms) == kill_after:
+            victim = next((j.replica_id
+                           for j in router.journals.values()
+                           if not j.finished
+                           and j.replica_id is not None), None)
+            if victim is not None:
+                replicas[victim].engine._state = "stopped"
+        if live == 0:
+            break
+    elapsed = _time.perf_counter() - t0
+    results = [router.collect(r, timeout=60) for r in rids]
+    generated = sum(len(r["tokens"]) for r in results)
+    tok_s = generated / max(1e-9, elapsed)
+
+    n_params = param_count(models[0].trainable_variables())
+    flops_tok = flops_per_token(n_params, num_layers=cfg.num_layers,
+                                hidden_size=cfg.hidden_size,
+                                seq_len=cfg.max_position_embeddings,
+                                fwd_only=True)
+
+    def p50(series):
+        return harness.pct(sorted(series), 50) or 0.0
+
+    return {
+        "config": {"n_streams": n_streams, "max_new_tokens": max_new,
+                   "replicas": 2, "steps": len(step_ms),
+                   "params_m": n_params / 1e6},
+        "step_times_ms": step_ms,
+        # a pump is poll+step+journal in one host call — all compute
+        # phase (no separate data/readback to time at this layer)
+        "phases_ms": {"data": 0.0, "compute": p50(step_ms),
+                      "readback": 0.0, "collective": 0.0},
+        "tokens_per_sec": tok_s,
+        "mfu": mfu(tok_s, flops_tok),
+        "peak_hbm_bytes": harness.peak_hbm(),
+        "extra": {"generated_tokens": generated,
+                  "router_pumps": len(step_ms),
+                  "failovers": router.failovers,
+                  "dispatches": len(rids) + router.failovers},
     }

@@ -286,3 +286,8 @@ from .scheduler import ContinuousBatchingScheduler  # noqa: E402
 __all__ += ["ServingEngine", "CollectTimeout", "PagedKVCache",
             "BlockAllocator", "ContinuousBatchingScheduler",
             "paged_attention", "EnginePredictor"]
+
+# -- the serving fleet (ISSUE 16) -------------------------------------------
+from . import fleet  # noqa: E402
+
+__all__ += ["fleet"]

@@ -60,6 +60,14 @@ same robustness treatment the training path earned:
   finishes what it can, spills the rest to a JSON file a fresh engine
   ``resume()``s from, then stops the callback thread.
 
+Durable artifacts are namespaced per replica (ISSUE 16): quarantine
+records land under ``<run_dir>/serve/replica-<i>/quarantine/`` and the
+drain spill at ``<run_dir>/serve/replica-<i>/spill.json`` (``<i>`` is
+``replica_id``, 0 when unset), so N engines sharing one run_dir — the
+fleet layout — never collide.  ``resume()`` without an explicit path
+reads the namespaced location and falls back to the legacy
+``<run_dir>/serve_spill.json``.
+
 Env knobs: ``PTPU_MAX_SEQS``, ``PTPU_KV_BLOCK_SIZE``,
 ``PTPU_SHED_QUEUE_DEPTH``, ``PTPU_SERVE_NAN_GUARD``,
 ``PTPU_SERVE_DEADLINE_MS``, ``PTPU_SERVE_DRAIN_SECS``.  Single-host by
@@ -181,6 +189,7 @@ class ServingEngine:
                  step_timeout: Optional[float] = None,
                  watchdog: Optional[Watchdog] = None,
                  run_dir: Optional[str] = None,
+                 replica_id: Optional[int] = None,
                  step_fault: Optional[Callable] = None):
         from ..distributed.topology import get_mesh
         enforce(get_mesh() is None,
@@ -230,6 +239,7 @@ class ServingEngine:
         self.nan_guard = (default_nan_guard() if nan_guard is None
                           else bool(nan_guard))
         self.run_dir = run_dir
+        self.replica_id = None if replica_id is None else int(replica_id)
         self.step_fault = step_fault      # fault seam for the drills
         self.step_timeout = step_timeout
         self._owns_watchdog = watchdog is None and step_timeout is not None
@@ -246,6 +256,16 @@ class ServingEngine:
         self._last_callback_error: Optional[str] = None
 
     # -- plumbing ----------------------------------------------------------
+    def serve_dir(self) -> Optional[str]:
+        """Per-replica durable-artifact namespace (ISSUE 16):
+        ``<run_dir>/serve/replica-<i>`` — quarantine records and the
+        drain spill live here so N engines sharing one ``run_dir``
+        never collide.  None without a ``run_dir``."""
+        if self.run_dir is None:
+            return None
+        return os.path.join(self.run_dir, "serve",
+                            f"replica-{self.replica_id or 0}")
+
     def _reg(self):
         if self._registry is not None:
             return self._registry
@@ -647,7 +667,7 @@ class ServingEngine:
         reg.counter("serve.poisoned").inc()
         reg.emit("serve.quarantine", **record)
         if self.run_dir is not None:
-            qdir = os.path.join(self.run_dir, "serve_quarantine")
+            qdir = os.path.join(self.serve_dir(), "quarantine")
             os.makedirs(qdir, exist_ok=True)
             fname = re.sub(r"[^\w.-]", "_", seq.request_id) + ".json"
             fsio.atomic_write_bytes(
@@ -832,9 +852,12 @@ class ServingEngine:
               spill_path: Optional[str] = None) -> Dict[str, Any]:
         """Graceful shutdown: stop admission, finish what fits inside
         ``timeout`` (default ``PTPU_SERVE_DRAIN_SECS``), spill the rest
-        to ``spill_path`` (default ``<run_dir>/serve_spill.json``) as a
-        JSON file a fresh engine can :meth:`resume` from, stop the
-        callback thread, and mark the engine ``stopped``."""
+        to ``spill_path`` (default
+        ``<run_dir>/serve/replica-<i>/spill.json``) as a JSON file a
+        fresh engine can :meth:`resume` from, stop the callback thread,
+        and mark the engine ``stopped``.  The report carries the spill
+        records inline (``"spilled_records"``) so a fleet router can
+        migrate them without re-reading the file."""
         if timeout is None:
             timeout = default_drain_secs()
         self.begin_drain()
@@ -866,8 +889,8 @@ class ServingEngine:
             self._reg().counter("serve.spilled").inc()
         if spilled:
             if spill_path is None and self.run_dir is not None:
-                spill_path = os.path.join(self.run_dir,
-                                          "serve_spill.json")
+                os.makedirs(self.serve_dir(), exist_ok=True)
+                spill_path = os.path.join(self.serve_dir(), "spill.json")
             enforce(spill_path is not None,
                     "drain spilled requests but no spill_path was given "
                     "and the engine has no run_dir")
@@ -882,38 +905,63 @@ class ServingEngine:
         self._update_gauges()
         return {"finished": finished, "spilled": len(spilled),
                 "spill_path": spill_path if spilled else None,
+                "spilled_records": spilled,
                 "timed_out": timed_out,
                 "callbacks_stopped": callbacks_stopped}
 
-    def resume(self, spill_path: str) -> List[str]:
+    def admit_record(self, record: Dict[str, Any]) -> str:
+        """Admit one spill-format record (``request_id`` / ``prompt`` /
+        ``output`` / ``max_new_tokens`` / ``eos_token_id``) into this
+        serving engine.  The generated ``output`` tail is preserved and
+        its newest token becomes ``pending``, so the recompute-prefill
+        path rebuilds the KV and decoding continues **token-exact** —
+        the seam both :meth:`resume` and the fleet router's failover
+        re-submission go through.  Returns the request id."""
+        enforce(self._state == "serving",
+                f"admit_record() needs a serving engine "
+                f"(state={self._state})")
+        seq = SequenceState(
+            request_id=record["request_id"],
+            prompt=[int(t) for t in record["prompt"]],
+            max_new_tokens=int(record["max_new_tokens"]),
+            eos_token_id=record.get("eos_token_id"),
+            arrival=float(self.clock()),
+            capture_logits=self.capture_logits)
+        seq.output = [int(t) for t in record.get("output", [])]
+        seq.pending = seq.output[-1] if seq.output else None
+        seq.preemptions = int(record.get("preemptions", 0))
+        self.sched.submit(seq)
+        self._submit_order.append(seq.request_id)
+        self._reg().counter("serve.resumed").inc()
+        self._update_gauges()
+        return seq.request_id
+
+    def resume(self, spill_path: Optional[str] = None) -> List[str]:
         """Re-admit a drain spill file into THIS (fresh, serving)
         engine.  Sequences resume exactly where they left off: generated
         output is preserved and the newest token becomes ``pending``, so
         the recompute-prefill path rebuilds the KV and decoding
-        continues token-exact.  Returns the resumed request ids."""
+        continues token-exact.  Returns the resumed request ids.
+
+        Without ``spill_path`` the engine reads its namespaced
+        ``<run_dir>/serve/replica-<i>/spill.json``, falling back to the
+        pre-ISSUE-16 ``<run_dir>/serve_spill.json`` so old run dirs
+        stay resumable."""
         enforce(self._state == "serving",
                 f"resume() needs a serving engine (state={self._state})")
+        if spill_path is None:
+            enforce(self.run_dir is not None,
+                    "resume() without a spill_path needs a run_dir")
+            spill_path = os.path.join(self.serve_dir(), "spill.json")
+            if not os.path.exists(spill_path):
+                legacy = os.path.join(self.run_dir, "serve_spill.json")
+                enforce(os.path.exists(legacy),
+                        f"no spill file at {spill_path} or {legacy}")
+                spill_path = legacy
         payload = json.loads(fsio.read_bytes(spill_path).decode())
         enforce(payload.get("version") == 1,
                 f"unknown spill-file version {payload.get('version')!r}")
-        rids = []
-        for rec in payload["spilled"]:
-            seq = SequenceState(
-                request_id=rec["request_id"],
-                prompt=[int(t) for t in rec["prompt"]],
-                max_new_tokens=int(rec["max_new_tokens"]),
-                eos_token_id=rec.get("eos_token_id"),
-                arrival=float(self.clock()),
-                capture_logits=self.capture_logits)
-            seq.output = [int(t) for t in rec.get("output", [])]
-            seq.pending = seq.output[-1] if seq.output else None
-            seq.preemptions = int(rec.get("preemptions", 0))
-            self.sched.submit(seq)
-            self._submit_order.append(seq.request_id)
-            self._reg().counter("serve.resumed").inc()
-            rids.append(seq.request_id)
-        self._update_gauges()
-        return rids
+        return [self.admit_record(rec) for rec in payload["spilled"]]
 
     # -- observability ------------------------------------------------------
     def _update_gauges(self) -> None:
@@ -935,6 +983,7 @@ class ServingEngine:
         leak = self.cache.leak_report()
         return {
             "steps": self.steps,
+            "replica_id": self.replica_id,
             "queue_depth": self.sched.queue_depth,
             "waiting": c["waiting"],
             "running": c["running"],

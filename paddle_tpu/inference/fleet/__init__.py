@@ -1,0 +1,36 @@
+"""Fault-tolerant multi-replica serving fleet (ISSUE 16).
+
+The layer above the single-host ServingEngine: a
+:class:`ReplicaManager` spawns/monitors N engine worker subprocesses
+(:mod:`.worker`, localhost HTTP, states starting/healthy/draining/
+dead), and a :class:`Router` dispatches client streams queue-aware
+least-loaded with session affinity, fleet-level admission control,
+bounded retry-with-backoff, and **token-exact failover**: the router
+journals every stream's prompt + accepted tokens, so a SIGKILLed
+replica's survivors re-enter a healthy engine through the
+recompute-prefill path and finish with exactly the tokens an
+uninterrupted run would have produced.  ``rolling_upgrade()`` drains
+one replica at a time with zero client-visible drops.
+
+See docs/ARCHITECTURE.md "Serving fleet" for the state machine,
+failover sequence, and the ``PTPU_FLEET_*`` knob table.
+"""
+from .replica import (HEARTBEAT_SECS_ENV, PORT_BASE_ENV, REPLICAS_ENV,
+                      HttpReplica, LocalReplica, ReplicaManager,
+                      default_heartbeat_secs, default_port_base,
+                      default_replicas)
+from .router import (RETRY_BACKOFF_MS_ENV, RETRY_MAX_ENV,
+                     SHED_QUEUE_DEPTH_ENV, DispatchExhausted,
+                     FleetOverloaded, Router, StreamJournal,
+                     default_retry_backoff_ms, default_retry_max,
+                     default_shed_queue_depth)
+
+__all__ = [
+    "LocalReplica", "HttpReplica", "ReplicaManager", "Router",
+    "StreamJournal", "FleetOverloaded", "DispatchExhausted",
+    "REPLICAS_ENV", "PORT_BASE_ENV", "HEARTBEAT_SECS_ENV",
+    "RETRY_MAX_ENV", "RETRY_BACKOFF_MS_ENV", "SHED_QUEUE_DEPTH_ENV",
+    "default_replicas", "default_port_base", "default_heartbeat_secs",
+    "default_retry_max", "default_retry_backoff_ms",
+    "default_shed_queue_depth",
+]

@@ -1,0 +1,407 @@
+"""Replica clients + the ReplicaManager (ISSUE 16).
+
+A *replica* is one ServingEngine the fleet router can dispatch to.  Two
+client shapes speak the same duck-typed protocol:
+
+- :class:`LocalReplica` — wraps an in-process engine; ``pump()`` steps
+  it.  This is the deterministic form the router unit tests and the
+  ``serve_fleet`` bench scenario use.
+- :class:`HttpReplica` — speaks localhost HTTP to a
+  :mod:`.worker` subprocess (``/submit`` ``/poll`` ``/drain``
+  ``/healthz`` ``/statusz``); ``pump()`` is a no-op because the worker
+  steps itself.
+
+The protocol (all a router needs):
+
+    submit(record)          admit one spill-format request record
+    poll(rid, start)        {"tokens": output[start:], "finished", "reason"}
+    serving_stats()         the /statusz serving section (load score)
+    healthz()               (http_code, state_string)
+    alive()                 False once the process/engine is gone
+    pump()                  advance work (in-process engines only)
+    drain(timeout)          {"finished", "spilled_records": [...]}
+
+:class:`ReplicaManager` spawns/monitors N worker subprocesses: states
+``starting`` (spawned, /healthz not yet 200) → ``healthy`` (200 +
+fresh heartbeat) → ``draining`` (503 draining) → ``dead`` (process
+exited or heartbeat older than ``PTPU_FLEET_HEARTBEAT_SECS``), mirrors
+the census into ``fleet.replicas[state=...]`` gauges, and can
+``restart()`` a slot — the rolling-upgrade primitive.
+
+Env knobs: ``PTPU_FLEET_REPLICAS``, ``PTPU_FLEET_PORT_BASE``,
+``PTPU_FLEET_HEARTBEAT_SECS`` (see docs/ARCHITECTURE.md "Serving
+fleet").
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ...framework.errors import enforce
+from ...framework.log import vlog
+
+__all__ = ["REPLICAS_ENV", "PORT_BASE_ENV", "HEARTBEAT_SECS_ENV",
+           "default_replicas", "default_port_base",
+           "default_heartbeat_secs", "LocalReplica", "HttpReplica",
+           "ReplicaManager"]
+
+REPLICAS_ENV = "PTPU_FLEET_REPLICAS"
+PORT_BASE_ENV = "PTPU_FLEET_PORT_BASE"
+HEARTBEAT_SECS_ENV = "PTPU_FLEET_HEARTBEAT_SECS"
+
+
+def default_replicas() -> int:
+    return int(os.environ.get(REPLICAS_ENV, "2"))
+
+
+def default_port_base() -> int:
+    """0 = every worker binds an ephemeral port and reports it on the
+    spawn handshake line — the CI-safe default (no port collisions)."""
+    return int(os.environ.get(PORT_BASE_ENV, "0"))
+
+
+def default_heartbeat_secs() -> float:
+    return float(os.environ.get(HEARTBEAT_SECS_ENV, "10"))
+
+
+class LocalReplica:
+    """In-process replica: a ServingEngine behind the replica protocol.
+
+    The router's unit tests and the bench scenario run whole fleets of
+    these in one process — same dispatch/journal/failover code paths as
+    the subprocess form, no IPC nondeterminism."""
+
+    def __init__(self, engine, replica_id: int = 0):
+        self.engine = engine
+        self.replica_id = int(replica_id)
+        if engine.replica_id is None:
+            engine.replica_id = self.replica_id
+
+    def _check_up(self) -> None:
+        # a dead in-process engine fails like a dead worker: the
+        # transport error is the router's failover signal
+        if self.engine.state == "stopped":
+            raise ConnectionError(
+                f"replica {self.replica_id}: engine stopped")
+
+    def submit(self, record: Dict[str, Any]) -> None:
+        self._check_up()
+        self.engine.admit_record(record)
+
+    def poll(self, request_id: str, start: int = 0) -> Dict[str, Any]:
+        self._check_up()
+        eng = self.engine
+        seq = eng.sched.finished.get(request_id)
+        if seq is None:
+            for s in list(eng.sched.running) + list(eng.sched.waiting):
+                if s.request_id == request_id:
+                    seq = s
+                    break
+        enforce(seq is not None,
+                f"replica {self.replica_id}: unknown request "
+                f"{request_id!r}")
+        finished = seq.finish_reason is not None
+        return {"tokens": list(seq.output[start:]),
+                "finished": finished,
+                "reason": seq.finish_reason}
+
+    def pump(self) -> bool:
+        """One engine step when work is queued; True when it stepped."""
+        if self.engine.state == "serving" and self.engine.has_work():
+            self.engine.step()
+            return True
+        return False
+
+    def serving_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def healthz(self):
+        if self.engine.state != "serving":
+            return 503, self.engine.state
+        if self.engine.should_shed():
+            return 503, \
+                f"load-shed:queue_depth={self.engine.sched.queue_depth}"
+        return 200, "serving"
+
+    def alive(self) -> bool:
+        return self.engine.state != "stopped"
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        report = self.engine.drain(timeout=timeout)
+        return {"finished": report["finished"],
+                "spilled_records": report["spilled_records"]}
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+
+class HttpReplica:
+    """Localhost-HTTP client for one :mod:`.worker` subprocess.
+
+    Transport errors surface as ``ConnectionError`` from every call —
+    the router's retry/failover signal.  ``process`` (when the manager
+    spawned the worker) lets ``alive()`` notice a SIGKILLed worker
+    immediately instead of waiting out a connect timeout."""
+
+    def __init__(self, replica_id: int, port: int,
+                 host: str = "127.0.0.1", timeout: float = 5.0,
+                 process: Optional[subprocess.Popen] = None):
+        self.replica_id = int(replica_id)
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+        self.process = process
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _call(self, path: str, payload: Optional[Dict] = None,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self._url(path), data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            raise ConnectionError(
+                f"replica {self.replica_id} {path}: HTTP {e.code} "
+                f"{body[:200]}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ConnectionError(
+                f"replica {self.replica_id} {path}: {e}") from e
+
+    def submit(self, record: Dict[str, Any]) -> None:
+        self._call("/submit", {"record": record})
+
+    def poll(self, request_id: str, start: int = 0) -> Dict[str, Any]:
+        return self._call(f"/poll?rid={request_id}&start={int(start)}")
+
+    def pump(self) -> bool:
+        return False                  # the worker steps itself
+
+    def serving_stats(self) -> Dict[str, Any]:
+        return self._call("/statusz").get("serving") or {}
+
+    def healthz(self):
+        try:
+            out = self._call("/healthz")
+            return 200, out.get("state", "serving")
+        except ConnectionError as e:
+            cause = e.__cause__
+            if isinstance(cause, urllib.error.HTTPError):
+                try:
+                    return cause.code, json.loads(
+                        str(e).split(" ", 3)[-1]).get("state", "unknown")
+                except Exception:  # noqa: swallow — health probe must answer
+                    return cause.code, "unhealthy"
+            raise
+
+    def alive(self) -> bool:
+        if self.process is not None and self.process.poll() is not None:
+            return False
+        try:
+            self.healthz()
+            return True
+        except ConnectionError:
+            return False
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        # the worker finishes/spills inside this call — give the HTTP
+        # read a margin over the engine-side budget
+        http_timeout = (self.timeout if timeout is None
+                        else float(timeout) + 30.0)
+        return self._call("/drain", {"timeout": timeout},
+                          timeout=http_timeout)
+
+    def stop(self) -> None:
+        try:
+            self._call("/shutdown", {})
+        except ConnectionError:
+            pass                      # already gone — that is the goal
+
+
+class ReplicaManager:
+    """Spawn + monitor N engine worker subprocesses.
+
+    ``model_spec`` is the JSON-able dict :mod:`.worker` rebuilds the
+    decoder from (config kwargs + seed) — every replica seeds
+    identically, so greedy decode is token-exact across the fleet and
+    failover is provable against a single-engine reference.
+
+    State machine per slot (mirrored into ``fleet.replicas[state=...]``
+    gauges by :meth:`poll_states`):
+
+        starting --/healthz 200--> healthy --503 draining--> draining
+            |                        |                          |
+            +---- process exit / stale heartbeat ----> dead <---+
+    """
+
+    def __init__(self, model_spec: Dict[str, Any], *,
+                 replicas: Optional[int] = None,
+                 port_base: Optional[int] = None,
+                 run_dir: Optional[str] = None,
+                 registry=None,
+                 heartbeat_secs: Optional[float] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 spawn_timeout: float = 120.0):
+        self.model_spec = dict(model_spec)
+        self.num_replicas = int(replicas if replicas is not None
+                                else default_replicas())
+        enforce(self.num_replicas >= 1, "fleet needs >= 1 replica")
+        self.port_base = int(port_base if port_base is not None
+                             else default_port_base())
+        self.run_dir = run_dir
+        self._registry = registry
+        self.heartbeat_secs = float(
+            heartbeat_secs if heartbeat_secs is not None
+            else default_heartbeat_secs())
+        self.env = dict(env or {})
+        self.spawn_timeout = float(spawn_timeout)
+        self.replicas: List[HttpReplica] = []
+        self.states: Dict[int, str] = {}
+        self._last_beat: Dict[int, float] = {}
+        self.restarts = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ...observability.registry import get_registry
+        return get_registry()
+
+    # -- spawning ----------------------------------------------------------
+    def _spawn(self, idx: int) -> HttpReplica:
+        port = self.port_base + idx if self.port_base > 0 else 0
+        cmd = [sys.executable, "-m", "paddle_tpu.inference.fleet.worker",
+               "--replica-id", str(idx), "--port", str(port),
+               "--model", json.dumps(self.model_spec)]
+        if self.run_dir:
+            cmd += ["--run-dir", self.run_dir]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(self.env)
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True,
+                                env=env)
+        # handshake: the worker prints ONE line once its server is bound
+        # (ephemeral ports make this the only way to learn the port)
+        deadline = time.monotonic() + self.spawn_timeout
+        line = ""
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("ptpu-fleet-worker"):
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {idx} died before handshake "
+                    f"(rc={proc.returncode})")
+        enforce(line.startswith("ptpu-fleet-worker"),
+                f"fleet worker {idx}: no handshake within "
+                f"{self.spawn_timeout}s")
+        fields = dict(kv.split("=", 1) for kv in line.split()
+                      if "=" in kv)
+        replica = HttpReplica(idx, int(fields["port"]), process=proc)
+        self.states[idx] = "starting"
+        self._last_beat[idx] = time.monotonic()
+        vlog(0, "fleet: worker %d up on port %d (pid %s)", idx,
+             replica.port, fields.get("pid"))
+        return replica
+
+    def start(self) -> List[HttpReplica]:
+        enforce(not self.replicas, "fleet already started")
+        self.replicas = [self._spawn(i)
+                         for i in range(self.num_replicas)]
+        self.poll_states()
+        return self.replicas
+
+    def restart(self, idx: int) -> HttpReplica:
+        """Replace slot ``idx`` with a fresh worker (rolling upgrade /
+        post-failover respawn).  The old process, if any, is killed."""
+        old = self.replicas[idx]
+        if old.process is not None and old.process.poll() is None:
+            old.process.kill()
+            old.process.wait(timeout=10)
+        self.replicas[idx] = self._spawn(idx)
+        self.restarts += 1
+        self._reg().counter("fleet.restarts").inc()
+        self.poll_states()
+        return self.replicas[idx]
+
+    # -- monitoring --------------------------------------------------------
+    def _probe(self, idx: int, replica: HttpReplica) -> str:
+        proc = replica.process
+        if proc is not None and proc.poll() is not None:
+            return "dead"
+        try:
+            code, state = replica.healthz()
+            self._last_beat[idx] = time.monotonic()
+        except ConnectionError:
+            age = time.monotonic() - self._last_beat.get(idx, 0.0)
+            if age > self.heartbeat_secs:
+                return "dead"
+            return self.states.get(idx, "starting")
+        if code == 200:
+            return "healthy"
+        if str(state).startswith(("draining", "stopped")):
+            return "draining"
+        if str(state).startswith("load-shed"):
+            return "healthy"          # shedding, but alive and serving
+        return self.states.get(idx, "starting")
+
+    def poll_states(self) -> Dict[int, str]:
+        """One health sweep: probe every slot, update the state map and
+        the ``fleet.replicas[state=...]`` gauges; returns the map."""
+        for idx, replica in enumerate(self.replicas):
+            new = self._probe(idx, replica)
+            old = self.states.get(idx)
+            if new != old:
+                self._reg().emit("fleet.replica_state", replica=idx,
+                                 prev=old, state=new)
+                vlog(1, "fleet: replica %d %s -> %s", idx, old, new)
+            self.states[idx] = new
+        self.update_gauges()
+        return dict(self.states)
+
+    def update_gauges(self) -> None:
+        reg = self._reg()
+        counts = {s: 0 for s in ("starting", "healthy", "draining",
+                                 "dead")}
+        for s in self.states.values():
+            counts[s] = counts.get(s, 0) + 1
+        for state, n in counts.items():
+            reg.gauge(f"fleet.replicas[state={state}]").set(float(n))
+
+    def kill(self, idx: int, sig=None) -> None:
+        """Hard-kill slot ``idx`` (drill seam — see
+        ``testing/faults.kill_replica``)."""
+        import signal as _signal
+        proc = self.replicas[idx].process
+        enforce(proc is not None, f"replica {idx} has no process handle")
+        os.kill(proc.pid, sig if sig is not None else _signal.SIGKILL)
+        proc.wait(timeout=10)
+        self.poll_states()
+
+    def stop(self) -> None:
+        for replica in self.replicas:
+            replica.stop()
+        for replica in self.replicas:
+            proc = replica.process
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        for idx in range(len(self.replicas)):
+            self.states[idx] = "dead"
+        self.update_gauges()

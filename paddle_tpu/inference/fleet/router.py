@@ -1,0 +1,421 @@
+"""Fleet router: queue-aware dispatch with token-exact failover.
+
+The router is the layer the ROADMAP's "millions of users" tier needs
+above the single-host ServingEngine: it owns a set of replicas (any
+mix of :class:`..replica.LocalReplica` / ``HttpReplica``) and gives
+clients one durable stream per request, surviving replica death,
+clean drains, and rolling upgrades with zero client-visible drops.
+
+Mechanics:
+
+- **Dispatch** — least-loaded by each replica's ``/statusz`` serving
+  section (``queue_depth + waiting + running``); a stream with a
+  ``session`` key is affine to the replica already serving that
+  session (KV/prefix locality), unless that replica left the healthy
+  set.  Dispatch failures retry with bounded exponential backoff
+  (``PTPU_FLEET_RETRY_MAX`` × ``PTPU_FLEET_RETRY_BACKOFF_MS``) across
+  the healthy set; exhaustion raises :class:`DispatchExhausted`
+  naming every replica tried.
+- **Admission** — fleet-level generalization of the PR 6 load-shed:
+  when total queued work across healthy replicas exceeds
+  ``PTPU_FLEET_SHED_QUEUE_DEPTH``, new submissions raise
+  :class:`FleetOverloaded` (the caller's 429).
+- **Token-exact failover** — the router journals every stream's
+  prompt and accepted tokens.  ``pump()`` polls new tokens into the
+  journal; when a replica dies mid-stream (SIGKILL — no spill file),
+  the survivors' journal entries are re-submitted to a healthy
+  replica as spill-format records (``output`` = accepted tokens), so
+  the engine's recompute-prefill path rebuilds the KV and greedy
+  decoding continues **token-exact** — the same seam ``resume()``
+  uses.  A replica that drains cleanly hands its ``spilled_records``
+  to the router, which migrates them identically.
+- **Rolling upgrade** — :meth:`rolling_upgrade` drains one replica at
+  a time (migrating its spill), lets the manager respawn it, waits
+  healthy, and moves on; in-flight streams never drop.
+
+Counters: ``fleet.dispatch``, ``fleet.retries``, ``fleet.failovers``,
+``fleet.migrations``, ``fleet.shed``; gauges ``fleet.streams`` and
+the manager's ``fleet.replicas[state=...]`` census.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ...framework.errors import enforce
+from ...framework.log import vlog
+
+__all__ = ["RETRY_MAX_ENV", "RETRY_BACKOFF_MS_ENV",
+           "SHED_QUEUE_DEPTH_ENV", "default_retry_max",
+           "default_retry_backoff_ms", "default_shed_queue_depth",
+           "FleetOverloaded", "DispatchExhausted", "StreamJournal",
+           "Router"]
+
+RETRY_MAX_ENV = "PTPU_FLEET_RETRY_MAX"
+RETRY_BACKOFF_MS_ENV = "PTPU_FLEET_RETRY_BACKOFF_MS"
+SHED_QUEUE_DEPTH_ENV = "PTPU_FLEET_SHED_QUEUE_DEPTH"
+
+
+def default_retry_max() -> int:
+    return int(os.environ.get(RETRY_MAX_ENV, "3"))
+
+
+def default_retry_backoff_ms() -> float:
+    return float(os.environ.get(RETRY_BACKOFF_MS_ENV, "50"))
+
+
+def default_shed_queue_depth() -> int:
+    return int(os.environ.get(SHED_QUEUE_DEPTH_ENV, "64"))
+
+
+class FleetOverloaded(RuntimeError):
+    """Fleet-level admission refusal (every replica is past the shed
+    threshold, or the aggregate queue is) — the client's 429."""
+
+
+class DispatchExhausted(RuntimeError):
+    """Dispatch retries exhausted; the message names every replica
+    tried so operators see the blast radius, not just the last error."""
+
+
+class StreamJournal:
+    """One client stream's durable record: the prompt plus every token
+    the router has accepted — exactly the spill-format record a fresh
+    engine re-admits token-exactly on failover."""
+
+    def __init__(self, request_id: str, prompt: Sequence[int],
+                 max_new_tokens: int, eos_token_id: Optional[int],
+                 session: Optional[str] = None):
+        self.request_id = request_id
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.session = session
+        self.tokens: List[int] = []     # accepted (journaled) tokens
+        self.finished = False
+        self.reason: Optional[str] = None
+        self.replica_id: Optional[int] = None
+        self.failovers = 0
+
+    def record(self) -> Dict[str, Any]:
+        """Spill-format record re-admitting this stream mid-flight."""
+        return {"request_id": self.request_id,
+                "prompt": list(self.prompt),
+                "output": list(self.tokens),
+                "max_new_tokens": self.max_new_tokens,
+                "eos_token_id": self.eos_token_id,
+                "preemptions": 0}
+
+
+class Router:
+    """Dispatch + journal + failover over a replica set.
+
+    ``replicas`` maps replica_id → client.  ``manager`` (optional,
+    a :class:`..replica.ReplicaManager`) supplies the subprocess
+    census for ``poll_states``-driven liveness; without one the
+    router probes ``alive()`` itself (the in-process form)."""
+
+    def __init__(self, replicas, *, manager=None, registry=None,
+                 retry_max: Optional[int] = None,
+                 retry_backoff_ms: Optional[float] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 sleep=time.sleep):
+        if isinstance(replicas, dict):
+            self.replicas = dict(replicas)
+        else:
+            self.replicas = {r.replica_id: r for r in replicas}
+        enforce(self.replicas, "router needs at least one replica")
+        self.manager = manager
+        self._registry = registry
+        self.retry_max = int(retry_max if retry_max is not None
+                             else default_retry_max())
+        self.retry_backoff_ms = float(
+            retry_backoff_ms if retry_backoff_ms is not None
+            else default_retry_backoff_ms())
+        self.shed_queue_depth = int(
+            shed_queue_depth if shed_queue_depth is not None
+            else default_shed_queue_depth())
+        self._sleep = sleep
+        self.journals: Dict[str, StreamJournal] = {}
+        self._sessions: Dict[str, int] = {}   # session -> replica_id
+        self._ids = 0
+        self.dispatch_fault = None   # seam: fn(replica_id, record) pre-send
+        self.failovers = 0
+        self.migrations = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ...observability.registry import get_registry
+        return get_registry()
+
+    # -- replica set -------------------------------------------------------
+    def _healthy_ids(self) -> List[int]:
+        if self.manager is not None:
+            states = self.manager.poll_states()
+            self.replicas = {i: r for i, r
+                             in enumerate(self.manager.replicas)}
+            return [i for i, s in states.items() if s == "healthy"]
+        return [i for i, r in self.replicas.items() if r.alive()
+                and r.healthz()[0] == 200]
+
+    def _load(self, replica) -> float:
+        """Queue-aware load score from the replica's serving stats;
+        unreachable replicas sort last."""
+        try:
+            s = replica.serving_stats()
+        except ConnectionError:
+            return float("inf")
+        return (float(s.get("queue_depth", 0)) + float(s.get("waiting", 0))
+                + float(s.get("running", 0)))
+
+    def _pick(self, session: Optional[str],
+              healthy: List[int]) -> List[int]:
+        """Candidate order: session-affine replica first (when still
+        healthy), then the rest least-loaded."""
+        ranked = sorted(healthy,
+                        key=lambda i: (self._load(self.replicas[i]), i))
+        if session is not None:
+            aff = self._sessions.get(session)
+            if aff in ranked:
+                ranked.remove(aff)
+                ranked.insert(0, aff)
+        return ranked
+
+    def fleet_depth(self, healthy: List[int]) -> float:
+        return sum(self._load(self.replicas[i]) for i in healthy)
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, journal: StreamJournal) -> int:
+        """Send ``journal``'s record to the best replica, retrying with
+        backoff across the healthy set.  Returns the replica id."""
+        reg = self._reg()
+        tried: List[str] = []
+        backoff = self.retry_backoff_ms / 1e3
+        for attempt in range(self.retry_max + 1):
+            healthy = self._healthy_ids()
+            for rid in self._pick(journal.session, healthy):
+                replica = self.replicas[rid]
+                try:
+                    if self.dispatch_fault is not None:
+                        self.dispatch_fault(rid, journal.record())
+                    replica.submit(journal.record())
+                except ConnectionError as e:
+                    tried.append(f"replica-{rid}: {e}")
+                    continue
+                journal.replica_id = rid
+                if journal.session is not None:
+                    self._sessions[journal.session] = rid
+                reg.counter("fleet.dispatch").inc()
+                reg.emit("fleet.dispatch", request_id=journal.request_id,
+                         replica=rid, attempt=attempt,
+                         resumed_at=len(journal.tokens))
+                return rid
+            if attempt < self.retry_max:
+                reg.counter("fleet.retries").inc()
+                self._sleep(backoff)
+                backoff *= 2
+        raise DispatchExhausted(
+            f"{journal.request_id}: dispatch failed after "
+            f"{self.retry_max + 1} attempts across replicas "
+            f"{sorted(self.replicas)} — " + ("; ".join(tried[-6:])
+                                             or "no healthy replica"))
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               request_id: Optional[str] = None,
+               eos_token_id: Optional[int] = None,
+               session: Optional[str] = None) -> str:
+        """Admit one client stream: journal it, then dispatch.  Raises
+        :class:`FleetOverloaded` past the fleet shed threshold."""
+        healthy = self._healthy_ids()
+        depth = self.fleet_depth(healthy)
+        if not healthy or depth > self.shed_queue_depth:
+            self._reg().counter("fleet.shed").inc()
+            raise FleetOverloaded(
+                f"fleet admission closed: {len(healthy)} healthy "
+                f"replicas, aggregate depth {depth:.0f} > "
+                f"{self.shed_queue_depth}")
+        if request_id is None:
+            request_id = f"fleet-{self._ids}"
+            self._ids += 1
+        enforce(request_id not in self.journals,
+                f"duplicate request id {request_id!r}")
+        journal = StreamJournal(request_id, prompt, max_new_tokens,
+                                eos_token_id, session=session)
+        self.journals[request_id] = journal
+        self._reg().gauge("fleet.streams").set(float(len(
+            [j for j in self.journals.values() if not j.finished])))
+        self._dispatch(journal)
+        return request_id
+
+    # -- streaming / failover ---------------------------------------------
+    def _poll_journal(self, journal: StreamJournal) -> bool:
+        """Pull new tokens for one live stream into its journal; True
+        when progress or completion was observed.  ConnectionError
+        propagates — pump() turns it into failover."""
+        replica = self.replicas[journal.replica_id]
+        out = replica.poll(journal.request_id, start=len(journal.tokens))
+        new = [int(t) for t in out["tokens"]]
+        if new:
+            journal.tokens.extend(new)
+        if out["finished"]:
+            journal.finished = True
+            journal.reason = out.get("reason")
+        return bool(new) or journal.finished
+
+    def _failover(self, journal: StreamJournal, why: str) -> None:
+        """Re-home one live stream: re-submit its journal record (the
+        accepted-token tail rides along) to a healthy replica."""
+        reg = self._reg()
+        dead = journal.replica_id
+        journal.failovers += 1
+        self.failovers += 1
+        journal.replica_id = None
+        if (journal.session is not None
+                and self._sessions.get(journal.session) == dead):
+            del self._sessions[journal.session]
+        rid = self._dispatch(journal)
+        reg.counter("fleet.failovers").inc()
+        reg.emit("fleet.failover", request_id=journal.request_id,
+                 from_replica=dead, to_replica=rid, why=why,
+                 accepted_tokens=len(journal.tokens))
+        vlog(0, "fleet: failover %s replica %s -> %d (%s, %d tokens "
+             "accepted)", journal.request_id, dead, rid, why,
+             len(journal.tokens))
+
+    def pump(self) -> int:
+        """One router turn: step in-process replicas, poll every live
+        stream's tokens into its journal, and fail over streams whose
+        replica died.  Returns the number of live streams remaining."""
+        for replica in self.replicas.values():
+            try:
+                replica.pump()
+            except ConnectionError:
+                pass                  # liveness handled per-stream below
+        live = [j for j in self.journals.values() if not j.finished]
+        for journal in live:
+            if journal.replica_id is None:
+                self._failover(journal, "undispatched")
+                continue
+            try:
+                self._poll_journal(journal)
+            except ConnectionError as e:
+                replica = self.replicas.get(journal.replica_id)
+                if replica is not None and replica.alive():
+                    raise    # transient — replica is up; surface it
+                self._failover(journal, f"replica died ({e})")
+        remaining = [j for j in self.journals.values() if not j.finished]
+        self._reg().gauge("fleet.streams").set(float(len(remaining)))
+        return len(remaining)
+
+    def collect(self, request_id: str,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Pump until ``request_id`` finishes; return its journal
+        record (tokens are the journaled, failover-stable stream)."""
+        journal = self.journals.get(request_id)
+        enforce(journal is not None, f"unknown stream {request_id!r}")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not journal.finished:
+            enforce(deadline is None or time.monotonic() < deadline,
+                    f"{request_id}: fleet stream not finished after "
+                    f"{timeout}s (replica={journal.replica_id}, "
+                    f"accepted={len(journal.tokens)})")
+            self.pump()
+            if not journal.finished:
+                self._sleep(0.002)
+        return {"request_id": request_id,
+                "tokens": list(journal.tokens),
+                "finish_reason": journal.reason,
+                "replica_id": journal.replica_id,
+                "failovers": journal.failovers}
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """Pump until every journaled stream finishes."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self.pump() > 0:
+            enforce(deadline is None or time.monotonic() < deadline,
+                    f"fleet streams not drained after {timeout}s")
+            self._sleep(0.002)
+
+    # -- drain / rolling upgrade -------------------------------------------
+    def drain_replica(self, rid: int,
+                      timeout: Optional[float] = None) -> int:
+        """Gracefully drain one replica and migrate its spilled
+        streams to the rest of the fleet; returns the migration
+        count.  The replica ends ``stopped`` — restart it via the
+        manager before re-adding."""
+        replica = self.replicas[rid]
+        report = replica.drain(timeout=timeout)
+        migrated = 0
+        by_rid = {j.request_id: j for j in self.journals.values()}
+        for rec in report.get("spilled_records", []):
+            journal = by_rid.get(rec["request_id"])
+            if journal is None or journal.finished:
+                continue
+            # trust the engine's record — it may hold tokens a poll
+            # never fetched; both prefixes agree (greedy decode)
+            if len(rec.get("output", [])) > len(journal.tokens):
+                journal.tokens = [int(t) for t in rec["output"]]
+            journal.replica_id = None
+            if (journal.session is not None
+                    and self._sessions.get(journal.session) == rid):
+                del self._sessions[journal.session]
+            self._dispatch(journal)
+            migrated += 1
+            self.migrations += 1
+            self._reg().counter("fleet.migrations").inc()
+        # finished-on-drain streams: pull their final tokens before the
+        # replica goes away entirely
+        for journal in self.journals.values():
+            if journal.replica_id == rid and not journal.finished:
+                try:
+                    self._poll_journal(journal)
+                except ConnectionError:
+                    pass
+        self._reg().emit("fleet.drain", replica=rid, migrated=migrated,
+                         finished=report.get("finished"))
+        return migrated
+
+    def rolling_upgrade(self,
+                        timeout_per_replica: Optional[float] = None
+                        ) -> Dict[int, int]:
+        """Drain + respawn every replica one at a time while the rest
+        of the fleet absorbs the load; returns replica_id → migrated
+        stream count.  Requires a manager (subprocess fleet)."""
+        enforce(self.manager is not None,
+                "rolling_upgrade() needs a ReplicaManager")
+        migrated: Dict[int, int] = {}
+        for rid in sorted(self.replicas):
+            migrated[rid] = self.drain_replica(
+                rid, timeout=timeout_per_replica)
+            self.manager.restart(rid)
+            self.replicas[rid] = self.manager.replicas[rid]
+            deadline = time.monotonic() + 60.0
+            while self.manager.poll_states().get(rid) != "healthy":
+                enforce(time.monotonic() < deadline,
+                        f"replica {rid} not healthy after respawn")
+                self._sleep(0.05)
+            vlog(0, "fleet: rolling upgrade — replica %d respawned "
+                 "(%d streams migrated)", rid, migrated[rid])
+        return migrated
+
+    # -- observability ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Fleet snapshot for ``/statusz`` and the doctor."""
+        live = [j for j in self.journals.values() if not j.finished]
+        states = (self.manager.poll_states() if self.manager is not None
+                  else {i: ("healthy" if r.alive() else "dead")
+                        for i, r in self.replicas.items()})
+        counts: Dict[str, int] = {}
+        for s in states.values():
+            counts[s] = counts.get(s, 0) + 1
+        return {"replicas": len(self.replicas),
+                "states": counts,
+                "streams": {"live": len(live),
+                            "finished": len(self.journals) - len(live)},
+                "failovers": self.failovers,
+                "migrations": self.migrations,
+                "sessions": len(self._sessions)}

@@ -68,7 +68,8 @@ from .sinks import metrics_dir
 __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_memory", "check_straggler", "check_data_starved",
            "check_comm_bound", "check_supervisor",
-           "check_perf_regression", "check_perf_trend", "check_serving"]
+           "check_perf_regression", "check_perf_trend", "check_serving",
+           "check_fleet"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -623,8 +624,9 @@ def check_serving(workers) -> List[Dict[str, Any]]:
             errors[e] = errors.get(e, 0) + 1
         ev = [f"{q.get('request_id')}: {q.get('step_kind')} step — "
               f"{q.get('error')}" for q in quarantines[:4]]
-        ev.append("durable records under <run_dir>/serve_quarantine/; "
-                  "every co-batched request completed token-exact")
+        ev.append("durable records under "
+                  "<run_dir>/serve/replica-<i>/quarantine/; every "
+                  "co-batched request completed token-exact")
         findings.append(_finding(
             "serve_poisoned", 55 + 5 * min(6, len(quarantines)),
             f"{len(quarantines)} request(s) quarantined as poisoned",
@@ -641,6 +643,48 @@ def check_serving(workers) -> List[Dict[str, Any]]:
             "serve_deadline_misses", 30 + 5 * min(8, len(misses)),
             f"{len(misses)} request(s) evicted past their deadline",
             ev, count=len(misses), ttft_misses=ttft))
+    return findings
+
+
+def check_fleet(workers) -> List[Dict[str, Any]]:
+    """Serving-fleet verdict (ISSUE 16): ``fleet_failover`` when the
+    router re-homed live streams off a dead replica.  Failover itself
+    is the system WORKING — clients saw nothing — but a replica died,
+    and dying replicas are the thing to fix, so the verdict names the
+    dead replicas and how many streams each failover moved."""
+    findings: List[Dict[str, Any]] = []
+    failovers: List[Dict[str, Any]] = []
+    deaths: List[Dict[str, Any]] = []
+    for recs in workers.values():
+        for r in recs:
+            k = r.get("kind")
+            if k == "fleet.failover":
+                failovers.append(r)
+            elif (k == "fleet.replica_state"
+                  and r.get("state") == "dead"):
+                deaths.append(r)
+    if not failovers:
+        return findings
+    by_replica: Dict[str, int] = {}
+    for f in failovers:
+        src = str(f.get("from_replica"))
+        by_replica[src] = by_replica.get(src, 0) + 1
+    ev = [f"{f.get('request_id')}: replica {f.get('from_replica')} -> "
+          f"{f.get('to_replica')} ({f.get('why')}, "
+          f"{f.get('accepted_tokens')} tokens journaled)"
+          for f in failovers[:4]]
+    ev.append("streams re-entered via the recompute-prefill path — "
+              "completions stay token-exact (journaled prompt + "
+              "accepted tokens re-admitted as pending tail)")
+    if deaths:
+        ev.append("replica deaths observed: " + ", ".join(
+            f"replica {d.get('replica')}" for d in deaths[:6]))
+    findings.append(_finding(
+        "fleet_failover", 50 + 5 * min(6, len(failovers)),
+        f"{len(failovers)} stream failover(s) off dead replica(s) "
+        f"{sorted(by_replica)}",
+        ev, count=len(failovers), by_replica=by_replica,
+        deaths=len(deaths)))
     return findings
 
 
@@ -672,6 +716,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_perf_trend(workers)
     findings += check_integrity(events)
     findings += check_serving(workers)
+    findings += check_fleet(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

@@ -84,10 +84,12 @@ class StatusServer:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  registry=None, supervisor=None,
-                 worker_id: Optional[int] = None, engine=None):
+                 worker_id: Optional[int] = None, engine=None,
+                 router=None):
         self._registry = registry
         self.supervisor = supervisor
         self.engine = engine          # serving engine (ISSUE 6 SLOs)
+        self.router = router          # fleet router (ISSUE 16 census)
         self.worker_id = worker_id
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -203,6 +205,33 @@ class StatusServer:
             except Exception:  # noqa: swallow — statusz must render
                 pass
         status["serving"] = serving or None
+        # serving fleet (ISSUE 16): replica census + stream/failover
+        # counters — registry-derived so any worker in the fleet can
+        # render it; the router's richer stats() dict wins when the
+        # router itself hosts this server
+        fleet: Dict[str, Any] = {}
+        if any(k.startswith("fleet.") for k in snap):
+            states = {}
+            for key in snap:
+                if key.startswith("fleet.replicas[state="):
+                    states[key[len("fleet.replicas[state="):-1]] = \
+                        gauge(key)
+            fleet = {
+                "replicas": states or None,
+                "streams": gauge("fleet.streams"),
+                "dispatch": counter("fleet.dispatch"),
+                "retries": counter("fleet.retries"),
+                "failovers": counter("fleet.failovers"),
+                "migrations": counter("fleet.migrations"),
+                "shed": counter("fleet.shed"),
+                "restarts": counter("fleet.restarts"),
+            }
+        if self.router is not None:
+            try:
+                fleet.update(self.router.stats())
+            except Exception:  # noqa: swallow — statusz must render
+                pass
+        status["fleet"] = fleet or None
         sup = self.supervisor
         # elasticity (ISSUE 9): present whenever an elastic coordinator
         # drives this worker or elastic.* instruments exist — the page an
@@ -565,6 +594,7 @@ class LiveAggregator:
         findings += doctor.check_perf_regression(workers)
         findings += doctor.check_perf_trend(workers)
         findings += doctor.check_serving(workers)
+        findings += doctor.check_fleet(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

@@ -32,6 +32,14 @@ Serving-side injectors (ISSUE 15): :class:`poison_request` plugs into
 (raise / NaN logits / hang) so the quarantine, NaN-guard and watchdog
 paths are drillable without real hardware faults; :class:`expire_clock`
 is a hand-advanced clock for deadline-eviction tests.
+
+Fleet injectors (ISSUE 16): :class:`kill_replica` SIGKILLs one fleet
+worker subprocess — optionally gated on a ``when()`` predicate the
+drill polls, so "kill replica 0 once stream X has 3 accepted tokens"
+is deterministic; :class:`drop_dispatch` plugs into
+``Router.dispatch_fault`` and fails the first N dispatch attempts
+with ``ConnectionError``, driving the retry-with-backoff and
+exhaustion paths without a real network.
 """
 from __future__ import annotations
 
@@ -47,7 +55,8 @@ from ..utils.retry import RetryPolicy
 __all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
            "corrupt_manifest", "fast_retries", "hang", "slow_call",
            "diverge_after", "sigkill_self", "sigkill_at", "bitflip",
-           "flip_tree_bit", "poison_request", "expire_clock"]
+           "flip_tree_bit", "poison_request", "expire_clock",
+           "kill_replica", "drop_dispatch"]
 
 
 def _default_transient() -> OSError:
@@ -436,6 +445,94 @@ class expire_clock:
 
     def __call__(self) -> float:
         return self.now
+
+
+# ---------------------------------------------------------------------------
+# fleet injectors (ISSUE 16)
+# ---------------------------------------------------------------------------
+class kill_replica:
+    """SIGKILL one fleet worker subprocess, deterministically.
+
+    ``target`` is anything with a live process: a ``ReplicaManager``
+    plus ``index``, an ``HttpReplica`` (its ``.process``), a
+    ``subprocess.Popen``, or a bare pid.  With ``when`` (a no-arg
+    predicate) the drill polls ``maybe()`` in its pump loop and the
+    kill fires exactly once, the first time the predicate holds —
+    e.g. ``when=lambda: len(journal.tokens) >= 3`` pins "die
+    mid-stream after 3 accepted tokens".  Calling the injector
+    directly fires unconditionally.
+
+    >>> k = kill_replica(manager, index=0,
+    ...                  when=lambda: len(j.tokens) >= 3)
+    >>> while not router.journals_done():
+    ...     router.pump(); k.maybe()
+    >>> k.fired
+    1
+    """
+
+    def __init__(self, target, index: Optional[int] = None,
+                 sig: int = _signal.SIGKILL,
+                 when: Optional[Callable[[], bool]] = None):
+        self.target = target
+        self.index = index
+        self.sig = sig
+        self.when = when
+        self.fired = 0
+
+    def _pid(self) -> int:
+        t = self.target
+        if isinstance(t, int):
+            return t
+        if self.index is not None and hasattr(t, "replicas"):
+            t = t.replicas[self.index]          # ReplicaManager slot
+        proc = getattr(t, "process", t)          # HttpReplica -> Popen
+        return int(proc.pid)
+
+    def __call__(self) -> int:
+        """Fire now; returns the killed pid."""
+        pid = self._pid()
+        os.kill(pid, self.sig)
+        t = self.target
+        if self.index is not None and hasattr(t, "replicas"):
+            t.replicas[self.index].process.wait(timeout=10)
+            t.poll_states()
+        self.fired += 1
+        return pid
+
+    def maybe(self) -> bool:
+        """Fire once when ``when()`` first holds; True if it fired."""
+        if self.fired or (self.when is not None and not self.when()):
+            return False
+        self()
+        return True
+
+
+class drop_dispatch:
+    """Router-visible network fault: assigned to
+    ``Router.dispatch_fault``, it raises ``ConnectionError`` for the
+    first ``count`` dispatch attempts (optionally only toward
+    ``replica_id``), then passes everything — the deterministic way to
+    drill retry-with-backoff and ``DispatchExhausted``.
+
+    >>> router.dispatch_fault = drop_dispatch(count=2)
+    >>> router.submit(...)      # two retries burned, third attempt lands
+    """
+
+    def __init__(self, count: int, replica_id: Optional[int] = None):
+        self.count = int(count)
+        self.replica_id = replica_id
+        self.fired = 0
+
+    def __call__(self, replica_id: int, record) -> None:
+        if self.replica_id is not None and replica_id != self.replica_id:
+            return
+        if self.fired >= self.count:
+            return
+        self.fired += 1
+        raise ConnectionError(
+            f"injected dispatch drop {self.fired}/{self.count} "
+            f"(replica {replica_id}, request "
+            f"{record.get('request_id')!r})")
 
 
 @contextlib.contextmanager

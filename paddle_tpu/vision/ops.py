@@ -69,9 +69,25 @@ def _box_batch_index(boxes_num, total):
                        jnp.int32)
 
 
+_ROI_ALIGN_WARNED = False
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
               sampling_ratio: int = -1, aligned: bool = True):
-    """Mask R-CNN RoIAlign (reference ops.py:1160)."""
+    """Mask R-CNN RoIAlign (reference ops.py:1160).
+
+    ``sampling_ratio=-1`` differs from the reference: the reference
+    picks an *adaptive* grid of ``ceil(roi_size / pooled_size)``
+    samples per bin per box, which is a data-dependent shape — so this
+    TPU-first version fixes the grid at **2×2 samples per bin** (the
+    value detection configs overwhelmingly use, and exact whenever the
+    RoI is no larger than ~2× the pooled output).  RoIs much larger
+    than ``2 * output_size`` feature pixels are under-sampled relative
+    to the reference — bins average 4 taps where the reference would
+    take more — which slightly blurs very large proposals.  Pass an
+    explicit ``sampling_ratio`` to match the reference exactly for a
+    known box-size regime; a one-time ``RuntimeWarning`` fires when
+    concrete boxes exceed the 2× regime."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
@@ -80,6 +96,23 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     img_idx = _box_batch_index(boxes_num, boxes.shape[0])
     sr = sampling_ratio if sampling_ratio > 0 else 2
     off = 0.5 if aligned else 0.0
+    global _ROI_ALIGN_WARNED
+    if (sampling_ratio <= 0 and not _ROI_ALIGN_WARNED
+            and not isinstance(boxes, jax.core.Tracer)):
+        b = np.asarray(boxes)
+        if b.size and (np.any((b[:, 2] - b[:, 0]) * spatial_scale
+                              > 2.0 * pw)
+                       or np.any((b[:, 3] - b[:, 1]) * spatial_scale
+                                 > 2.0 * ph)):
+            _ROI_ALIGN_WARNED = True
+            import warnings
+            warnings.warn(
+                "roi_align(sampling_ratio=-1) uses a fixed 2x2 "
+                "sample grid per bin (static shapes for TPU); at "
+                "least one RoI exceeds 2x the pooled output size and "
+                "will be under-sampled vs the reference's adaptive "
+                "grid — pass an explicit sampling_ratio to match",
+                RuntimeWarning, stacklevel=2)
 
     def one_box(feat, box):
         x1, y1, x2, y2 = (box * spatial_scale) - off

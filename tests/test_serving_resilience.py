@@ -156,10 +156,10 @@ class TestQuarantine:
         for i in (0, 1, 3):
             assert outs[i] == clean[i], (i, outs[i], clean[i])
         # durable record
-        files = os.listdir(tmp_path / "serve_quarantine")
+        qdir = tmp_path / "serve" / "replica-0" / "quarantine"
+        files = os.listdir(qdir)
         assert len(files) == 1
-        rec = json.loads((tmp_path / "serve_quarantine" /
-                          files[0]).read_text())
+        rec = json.loads((qdir / files[0]).read_text())
         assert rec["request_id"] == bad
         assert rec["reason"] == "poisoned"
         assert rec["step_kind"] == "decode"
@@ -355,6 +355,37 @@ class TestDrainResume:
         eng = make_engine(max_seqs=2, kv_block_size=4)
         with pytest.raises(Exception, match="version"):
             eng.resume(str(spill))
+
+    def test_spill_lands_in_replica_namespace(self, tmp_path):
+        # ISSUE 16: per-replica artifact namespacing — default spill
+        # path is <run_dir>/serve/replica-<i>/spill.json
+        eng = make_engine(max_seqs=2, kv_block_size=4,
+                          run_dir=str(tmp_path), replica_id=3)
+        eng.submit([1, 2, 3], max_new_tokens=20)
+        eng.step()
+        report = eng.drain(timeout=0.0)
+        assert report["spilled"] == 1
+        assert report["spill_path"] == str(
+            tmp_path / "serve" / "replica-3" / "spill.json")
+        assert report["spilled_records"][0]["request_id"] \
+            == eng._submit_order[0]
+
+    def test_resume_reads_legacy_spill_path(self, tmp_path):
+        # pre-ISSUE-16 run dirs keep <run_dir>/serve_spill.json — a
+        # fresh engine with only run_dir must still find and resume it
+        model = tiny_model()
+        want = dense_continuation(model, [1, 2, 3], 6)
+        eng = make_engine(model, max_seqs=2, kv_block_size=4)
+        eng.submit([1, 2, 3], max_new_tokens=6, request_id="legacy")
+        eng.step(); eng.step()
+        legacy = tmp_path / "serve_spill.json"
+        eng.drain(timeout=0.0, spill_path=str(legacy))
+        assert legacy.exists()
+        fresh = make_engine(model, max_seqs=2, kv_block_size=4,
+                            run_dir=str(tmp_path))
+        assert fresh.resume() == ["legacy"]
+        fresh.run(max_steps=200)
+        assert fresh.collect("legacy")["tokens"] == want
 
 
 # ---------------------------------------------------------------------------

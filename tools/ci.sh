@@ -173,6 +173,18 @@ assert sz["serving"]["resilience"]["state"] == "stopped", sz["serving"]
 engine.stop()
 print("drain smoke: healthz 503 draining -> clean stop, 4 streams finished")
 PYEOF
+    # fleet tier (ISSUE 16): router dispatch/affinity/admission units,
+    # journal-replay failover token-exactness, and the multi-process
+    # drills — one replica SIGKILLed mid-stream (every client must
+    # complete token-exact vs an uninterrupted single-engine reference,
+    # fleet.failovers >= 1, survivor allocators clean) and a rolling
+    # upgrade (drain each replica in turn under load, zero drops)
+    python -m pytest -q -m serving tests/test_serve_fleet.py
+    JAX_PLATFORMS=cpu python examples/serve_fleet.py --sigkill_drill
+    JAX_PLATFORMS=cpu python examples/serve_fleet.py --rolling_upgrade
+    # serve_fleet smoke row into the ledger (advisory gate on first rows)
+    JAX_PLATFORMS=cpu python -m paddle_tpu.bench \
+        --scenario serve_fleet --smoke
     # kernels tier (ISSUE 7): Pallas/fused-op parity — flash attention,
     # fused block (both routes), fused CE, rope cache
     python -m pytest -q -m kernels tests/test_ops.py tests/test_fused_block.py
@@ -435,7 +447,8 @@ PYEOF
     python -m pytest -q -m slow tests/test_compile_cache.py
     echo "api-guard + ptlint + faults tier + telemetry tier + doctor" \
          "smoke + monitor smoke + serving tier + serve smoke + serve" \
-         "chaos drill + drain smoke + kernels tier + fused-block smoke" \
+         "chaos drill + drain smoke + fleet tier + fleet drills +" \
+         "kernels tier + fused-block smoke" \
          "+ comm tier + comm smoke + elastic tier + elastic smoke +" \
          "integrity tier + integrity smoke + integrity overhead +" \
          "bench smoke + perf tier + trends + dashboard + warm-start ok"
