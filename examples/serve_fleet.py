@@ -1,6 +1,7 @@
-"""Serving-fleet drills (ISSUE 16): a 2-replica fleet behind the
-router, killed and upgraded under load, with token-exactness proved
-against an uninterrupted single-engine reference.
+"""Serving-fleet drills (ISSUE 16 + 17): a replica fleet behind the
+router, killed, upgraded, crashed and autoscaled under load, with
+token-exactness proved against an uninterrupted single-engine
+reference.
 
     python examples/serve_fleet.py --sigkill_drill
         spawn 2 engine workers, push 6 concurrent streams, SIGKILL one
@@ -15,12 +16,30 @@ against an uninterrupted single-engine reference.
         it — zero dropped or truncated streams, and /statusz's fleet
         census shows every replica healthy again at the end.
 
-Both drills print one JSON line of evidence and exit nonzero on any
+    python examples/serve_fleet.py --router_crash_drill
+        ISSUE 17 crash-safety acceptance: a child process runs a
+        journaling router over 6 ragged streams, the parent SIGKILLs
+        the *router* mid-stream (the workers survive as orphans), and
+        a fresh ``Router(recover=run_dir)`` built from the journal
+        directory alone must finish every stream token-identical to
+        the reference — with zero replica restarts and no live
+        journal files left behind.
+
+    python examples/serve_fleet.py --autoscale_drill
+        ISSUE 17 autoscaler acceptance, on fake time: a queue burst
+        must scale the fleet up, continued burn at the ceiling must
+        record ``blocked_at_max``, and a fully idle window must drain
+        + retire back down — every transition a ``fleet.autoscale``
+        record, and the burst's streams still token-exact.
+
+All drills print one JSON line of evidence and exit nonzero on any
 violated invariant, so ci.sh can run them as smokes.
 """
 import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -28,7 +47,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import paddle_tpu as pt
 from paddle_tpu.inference import ServingEngine
-from paddle_tpu.inference.fleet import ReplicaManager, Router
+from paddle_tpu.inference.fleet import (FleetAutoscaler, HttpReplica,
+                                        LocalReplicaManager, ReplicaManager,
+                                        Router, ServingSLO)
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
 from paddle_tpu.observability.monitor import StatusServer
 from paddle_tpu.observability.registry import MetricsRegistry
@@ -52,11 +73,13 @@ def reference_outputs(max_new):
     return ref.generate(PROMPTS, max_new_tokens=max_new)
 
 
-def start_fleet(run_dir):
+def start_fleet(run_dir, journal=False):
     reg = MetricsRegistry()
     mgr = ReplicaManager(SPEC, replicas=2, registry=reg, run_dir=run_dir)
     mgr.start()
-    return reg, mgr, Router(mgr.replicas, manager=mgr, registry=reg)
+    router = Router(mgr.replicas, manager=mgr, registry=reg,
+                    run_dir=run_dir if journal else None)
+    return reg, mgr, router
 
 
 def sigkill_drill(run_dir):
@@ -124,19 +147,209 @@ def rolling_upgrade(run_dir):
         mgr.stop()
 
 
+_READY_FILE = "crash_child_ready.json"
+_RAGGED_MAX_NEW = [40 + 4 * i for i in range(len(PROMPTS))]
+
+
+def _crash_child(run_dir):
+    """The victim: a journaling router that admits 6 ragged streams,
+    pumps until every journal holds accepted tokens, then parks and
+    waits for the parent's SIGKILL.  No cleanup — that is the point."""
+    reg, mgr, router = start_fleet(run_dir, journal=True)
+    rids = [router.submit(p, max_new_tokens=_RAGGED_MAX_NEW[i])
+            for i, p in enumerate(PROMPTS)]
+    deadline = time.monotonic() + 120
+    while (any(len(j.tokens) < 2 for j in router.journals.values())
+           and time.monotonic() < deadline):
+        router.pump()
+        time.sleep(0.01)
+    assert all(len(j.tokens) >= 2 for j in router.journals.values()), \
+        "streams never accepted tokens"
+    ready = {"streams": [{"request_id": r, "max_new": _RAGGED_MAX_NEW[i]}
+                         for i, r in enumerate(rids)],
+             "workers": [{"replica": i, "port": rep.port,
+                          "pid": rep.process.pid}
+                         for i, rep in enumerate(mgr.replicas)]}
+    path = os.path.join(run_dir, _READY_FILE)
+    with open(path + ".tmp", "w") as f:
+        json.dump(ready, f)
+    os.replace(path + ".tmp", path)     # atomic: parent sees all or nothing
+    while True:                          # hold streams mid-flight
+        time.sleep(1)
+
+
+def _reap_workers(workers):
+    """Shut down the orphaned worker processes the drill left behind."""
+    for w in workers:
+        HttpReplica(w["replica"], w["port"]).stop()
+    deadline = time.monotonic() + 15
+    for w in workers:
+        while time.monotonic() < deadline:
+            try:
+                os.kill(w["pid"], 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.05)
+        else:
+            try:
+                os.kill(w["pid"], signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+
+def router_crash_drill(run_dir):
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--_crash_child", run_dir],
+        stdout=subprocess.DEVNULL)
+    ready_path = os.path.join(run_dir, _READY_FILE)
+    info = None
+    try:
+        deadline = time.monotonic() + 300
+        while not os.path.exists(ready_path):
+            assert child.poll() is None, \
+                f"router child died before ready (rc={child.returncode})"
+            assert time.monotonic() < deadline, "router child never ready"
+            time.sleep(0.02)
+        with open(ready_path) as f:
+            info = json.load(f)
+        # SIGKILL the router — no atexit, no drain, no journal flush
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=10)
+        for w in info["workers"]:        # workers must have survived
+            os.kill(w["pid"], 0)
+        reg = MetricsRegistry()
+        replicas = [HttpReplica(w["replica"], w["port"])
+                    for w in info["workers"]]
+        router = Router(replicas, registry=reg, recover=run_dir)
+        rec = dict(router.recovered)
+        assert rec["streams"] == len(info["streams"]), rec
+        assert rec["reattached"] + rec["redispatched"] >= 1, rec
+        outs = [router.collect(s["request_id"], timeout=120)
+                for s in info["streams"]]
+        ref = reference_outputs(max(_RAGGED_MAX_NEW))
+        exact = sum(o["tokens"] == ref[i][: s["max_new"]]
+                    for i, (s, o) in enumerate(zip(info["streams"], outs)))
+        assert exact == len(PROMPTS), \
+            f"only {exact}/{len(PROMPTS)} recovered streams token-exact"
+        leaked = 0
+        for w, replica in zip(info["workers"], replicas):
+            os.kill(w["pid"], 0)         # original pid: never restarted
+            leaked += replica.serving_stats()["kv_blocks"]["leaked"]
+        assert leaked == 0, f"{leaked} KV blocks leaked across the crash"
+        assert router.store.live_count() == 0, \
+            "live journal files left after every stream finished"
+        print(json.dumps({
+            "drill": "router_crash", "streams": len(PROMPTS),
+            "token_exact": exact, "recovered": rec,
+            "worker_restarts": 0, "leaked_blocks": leaked,
+            "journal_live": router.store.live_count(),
+            "journal_drops": dict(router.store.drops)}))
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+        if info is not None:
+            _reap_workers(info["workers"])
+
+
+def autoscale_drill(run_dir):
+    max_new = 8
+    reg = MetricsRegistry()
+    records = []
+
+    class _Capture:
+        def write(self, r):
+            records.append(r)
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    reg.add_sink(_Capture())
+
+    def factory(i):
+        pt.seed(SPEC["seed"])
+        model = GPTForCausalLM(GPTConfig(**SPEC["config"]))
+        model.eval()
+        return ServingEngine(model, max_seqs=4, registry=reg)
+
+    clk = {"t": 0.0}
+    mgr = LocalReplicaManager(factory, replicas=1, registry=reg)
+    router = Router(mgr.replicas, manager=mgr, registry=reg)
+    scaler = FleetAutoscaler(
+        mgr, router=router, slo=ServingSLO(queue_depth=2.0),
+        min_replicas=1, max_replicas=2, window_secs=10.0,
+        cooldown_secs=5.0, registry=reg, clock=lambda: clk["t"])
+
+    def tick_until(action, limit=60):
+        for _ in range(limit):
+            clk["t"] += 1.0
+            if scaler.step() == action:
+                return
+        raise AssertionError(f"autoscaler never chose {action!r}: "
+                             f"{scaler.stats()}")
+
+    # burst: 6 streams against 1 replica — queue SLO burns -> scale up
+    rids = [router.submit(p, max_new_tokens=max_new) for p in PROMPTS]
+    tick_until("up")
+    assert len(scaler.active_ids()) == 2, mgr.poll_states()
+    # still burning at the ceiling -> the page-worthy record, not a spawn
+    tick_until("blocked_at_max")
+    assert len(scaler.active_ids()) == 2, mgr.poll_states()
+    # drain the burst; a fully idle window -> drain + retire back down
+    router.run(timeout=120)
+    tick_until("down")
+    states = mgr.poll_states()
+    assert sum(1 for s in states.values() if s == "retired") == 1, states
+    assert len(scaler.active_ids()) == 1, states
+    outs = [router.collect(r, timeout=10) for r in rids]
+    ref = reference_outputs(max_new)
+    exact = sum(o["tokens"] == ref[i] for i, o in enumerate(outs))
+    assert exact == len(PROMPTS), \
+        f"only {exact}/{len(PROMPTS)} streams token-exact across scaling"
+    scale_records = [r for r in records if r["kind"] == "fleet.autoscale"]
+    actions = [r["action"] for r in scale_records]
+    for want in ("up", "blocked_at_max", "down"):
+        assert want in actions, f"no fleet.autoscale {want!r}: {actions}"
+    for r in scale_records:              # the timeline schema operators page on
+        for field in ("action", "replicas", "target", "burn", "idle",
+                      "why", "slo"):
+            assert field in r, (field, r)
+    print(json.dumps({
+        "drill": "autoscale", "streams": len(PROMPTS),
+        "token_exact": exact, "actions": actions,
+        "active_end": len(scaler.active_ids()),
+        "scaler": scaler.stats()["actions"]}))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sigkill_drill", action="store_true")
     ap.add_argument("--rolling_upgrade", action="store_true")
+    ap.add_argument("--router_crash_drill", action="store_true")
+    ap.add_argument("--autoscale_drill", action="store_true")
+    ap.add_argument("--_crash_child", metavar="RUN_DIR", default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args._crash_child:
+        _crash_child(args._crash_child)
+        return
     import tempfile
     with tempfile.TemporaryDirectory() as run_dir:
         if args.sigkill_drill:
             sigkill_drill(run_dir)
         elif args.rolling_upgrade:
             rolling_upgrade(run_dir)
+        elif args.router_crash_drill:
+            router_crash_drill(run_dir)
+        elif args.autoscale_drill:
+            autoscale_drill(run_dir)
         else:
-            ap.error("pick --sigkill_drill or --rolling_upgrade")
+            ap.error("pick --sigkill_drill, --rolling_upgrade, "
+                     "--router_crash_drill or --autoscale_drill")
 
 
 if __name__ == "__main__":

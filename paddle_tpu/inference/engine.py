@@ -83,7 +83,8 @@ import queue
 import re
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -112,6 +113,15 @@ DRAIN_SECS_ENV = "PTPU_SERVE_DRAIN_SECS"
 
 _PAD_SEQ = "__pad__"          # never a real request id
 _CB_STOP = object()           # callback-thread shutdown sentinel
+
+
+def _pctl(values, p: float) -> Optional[float]:
+    """Nearest-rank percentile over a small sample; None when empty."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(len(ordered) * p / 100.0))
+    return float(ordered[idx])
 
 
 def default_max_seqs() -> int:
@@ -254,6 +264,11 @@ class ServingEngine:
         self._cb_dispatched = 0
         self._cb_errors = 0
         self._last_callback_error: Optional[str] = None
+        # engine-local latency tails for the stats() "slo" section —
+        # per-replica, unlike the (possibly fleet-shared) registry
+        # histograms, so the autoscaler sees THIS engine's p99
+        self._ttft_ms: Deque[float] = deque(maxlen=512)
+        self._tpot_ms: Deque[float] = deque(maxlen=512)
 
     # -- plumbing ----------------------------------------------------------
     def serve_dir(self) -> Optional[str]:
@@ -686,11 +701,13 @@ class ServingEngine:
         reg = self._reg()
         if first:
             seq.first_token_time = now
-            reg.histogram("serve.ttft_ms").observe(
-                (now - seq.arrival) * 1e3)
+            ttft = (now - seq.arrival) * 1e3
+            reg.histogram("serve.ttft_ms").observe(ttft)
+            self._ttft_ms.append(ttft)
         elif seq.last_token_time is not None:
-            reg.histogram("serve.tpot_ms").observe(
-                (now - seq.last_token_time) * 1e3)
+            tpot = (now - seq.last_token_time) * 1e3
+            reg.histogram("serve.tpot_ms").observe(tpot)
+            self._tpot_ms.append(tpot)
         seq.last_token_time = now
         reg.counter("serve.tokens").inc()
         if seq.capture_logits:
@@ -916,10 +933,22 @@ class ServingEngine:
         its newest token becomes ``pending``, so the recompute-prefill
         path rebuilds the KV and decoding continues **token-exact** —
         the seam both :meth:`resume` and the fleet router's failover
-        re-submission go through.  Returns the request id."""
+        re-submission go through.  Returns the request id.
+
+        Idempotent on ``request_id``: a record the engine already holds
+        (running, waiting or finished) is NOT re-admitted — the router's
+        crash recovery may race a re-dispatch against a replica that
+        still owns the stream, and a duplicate sequence would double-
+        schedule it."""
         enforce(self._state == "serving",
                 f"admit_record() needs a serving engine "
                 f"(state={self._state})")
+        rid = record["request_id"]
+        if rid in self.sched.finished or any(
+                s.request_id == rid for s in
+                list(self.sched.running) + list(self.sched.waiting)):
+            self._reg().counter("serve.readmit_dupes").inc()
+            return rid
         seq = SequenceState(
             request_id=record["request_id"],
             prompt=[int(t) for t in record["prompt"]],
@@ -1000,6 +1029,12 @@ class ServingEngine:
                           "balanced": leak["balanced"]},
             "load_shed": {"active": self.should_shed(),
                           "queue_threshold": self.shed_queue_depth},
+            "slo": {"ttft_ms": {"p50": _pctl(self._ttft_ms, 50),
+                                "p99": _pctl(self._ttft_ms, 99),
+                                "samples": len(self._ttft_ms)},
+                    "tpot_ms": {"p50": _pctl(self._tpot_ms, 50),
+                                "p99": _pctl(self._tpot_ms, 99),
+                                "samples": len(self._tpot_ms)}},
             "resilience": {
                 "state": self._state,
                 "deadline_misses": self.lifecycle_counts["deadline"],

@@ -26,11 +26,17 @@ The protocol (all a router needs):
 fresh heartbeat) → ``draining`` (503 draining) → ``dead`` (process
 exited or heartbeat older than ``PTPU_FLEET_HEARTBEAT_SECS``), mirrors
 the census into ``fleet.replicas[state=...]`` gauges, and can
-``restart()`` a slot — the rolling-upgrade primitive.
+``restart()`` a slot — the rolling-upgrade primitive.  ISSUE 17 adds
+two overlay states: ``flapping`` (alive but its router-side circuit
+breaker is open — see :mod:`.health`) and ``retired`` (scaled down by
+the :mod:`.autoscaler`; the slot stays in the list so replica ids
+stay stable), plus :meth:`spawn` / :meth:`retire` — the autoscaler's
+actuators.  :class:`LocalReplicaManager` is the in-process mirror of
+that protocol for deterministic drills.
 
 Env knobs: ``PTPU_FLEET_REPLICAS``, ``PTPU_FLEET_PORT_BASE``,
-``PTPU_FLEET_HEARTBEAT_SECS`` (see docs/ARCHITECTURE.md "Serving
-fleet").
+``PTPU_FLEET_HEARTBEAT_SECS``, ``PTPU_FLEET_DRAIN_SLACK_SECS`` (see
+docs/ARCHITECTURE.md "Serving fleet").
 """
 from __future__ import annotations
 
@@ -47,13 +53,15 @@ from ...framework.errors import enforce
 from ...framework.log import vlog
 
 __all__ = ["REPLICAS_ENV", "PORT_BASE_ENV", "HEARTBEAT_SECS_ENV",
-           "default_replicas", "default_port_base",
-           "default_heartbeat_secs", "LocalReplica", "HttpReplica",
-           "ReplicaManager"]
+           "DRAIN_SLACK_SECS_ENV", "default_replicas",
+           "default_port_base", "default_heartbeat_secs",
+           "default_drain_slack_secs", "LocalReplica", "HttpReplica",
+           "ReplicaManager", "LocalReplicaManager"]
 
 REPLICAS_ENV = "PTPU_FLEET_REPLICAS"
 PORT_BASE_ENV = "PTPU_FLEET_PORT_BASE"
 HEARTBEAT_SECS_ENV = "PTPU_FLEET_HEARTBEAT_SECS"
+DRAIN_SLACK_SECS_ENV = "PTPU_FLEET_DRAIN_SLACK_SECS"
 
 
 def default_replicas() -> int:
@@ -68,6 +76,12 @@ def default_port_base() -> int:
 
 def default_heartbeat_secs() -> float:
     return float(os.environ.get(HEARTBEAT_SECS_ENV, "10"))
+
+
+def default_drain_slack_secs() -> float:
+    """HTTP-read margin over the engine-side drain budget (the worker
+    finishes/spills *inside* the /drain call)."""
+    return float(os.environ.get(DRAIN_SLACK_SECS_ENV, "30"))
 
 
 class LocalReplica:
@@ -216,10 +230,8 @@ class HttpReplica:
             return False
 
     def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
-        # the worker finishes/spills inside this call — give the HTTP
-        # read a margin over the engine-side budget
         http_timeout = (self.timeout if timeout is None
-                        else float(timeout) + 30.0)
+                        else float(timeout) + default_drain_slack_secs())
         return self._call("/drain", {"timeout": timeout},
                           timeout=http_timeout)
 
@@ -271,6 +283,8 @@ class ReplicaManager:
         self.states: Dict[int, str] = {}
         self._last_beat: Dict[int, float] = {}
         self.restarts = 0
+        self._flapping: set = set()    # router-marked (breaker open)
+        self._retired: set = set()     # autoscaler-marked (slot stable)
 
     def _reg(self):
         if self._registry is not None:
@@ -332,12 +346,60 @@ class ReplicaManager:
             old.process.wait(timeout=10)
         self.replicas[idx] = self._spawn(idx)
         self.restarts += 1
+        self._flapping.discard(idx)   # fresh worker, fresh record
+        self._retired.discard(idx)
         self._reg().counter("fleet.restarts").inc()
         self.poll_states()
         return self.replicas[idx]
 
+    # -- autoscaler actuators (ISSUE 17) -----------------------------------
+    def spawn(self) -> HttpReplica:
+        """Scale up: add one fresh worker slot at the end of the list
+        (replica ids are stable — slots are never renumbered).  A
+        retired slot is reused before the list grows."""
+        for idx in sorted(self._retired):
+            return self.restart(idx)
+        idx = len(self.replicas)
+        self.replicas.append(self._spawn(idx))
+        self.num_replicas = len(self.replicas)
+        self.poll_states()
+        return self.replicas[idx]
+
+    def retire(self, idx: int) -> None:
+        """Scale down: stop slot ``idx`` and mark it ``retired`` *in
+        place* — the list keeps its shape so every other replica id
+        (and every router journal naming one) stays valid.  Drain
+        first (``router.drain_replica``) — retire only stops."""
+        replica = self.replicas[idx]
+        replica.stop()
+        proc = replica.process
+        if proc is not None:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        self._retired.add(idx)
+        self._flapping.discard(idx)
+        self.states[idx] = "retired"
+        self._reg().emit("fleet.replica_state", replica=idx,
+                         prev="draining", state="retired")
+        self.update_gauges()
+
+    # -- flap overlay (ISSUE 17) -------------------------------------------
+    def set_flapping(self, idx: int, flapping: bool) -> None:
+        """Router-side breaker verdict for slot ``idx``; reflected as
+        the ``flapping`` census state while the probe says healthy."""
+        if flapping:
+            self._flapping.add(idx)
+        else:
+            self._flapping.discard(idx)
+        self.poll_states()
+
     # -- monitoring --------------------------------------------------------
     def _probe(self, idx: int, replica: HttpReplica) -> str:
+        if idx in self._retired:
+            return "retired"
         proc = replica.process
         if proc is not None and proc.poll() is not None:
             return "dead"
@@ -350,7 +412,7 @@ class ReplicaManager:
                 return "dead"
             return self.states.get(idx, "starting")
         if code == 200:
-            return "healthy"
+            return "flapping" if idx in self._flapping else "healthy"
         if str(state).startswith(("draining", "stopped")):
             return "draining"
         if str(state).startswith("load-shed"):
@@ -373,8 +435,8 @@ class ReplicaManager:
 
     def update_gauges(self) -> None:
         reg = self._reg()
-        counts = {s: 0 for s in ("starting", "healthy", "draining",
-                                 "dead")}
+        counts = {s: 0 for s in ("starting", "healthy", "flapping",
+                                 "draining", "dead", "retired")}
         for s in self.states.values():
             counts[s] = counts.get(s, 0) + 1
         for state, n in counts.items():
@@ -402,6 +464,105 @@ class ReplicaManager:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=10)
+        for idx in range(len(self.replicas)):
+            self.states[idx] = "dead"
+        self.update_gauges()
+
+
+class LocalReplicaManager:
+    """In-process fleet manager: :class:`LocalReplica` slots behind the
+    same census / spawn / retire / flap protocol as
+    :class:`ReplicaManager`, so routers, drills and the autoscaler run
+    deterministically in one process (no subprocess nondeterminism).
+
+    ``engine_factory(replica_id)`` builds one ServingEngine per slot —
+    the caller seeds them identically when token-exactness matters."""
+
+    def __init__(self, engine_factory, *, replicas: int = 2,
+                 registry=None):
+        enforce(replicas >= 1, "fleet needs >= 1 replica")
+        self.engine_factory = engine_factory
+        self._registry = registry
+        self.replicas: List[LocalReplica] = [
+            LocalReplica(engine_factory(i), replica_id=i)
+            for i in range(replicas)]
+        self.num_replicas = len(self.replicas)
+        self.states: Dict[int, str] = {}
+        self.restarts = 0
+        self._flapping: set = set()
+        self._retired: set = set()
+        self.poll_states()
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ...observability.registry import get_registry
+        return get_registry()
+
+    def _probe(self, idx: int, replica: LocalReplica) -> str:
+        if idx in self._retired:
+            return "retired"
+        if not replica.alive():
+            return "dead"
+        code, state = replica.healthz()
+        if code == 200:
+            return "flapping" if idx in self._flapping else "healthy"
+        if str(state).startswith(("draining", "stopped")):
+            return "draining"
+        return "healthy"
+
+    def poll_states(self) -> Dict[int, str]:
+        for idx, replica in enumerate(self.replicas):
+            new = self._probe(idx, replica)
+            old = self.states.get(idx)
+            if new != old:
+                self._reg().emit("fleet.replica_state", replica=idx,
+                                 prev=old, state=new)
+            self.states[idx] = new
+        self.update_gauges()
+        return dict(self.states)
+
+    update_gauges = ReplicaManager.update_gauges
+    set_flapping = ReplicaManager.set_flapping
+
+    def restart(self, idx: int) -> LocalReplica:
+        old = self.replicas[idx]
+        if old.alive():
+            old.stop()
+        self.replicas[idx] = LocalReplica(self.engine_factory(idx),
+                                          replica_id=idx)
+        self.restarts += 1
+        self._flapping.discard(idx)
+        self._retired.discard(idx)
+        self._reg().counter("fleet.restarts").inc()
+        self.poll_states()
+        return self.replicas[idx]
+
+    def spawn(self) -> LocalReplica:
+        for idx in sorted(self._retired):
+            return self.restart(idx)
+        idx = len(self.replicas)
+        self.replicas.append(LocalReplica(self.engine_factory(idx),
+                                          replica_id=idx))
+        self.num_replicas = len(self.replicas)
+        self.poll_states()
+        return self.replicas[idx]
+
+    def retire(self, idx: int) -> None:
+        replica = self.replicas[idx]
+        if replica.alive():
+            replica.stop()
+        self._retired.add(idx)
+        self._flapping.discard(idx)
+        self.states[idx] = "retired"
+        self._reg().emit("fleet.replica_state", replica=idx,
+                         prev="draining", state="retired")
+        self.update_gauges()
+
+    def stop(self) -> None:
+        for replica in self.replicas:
+            if replica.alive():
+                replica.stop()
         for idx in range(len(self.replicas)):
             self.states[idx] = "dead"
         self.update_gauges()

@@ -4,7 +4,7 @@ The router is the layer the ROADMAP's "millions of users" tier needs
 above the single-host ServingEngine: it owns a set of replicas (any
 mix of :class:`..replica.LocalReplica` / ``HttpReplica``) and gives
 clients one durable stream per request, surviving replica death,
-clean drains, and rolling upgrades with zero client-visible drops.
+clean drains, rolling upgrades — and, since ISSUE 17, its *own* death.
 
 Mechanics:
 
@@ -29,13 +29,30 @@ Mechanics:
   decoding continues **token-exact** — the same seam ``resume()``
   uses.  A replica that drains cleanly hands its ``spilled_records``
   to the router, which migrates them identically.
+- **Crash-safe journal** (ISSUE 17) — with a ``run_dir``, every
+  journal mutation is written ahead to ``<run_dir>/fleet/journal/``
+  through the fsync'd :class:`.journal.JournalStore`.
+  ``Router(recover=run_dir)`` rebuilds every stream from the
+  directory alone: streams a live replica still owns are
+  *re-attached* (polling resumes at the journaled offset); orphans
+  are *re-dispatched* through ``admit_record`` — either way the
+  client's tokens stay exact across a router SIGKILL with zero
+  replica restarts.
+- **Flap resistance** (ISSUE 17) — a per-replica
+  :class:`.health.CircuitBreaker` turns intermittent transport
+  failures into a ``flapping`` census state (excluded from dispatch,
+  probed after backoff) instead of failover churn, and every retry /
+  failover re-dispatch spends the process-wide
+  :class:`.health.RetryBudget`; a dry bucket degrades new work to
+  load-shed and defers failovers to the next pump — no retry storms.
 - **Rolling upgrade** — :meth:`rolling_upgrade` drains one replica at
   a time (migrating its spill), lets the manager respawn it, waits
   healthy, and moves on; in-flight streams never drop.
 
 Counters: ``fleet.dispatch``, ``fleet.retries``, ``fleet.failovers``,
-``fleet.migrations``, ``fleet.shed``; gauges ``fleet.streams`` and
-the manager's ``fleet.replicas[state=...]`` census.
+``fleet.migrations``, ``fleet.shed``, ``fleet.deferred``,
+``fleet.recovered``; gauges ``fleet.streams`` and the manager's
+``fleet.replicas[state=...]`` census (now including ``flapping``).
 """
 from __future__ import annotations
 
@@ -45,6 +62,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ...framework.errors import enforce
 from ...framework.log import vlog
+from .health import CircuitBreaker, get_retry_budget
+from .journal import JournalStore
 
 __all__ = ["RETRY_MAX_ENV", "RETRY_BACKOFF_MS_ENV",
            "SHED_QUEUE_DEPTH_ENV", "default_retry_max",
@@ -71,7 +90,8 @@ def default_shed_queue_depth() -> int:
 
 class FleetOverloaded(RuntimeError):
     """Fleet-level admission refusal (every replica is past the shed
-    threshold, or the aggregate queue is) — the client's 429."""
+    threshold, the aggregate queue is, or the retry budget is dry) —
+    the client's 429."""
 
 
 class DispatchExhausted(RuntimeError):
@@ -114,12 +134,23 @@ class Router:
     ``replicas`` maps replica_id → client.  ``manager`` (optional,
     a :class:`..replica.ReplicaManager`) supplies the subprocess
     census for ``poll_states``-driven liveness; without one the
-    router probes ``alive()`` itself (the in-process form)."""
+    router probes ``alive()`` itself (the in-process form).
+
+    ``run_dir`` switches on the crash-safe write-ahead journal;
+    ``recover`` (a run_dir) additionally rebuilds every stream from
+    the journal directory before serving.  ``retry_budget`` overrides
+    the process-wide bucket (tests); ``breaker_kw`` overrides the
+    per-replica breaker knobs (``failures`` / ``window_secs`` /
+    ``backoff_secs`` / ``clock``)."""
 
     def __init__(self, replicas, *, manager=None, registry=None,
                  retry_max: Optional[int] = None,
                  retry_backoff_ms: Optional[float] = None,
                  shed_queue_depth: Optional[int] = None,
+                 run_dir: Optional[str] = None,
+                 recover: Optional[str] = None,
+                 retry_budget=None,
+                 breaker_kw: Optional[Dict[str, Any]] = None,
                  sleep=time.sleep):
         if isinstance(replicas, dict):
             self.replicas = dict(replicas)
@@ -143,6 +174,20 @@ class Router:
         self.dispatch_fault = None   # seam: fn(replica_id, record) pre-send
         self.failovers = 0
         self.migrations = 0
+        # flap resistance (ISSUE 17)
+        self.budget = (retry_budget if retry_budget is not None
+                       else get_retry_budget())
+        self._breaker_kw = dict(breaker_kw or {})
+        self.breakers: Dict[int, CircuitBreaker] = {}
+        # crash-safe journal (ISSUE 17)
+        if recover is not None:
+            run_dir = recover
+        self.store = (JournalStore(run_dir) if run_dir is not None
+                      else None)
+        self.recovered = {"streams": 0, "reattached": 0,
+                          "redispatched": 0, "finished": 0}
+        if recover is not None:
+            self._recover()
 
     def _reg(self):
         if self._registry is not None:
@@ -151,14 +196,53 @@ class Router:
         return get_registry()
 
     # -- replica set -------------------------------------------------------
-    def _healthy_ids(self) -> List[int]:
+    def _available_ids(self) -> List[int]:
+        """Replicas dispatch may consider: healthy, plus flapping ones
+        (their breaker gates per-candidate — the half-open probe must
+        be dispatchable or an open breaker could never close)."""
         if self.manager is not None:
             states = self.manager.poll_states()
             self.replicas = {i: r for i, r
                              in enumerate(self.manager.replicas)}
-            return [i for i, s in states.items() if s == "healthy"]
+            return [i for i, s in states.items()
+                    if s in ("healthy", "flapping")]
         return [i for i, r in self.replicas.items() if r.alive()
                 and r.healthz()[0] == 200]
+
+    def _healthy_ids(self) -> List[int]:   # PR 16 name, kept for callers
+        return self._available_ids()
+
+    def _breaker(self, rid: int) -> CircuitBreaker:
+        br = self.breakers.get(rid)
+        if br is None:
+            def on_transition(prev, new, b, _rid=rid):
+                self._on_breaker(_rid, prev, new, b)
+            br = CircuitBreaker(on_transition=on_transition,
+                                **self._breaker_kw)
+            self.breakers[rid] = br
+        return br
+
+    def _on_breaker(self, rid: int, prev: str, new: str,
+                    breaker: CircuitBreaker) -> None:
+        reg = self._reg()
+        reg.emit("fleet.breaker", replica=rid, prev=prev, state=new,
+                 trips=breaker.trips,
+                 backoff_secs=breaker.current_backoff())
+        flapping = new in ("open", "half_open")
+        if self.manager is not None:
+            self.manager.set_flapping(rid, flapping)
+        else:
+            census = "flapping" if flapping else "healthy"
+            reg.emit("fleet.replica_state", replica=rid,
+                     prev=("healthy" if flapping else "flapping"),
+                     state=census)
+            reg.gauge("fleet.replicas[state=flapping]").set(float(
+                sum(1 for b in self.breakers.values()
+                    if b.state in ("open", "half_open"))))
+        if new == "open":
+            reg.counter("fleet.breaker_trips").inc()
+        vlog(0, "fleet: replica %d breaker %s -> %s (backoff %.1fs)",
+             rid, prev, new, breaker.current_backoff())
 
     def _load(self, replica) -> float:
         """Queue-aware load score from the replica's serving stats;
@@ -184,29 +268,69 @@ class Router:
         return ranked
 
     def fleet_depth(self, healthy: List[int]) -> float:
-        return sum(self._load(self.replicas[i]) for i in healthy)
+        """Aggregate queued work over reachable replicas (an
+        unreachable probe is unknown load, not infinite load — it must
+        not flip admission to shed on one dropped packet)."""
+        loads = [self._load(self.replicas[i]) for i in healthy]
+        return sum(x for x in loads if x != float("inf"))
 
     # -- dispatch ----------------------------------------------------------
-    def _dispatch(self, journal: StreamJournal) -> int:
+    def _dispatch(self, journal: StreamJournal,
+                  fresh: bool = True) -> Optional[int]:
         """Send ``journal``'s record to the best replica, retrying with
-        backoff across the healthy set.  Returns the replica id."""
+        backoff across the healthy set.  The first attempt of a fresh
+        submission is free; every further send spends the retry
+        budget.  Returns the replica id — or, for non-fresh work
+        (failover / recovery re-dispatch), None when dispatch must be
+        deferred to a later pump (budget dry, nowhere to send).
+
+        Fresh submissions fail loudly instead: a dry budget raises
+        :class:`FleetOverloaded` (degrade to load-shed), exhaustion
+        raises :class:`DispatchExhausted`."""
         reg = self._reg()
         tried: List[str] = []
         backoff = self.retry_backoff_ms / 1e3
+        first_free = fresh
         for attempt in range(self.retry_max + 1):
-            healthy = self._healthy_ids()
+            healthy = self._available_ids()
             for rid in self._pick(journal.session, healthy):
                 replica = self.replicas[rid]
+                breaker = self._breaker(rid)
+                if not breaker.allow():
+                    tried.append(f"replica-{rid}: breaker "
+                                 f"{breaker.state}")
+                    continue
+                if first_free:
+                    first_free = False
+                elif not self.budget.try_acquire():
+                    if fresh:
+                        reg.counter("fleet.shed").inc()
+                        reg.emit("fleet.shed", why="retry_budget",
+                                 request_id=journal.request_id)
+                        raise FleetOverloaded(
+                            f"{journal.request_id}: retry budget dry "
+                            f"({self.budget.snapshot()}) — degrading "
+                            f"to load-shed")
+                    reg.counter("fleet.deferred").inc()
+                    reg.emit("fleet.deferred",
+                             request_id=journal.request_id,
+                             why="retry_budget")
+                    return None
                 try:
                     if self.dispatch_fault is not None:
                         self.dispatch_fault(rid, journal.record())
                     replica.submit(journal.record())
                 except ConnectionError as e:
+                    breaker.record_failure()
                     tried.append(f"replica-{rid}: {e}")
                     continue
+                breaker.record_success()
                 journal.replica_id = rid
                 if journal.session is not None:
                     self._sessions[journal.session] = rid
+                if self.store is not None:
+                    self.store._append(journal.request_id,
+                                       {"kind": "disp", "replica": rid})
                 reg.counter("fleet.dispatch").inc()
                 reg.emit("fleet.dispatch", request_id=journal.request_id,
                          replica=rid, attempt=attempt,
@@ -216,6 +340,11 @@ class Router:
                 reg.counter("fleet.retries").inc()
                 self._sleep(backoff)
                 backoff *= 2
+        if not fresh:
+            reg.counter("fleet.deferred").inc()
+            reg.emit("fleet.deferred", request_id=journal.request_id,
+                     why="; ".join(tried[-3:]) or "no replica available")
+            return None
         raise DispatchExhausted(
             f"{journal.request_id}: dispatch failed after "
             f"{self.retry_max + 1} attempts across replicas "
@@ -226,17 +355,24 @@ class Router:
                request_id: Optional[str] = None,
                eos_token_id: Optional[int] = None,
                session: Optional[str] = None) -> str:
-        """Admit one client stream: journal it, then dispatch.  Raises
-        :class:`FleetOverloaded` past the fleet shed threshold."""
-        healthy = self._healthy_ids()
+        """Admit one client stream: journal it (durably, with a
+        ``run_dir``), then dispatch.  Raises :class:`FleetOverloaded`
+        past the fleet shed threshold or on a dry retry budget."""
+        healthy = self._available_ids()
         depth = self.fleet_depth(healthy)
         if not healthy or depth > self.shed_queue_depth:
             self._reg().counter("fleet.shed").inc()
+            self._reg().emit("fleet.shed", why="queue_depth",
+                             depth=depth, healthy=len(healthy))
             raise FleetOverloaded(
                 f"fleet admission closed: {len(healthy)} healthy "
                 f"replicas, aggregate depth {depth:.0f} > "
                 f"{self.shed_queue_depth}")
         if request_id is None:
+            # recovered journals may already hold fleet-N names — the
+            # counter restarts at 0 after a crash, the streams did not
+            while f"fleet-{self._ids}" in self.journals:
+                self._ids += 1
             request_id = f"fleet-{self._ids}"
             self._ids += 1
         enforce(request_id not in self.journals,
@@ -244,10 +380,79 @@ class Router:
         journal = StreamJournal(request_id, prompt, max_new_tokens,
                                 eos_token_id, session=session)
         self.journals[request_id] = journal
+        if self.store is not None:
+            # write-ahead: the stream exists durably before dispatch
+            self.store.open(request_id, journal.prompt, max_new_tokens,
+                            eos_token_id, session=session)
         self._reg().gauge("fleet.streams").set(float(len(
             [j for j in self.journals.values() if not j.finished])))
-        self._dispatch(journal)
+        try:
+            self._dispatch(journal, fresh=True)
+        except (FleetOverloaded, DispatchExhausted):
+            # the client saw a refusal — no ghost stream may linger
+            del self.journals[request_id]
+            if self.store is not None:
+                self.store.discard(request_id)
+            raise
         return request_id
+
+    # -- recovery (ISSUE 17) ----------------------------------------------
+    def _probe_owner(self, journal: StreamJournal,
+                     prefer: Optional[int]) -> Optional[int]:
+        """Find a replica that still owns ``journal`` (router crashed,
+        replicas survived): last-dispatched first, then the rest."""
+        order = [i for i in ([prefer] if prefer is not None else [])
+                 if i in self.replicas]
+        order += [i for i in self._available_ids() if i not in order]
+        for rid in order:
+            try:
+                self.replicas[rid].poll(journal.request_id,
+                                        start=len(journal.tokens))
+            except Exception:   # unknown rid / unreachable — not ours
+                continue
+            return rid
+        return None
+
+    def _recover(self) -> None:
+        """Rebuild every stream from the journal directory: re-attach
+        to a replica that still runs it, or re-dispatch the journal
+        record through the ``admit_record`` recompute-prefill seam."""
+        reg = self._reg()
+        for rec in self.store.recover():
+            rid = rec["request_id"]
+            journal = StreamJournal(rid, rec["prompt"],
+                                    rec["max_new_tokens"],
+                                    rec["eos_token_id"],
+                                    session=rec["session"])
+            journal.tokens = list(rec["tokens"])
+            self.journals[rid] = journal
+            self.recovered["streams"] += 1
+            if rec["finished"]:
+                journal.finished = True
+                journal.reason = rec["reason"]
+                self.recovered["finished"] += 1
+                self.store.retire(rid, rec["reason"])
+                continue
+            owner = self._probe_owner(journal, rec.get("replica"))
+            if owner is not None:
+                journal.replica_id = owner
+                if journal.session is not None:
+                    self._sessions[journal.session] = owner
+                self.recovered["reattached"] += 1
+            else:
+                # orphaned (its replica died with the router): replay
+                # the journal record; None = deferred to pump()
+                if self._dispatch(journal, fresh=False) is not None:
+                    self.recovered["redispatched"] += 1
+        if self.recovered["streams"]:
+            reg.counter("fleet.recovered").inc(self.recovered["streams"])
+        reg.emit("fleet.recover", **self.recovered)
+        self._reg().gauge("fleet.streams").set(float(len(
+            [j for j in self.journals.values() if not j.finished])))
+        vlog(0, "fleet: recovered %d streams (%d reattached, %d "
+             "redispatched, %d already finished)",
+             self.recovered["streams"], self.recovered["reattached"],
+             self.recovered["redispatched"], self.recovered["finished"])
 
     # -- streaming / failover ---------------------------------------------
     def _poll_journal(self, journal: StreamJournal) -> bool:
@@ -258,15 +463,22 @@ class Router:
         out = replica.poll(journal.request_id, start=len(journal.tokens))
         new = [int(t) for t in out["tokens"]]
         if new:
+            if self.store is not None:
+                # write-ahead: tokens are durable before they count
+                self.store.append_tokens(journal.request_id, new)
             journal.tokens.extend(new)
         if out["finished"]:
             journal.finished = True
             journal.reason = out.get("reason")
+            if self.store is not None:
+                self.store.retire(journal.request_id, journal.reason)
         return bool(new) or journal.finished
 
     def _failover(self, journal: StreamJournal, why: str) -> None:
         """Re-home one live stream: re-submit its journal record (the
-        accepted-token tail rides along) to a healthy replica."""
+        accepted-token tail rides along) to a healthy replica.  May
+        leave the stream undispatched (budget/candidate starvation) —
+        the next pump retries."""
         reg = self._reg()
         dead = journal.replica_id
         journal.failovers += 1
@@ -275,12 +487,12 @@ class Router:
         if (journal.session is not None
                 and self._sessions.get(journal.session) == dead):
             del self._sessions[journal.session]
-        rid = self._dispatch(journal)
+        rid = self._dispatch(journal, fresh=False)
         reg.counter("fleet.failovers").inc()
         reg.emit("fleet.failover", request_id=journal.request_id,
                  from_replica=dead, to_replica=rid, why=why,
                  accepted_tokens=len(journal.tokens))
-        vlog(0, "fleet: failover %s replica %s -> %d (%s, %d tokens "
+        vlog(0, "fleet: failover %s replica %s -> %s (%s, %d tokens "
              "accepted)", journal.request_id, dead, rid, why,
              len(journal.tokens))
 
@@ -296,14 +508,26 @@ class Router:
         live = [j for j in self.journals.values() if not j.finished]
         for journal in live:
             if journal.replica_id is None:
-                self._failover(journal, "undispatched")
+                # deferred failover/recovery: quiet budgeted retry
+                self._dispatch(journal, fresh=False)
                 continue
             try:
                 self._poll_journal(journal)
             except ConnectionError as e:
                 replica = self.replicas.get(journal.replica_id)
+                breaker = self._breaker(journal.replica_id)
                 if replica is not None and replica.alive():
-                    raise    # transient — replica is up; surface it
+                    # transient — the replica is up.  Feed the breaker
+                    # instead of raising: enough of these in a window
+                    # and the replica is flapping, and only THEN do its
+                    # streams move (churn costs more than patience).
+                    breaker.record_failure()
+                    if breaker.state == "closed":
+                        continue
+                    self._failover(journal,
+                                   f"replica flapping ({e})")
+                    continue
+                breaker.record_failure()
                 self._failover(journal, f"replica died ({e})")
         remaining = [j for j in self.journals.values() if not j.finished]
         self._reg().gauge("fleet.streams").set(float(len(remaining)))
@@ -347,6 +571,11 @@ class Router:
         streams to the rest of the fleet; returns the migration
         count.  The replica ends ``stopped`` — restart it via the
         manager before re-adding."""
+        if self.manager is not None:
+            # the manager may have spawned slots since construction
+            # (autoscaler scale-up) — refresh before indexing
+            self.replicas = {i: r for i, r
+                             in enumerate(self.manager.replicas)}
         replica = self.replicas[rid]
         report = replica.drain(timeout=timeout)
         migrated = 0
@@ -358,12 +587,16 @@ class Router:
             # trust the engine's record — it may hold tokens a poll
             # never fetched; both prefixes agree (greedy decode)
             if len(rec.get("output", [])) > len(journal.tokens):
-                journal.tokens = [int(t) for t in rec["output"]]
+                ahead = [int(t) for t in
+                         rec["output"][len(journal.tokens):]]
+                if self.store is not None:
+                    self.store.append_tokens(journal.request_id, ahead)
+                journal.tokens.extend(ahead)
             journal.replica_id = None
             if (journal.session is not None
                     and self._sessions.get(journal.session) == rid):
                 del self._sessions[journal.session]
-            self._dispatch(journal)
+            self._dispatch(journal, fresh=True)
             migrated += 1
             self.migrations += 1
             self._reg().counter("fleet.migrations").inc()
@@ -389,10 +622,13 @@ class Router:
                 "rolling_upgrade() needs a ReplicaManager")
         migrated: Dict[int, int] = {}
         for rid in sorted(self.replicas):
+            if self.manager.states.get(rid) in ("dead", "retired"):
+                continue
             migrated[rid] = self.drain_replica(
                 rid, timeout=timeout_per_replica)
             self.manager.restart(rid)
             self.replicas[rid] = self.manager.replicas[rid]
+            self.breakers.pop(rid, None)   # fresh worker, fresh record
             deadline = time.monotonic() + 60.0
             while self.manager.poll_states().get(rid) != "healthy":
                 enforce(time.monotonic() < deadline,
@@ -403,19 +639,41 @@ class Router:
         return migrated
 
     # -- observability ------------------------------------------------------
+    def census(self) -> Dict[int, str]:
+        """Replica states with the ``flapping`` overlay: a replica the
+        base census calls healthy whose breaker is open/half-open is
+        flapping — alive, polled, but not dispatchable."""
+        if self.manager is not None:
+            base = self.manager.poll_states()
+        else:
+            base = {i: ("healthy" if r.alive() else "dead")
+                    for i, r in self.replicas.items()}
+            for i, br in self.breakers.items():
+                if (base.get(i) == "healthy"
+                        and br.state in ("open", "half_open")):
+                    base[i] = "flapping"
+        return base
+
     def stats(self) -> Dict[str, Any]:
         """Fleet snapshot for ``/statusz`` and the doctor."""
         live = [j for j in self.journals.values() if not j.finished]
-        states = (self.manager.poll_states() if self.manager is not None
-                  else {i: ("healthy" if r.alive() else "dead")
-                        for i, r in self.replicas.items()})
+        states = self.census()
         counts: Dict[str, int] = {}
         for s in states.values():
             counts[s] = counts.get(s, 0) + 1
-        return {"replicas": len(self.replicas),
-                "states": counts,
-                "streams": {"live": len(live),
-                            "finished": len(self.journals) - len(live)},
-                "failovers": self.failovers,
-                "migrations": self.migrations,
-                "sessions": len(self._sessions)}
+        out = {"replicas": len(self.replicas),
+               "states": counts,
+               "streams": {"live": len(live),
+                           "finished": len(self.journals) - len(live)},
+               "failovers": self.failovers,
+               "migrations": self.migrations,
+               "sessions": len(self._sessions),
+               "breakers": {i: br.snapshot()
+                            for i, br in sorted(self.breakers.items())},
+               "retry_budget": self.budget.snapshot()}
+        if self.store is not None:
+            out["journal"] = {"live": self.store.live_count(),
+                              "appends": self.store.appends,
+                              "drops": dict(self.store.drops),
+                              "recovered": dict(self.recovered)}
+        return out

@@ -69,7 +69,8 @@ __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_memory", "check_straggler", "check_data_starved",
            "check_comm_bound", "check_supervisor",
            "check_perf_regression", "check_perf_trend", "check_serving",
-           "check_fleet"]
+           "check_fleet", "check_fleet_flapping",
+           "check_fleet_slo_burn"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -688,6 +689,94 @@ def check_fleet(workers) -> List[Dict[str, Any]]:
     return findings
 
 
+def check_fleet_flapping(workers) -> List[Dict[str, Any]]:
+    """Flap verdict (ISSUE 17): ``fleet_flapping`` when a replica's
+    circuit breaker tripped — the replica is alive by census but its
+    transport fails intermittently.  The verdict names each flapping
+    replica with its trip count, and escalates when the retry budget
+    had to shed or defer work (the storm the breaker exists to
+    prevent was actually knocking)."""
+    findings: List[Dict[str, Any]] = []
+    trips: Dict[str, int] = {}
+    reopened: Dict[str, int] = {}
+    budget_sheds = 0
+    deferred = 0
+    for recs in workers.values():
+        for r in recs:
+            k = r.get("kind")
+            if k == "fleet.breaker" and r.get("state") == "open":
+                rep = str(r.get("replica"))
+                trips[rep] = trips.get(rep, 0) + 1
+                if r.get("prev") == "half_open":
+                    reopened[rep] = reopened.get(rep, 0) + 1
+            elif k == "fleet.shed" and r.get("why") == "retry_budget":
+                budget_sheds += 1
+            elif k == "fleet.deferred":
+                deferred += 1
+    if not trips:
+        return findings
+    total = sum(trips.values())
+    ev = [f"replica {rep}: breaker opened {n}× "
+          + (f"({reopened[rep]}× from a failed half-open probe)"
+             if rep in reopened else "(first trip)")
+          for rep, n in sorted(trips.items())]
+    ev.append("flapping ≠ dead: the replica answers /healthz but its "
+              "transport fails intermittently — check its host before "
+              "restarting it")
+    if budget_sheds or deferred:
+        ev.append(f"retry-budget pressure: {budget_sheds} submission(s) "
+                  f"degraded to load-shed, {deferred} failover "
+                  f"re-dispatch(es) deferred — the fleet was absorbing "
+                  f"a retry storm")
+    findings.append(_finding(
+        "fleet_flapping",
+        45 + 5 * min(5, total) + (10 if budget_sheds else 0),
+        f"replica(s) {sorted(trips)} flapping "
+        f"({total} breaker trip(s))",
+        ev, trips=trips, reopened=reopened,
+        budget_sheds=budget_sheds, deferred=deferred))
+    return findings
+
+
+def check_fleet_slo_burn(workers) -> List[Dict[str, Any]]:
+    """Autoscaler verdict (ISSUE 17): ``fleet_slo_burn`` when the SLO
+    burn-rate loop had to act.  Scale-ups that stayed under the
+    ceiling are the system working (low severity, still worth a row —
+    capacity was bought); ``blocked_at_max`` is the one operators page
+    on: the SLO kept burning and the autoscaler had nothing left to
+    give."""
+    findings: List[Dict[str, Any]] = []
+    ups: List[Dict[str, Any]] = []
+    blocked: List[Dict[str, Any]] = []
+    for recs in workers.values():
+        for r in recs:
+            if r.get("kind") != "fleet.autoscale":
+                continue
+            if r.get("action") == "up":
+                ups.append(r)
+            elif r.get("action") == "blocked_at_max":
+                blocked.append(r)
+    if not ups and not blocked:
+        return findings
+    ev = [f"scale-up to {u.get('target')} replicas "
+          f"(burn {u.get('burn')}): {u.get('why')}" for u in ups[:4]]
+    ev += [f"BLOCKED at {b.get('replicas')} replicas "
+           f"(burn {b.get('burn')}): {b.get('why')}"
+           for b in blocked[:4]]
+    if blocked:
+        ev.append("the fleet hit PTPU_FLEET_MAX while the SLO still "
+                  "burned — raise the ceiling or shed earlier")
+    findings.append(_finding(
+        "fleet_slo_burn",
+        (70 + 5 * min(4, len(blocked))) if blocked
+        else 20 + 5 * min(4, len(ups)),
+        (f"SLO burn exhausted the fleet ceiling "
+         f"({len(blocked)} blocked-at-max event(s))") if blocked
+        else f"SLO burn drove {len(ups)} scale-up(s)",
+        ev, scale_ups=len(ups), blocked_at_max=len(blocked)))
+    return findings
+
+
 def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     """Run every check against ``run_dir``; returns the diagnosis dict
     (findings ranked most-severe first) or ``None`` when the run left no
@@ -717,6 +806,8 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_integrity(events)
     findings += check_serving(workers)
     findings += check_fleet(workers)
+    findings += check_fleet_flapping(workers)
+    findings += check_fleet_slo_burn(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

@@ -225,6 +225,10 @@ class StatusServer:
                 "migrations": counter("fleet.migrations"),
                 "shed": counter("fleet.shed"),
                 "restarts": counter("fleet.restarts"),
+                "deferred": counter("fleet.deferred"),
+                "breaker_trips": counter("fleet.breaker_trips"),
+                "autoscale_events": counter("fleet.autoscale"),
+                "recovered": counter("fleet.recovered"),
             }
         if self.router is not None:
             try:
@@ -595,6 +599,8 @@ class LiveAggregator:
         findings += doctor.check_perf_trend(workers)
         findings += doctor.check_serving(workers)
         findings += doctor.check_fleet(workers)
+        findings += doctor.check_fleet_flapping(workers)
+        findings += doctor.check_fleet_slo_burn(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

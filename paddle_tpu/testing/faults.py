@@ -39,14 +39,20 @@ drill polls, so "kill replica 0 once stream X has 3 accepted tokens"
 is deterministic; :class:`drop_dispatch` plugs into
 ``Router.dispatch_fault`` and fails the first N dispatch attempts
 with ``ConnectionError``, driving the retry-with-backoff and
-exhaustion paths without a real network.
+exhaustion paths without a real network; :class:`flaky_replica`
+(ISSUE 17) makes a *live* replica's transport intermittently fail /
+stall — the injector the circuit-breaker and retry-budget drills
+need: the replica stays alive and healthy by census, but a seeded
+fraction of its calls raise ``ConnectionError``.
 """
 from __future__ import annotations
 
 import contextlib
 import glob
 import os
+import random
 import signal as _signal
+import time
 from typing import Callable, List, Optional, Tuple
 
 from ..utils import fsio
@@ -56,7 +62,7 @@ __all__ = ["FaultInjector", "flip_byte", "truncate_file", "corrupt_shard",
            "corrupt_manifest", "fast_retries", "hang", "slow_call",
            "diverge_after", "sigkill_self", "sigkill_at", "bitflip",
            "flip_tree_bit", "poison_request", "expire_clock",
-           "kill_replica", "drop_dispatch"]
+           "kill_replica", "drop_dispatch", "flaky_replica"]
 
 
 def _default_transient() -> OSError:
@@ -533,6 +539,95 @@ class drop_dispatch:
             f"injected dispatch drop {self.fired}/{self.count} "
             f"(replica {replica_id}, request "
             f"{record.get('request_id')!r})")
+
+
+class flaky_replica:
+    """Intermittent transport faults on a LIVE replica (ISSUE 17).
+
+    Unlike :class:`kill_replica`, the replica keeps running and its
+    ``healthz`` stays 200 — only the router-facing transport methods
+    (``submit`` / ``poll`` / ``serving_stats``) are wrapped so that a
+    seeded fraction of calls raise ``ConnectionError`` (``error_rate``)
+    and/or stall (``latency_ms``).  That is exactly the *flapping*
+    regime: the binary census says healthy, yet every few calls storm
+    the retry path — the scenario the circuit breaker + retry budget
+    must absorb.
+
+    ``target`` is a ``ReplicaManager``/``LocalReplicaManager`` plus
+    ``index``, or a replica object directly.  ``when`` (no-arg
+    predicate, like ``kill_replica``) gates injection per call, so
+    "start flaking once stream X has 2 tokens" is deterministic.
+    Restores the original methods on ``stop()`` / context exit.
+
+    >>> with flaky_replica(manager, index=1, error_rate=0.3,
+    ...                    seed=7) as flake:
+    ...     router.run(timeout=30)
+    >>> flake.injected_errors > 0
+    True
+    """
+
+    METHODS = ("submit", "poll", "serving_stats")
+
+    def __init__(self, target, index: Optional[int] = None,
+                 error_rate: float = 0.0, latency_ms: float = 0.0,
+                 seed: int = 0,
+                 when: Optional[Callable[[], bool]] = None,
+                 sleep=time.sleep):
+        if index is not None and hasattr(target, "replicas"):
+            target = target.replicas[index]     # manager slot
+        self.replica = target
+        self.error_rate = float(error_rate)
+        self.latency_ms = float(latency_ms)
+        self.when = when
+        self.rng = random.Random(seed)
+        self._sleep = sleep
+        self.calls = 0
+        self.injected_errors = 0
+        self.injected_delays = 0
+        self._saved: dict = {}
+        self._install()
+
+    _MISSING = object()   # name was class-level, not an instance attr
+
+    def _install(self) -> None:
+        for name in self.METHODS:
+            orig = getattr(self.replica, name)
+            self._saved[name] = self.replica.__dict__.get(
+                name, self._MISSING)
+
+            def wrapper(*a, _orig=orig, _name=name, **kw):
+                return self._intercept(_orig, _name, *a, **kw)
+
+            setattr(self.replica, name, wrapper)
+
+    def _intercept(self, orig, name, *a, **kw):
+        self.calls += 1
+        if self.when is None or self.when():
+            if self.latency_ms > 0:
+                self.injected_delays += 1
+                self._sleep(self.latency_ms / 1e3)
+            if self.rng.random() < self.error_rate:
+                self.injected_errors += 1
+                raise ConnectionError(
+                    f"injected flake #{self.injected_errors} "
+                    f"({name} on replica "
+                    f"{getattr(self.replica, 'replica_id', '?')})")
+        return orig(*a, **kw)
+
+    def stop(self) -> None:
+        """Restore the wrapped transport (idempotent)."""
+        for name, prev in self._saved.items():
+            if prev is self._MISSING:
+                delattr(self.replica, name)   # class method shows again
+            else:
+                setattr(self.replica, name, prev)
+        self._saved = {}
+
+    def __enter__(self) -> "flaky_replica":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,771 @@
+"""Fleet-autonomy tests (ISSUE 17): crash-safe router WAL (unit +
+random crash/recover property), circuit-breaker state machine, retry
+budget, the flaky-replica drill, the SLO autoscaler control loop, and
+the new doctor verdicts.
+"""
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.inference.fleet import (CircuitBreaker, FleetAutoscaler,
+                                        JournalStore, LocalReplica,
+                                        LocalReplicaManager, RetryBudget,
+                                        Router, ServingSLO,
+                                        default_drain_slack_secs,
+                                        get_retry_budget,
+                                        reset_retry_budget)
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability.registry import MetricsRegistry
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.serving
+
+
+def tiny_model(max_pos=64):
+    pt.seed(7)
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=2,
+                    num_heads=2, ffn_hidden_size=64,
+                    max_position_embeddings=max_pos, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def dense_continuation(model, prompt, max_new, eos=None):
+    out = model.generate(jnp.asarray([prompt], jnp.int32),
+                         max_new_tokens=max_new, temperature=0.0,
+                         eos_token_id=eos)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def local_fleet(n=2, registry=None, max_pos=64, **engine_kw):
+    reg = registry or MetricsRegistry()
+    reps = [LocalReplica(ServingEngine(tiny_model(max_pos), registry=reg,
+                                       replica_id=i, **engine_kw),
+                         replica_id=i)
+            for i in range(n)]
+    return reps, reg
+
+
+class CaptureSink:
+    """Registry sink that keeps every emitted record (assertable)."""
+
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def fresh_budget(capacity=64, refill=0.0):
+    return RetryBudget(capacity=capacity, refill_per_s=refill)
+
+
+# ---------------------------------------------------------------------------
+# JournalStore: the WAL itself
+# ---------------------------------------------------------------------------
+class TestJournalStore:
+    def test_wal_roundtrip(self, tmp_path):
+        store = JournalStore(str(tmp_path))
+        store.open("r1", [1, 2, 3], 8, None, session="u1")
+        store.append_tokens("r1", [4, 5])
+        store.append_tokens("r1", [6])
+        [rec] = store.recover()
+        assert rec["request_id"] == "r1"
+        assert rec["prompt"] == [1, 2, 3]
+        assert rec["tokens"] == [4, 5, 6]
+        assert rec["session"] == "u1"
+        assert not rec["finished"]
+        store.retire("r1", "length")
+        assert store.live_count() == 0
+        done = [n for n in os.listdir(store.directory)
+                if n.endswith(".done")]
+        assert len(done) == 1
+        # retired streams still recover — as finished, for the client
+        # that re-asks the recovered router just after completion
+        [rec] = store.recover()
+        assert rec["finished"] and rec["tokens"] == [4, 5, 6]
+
+    def test_torn_tail_dropped_with_accounting(self, tmp_path):
+        store = JournalStore(str(tmp_path))
+        store.open("r1", [1, 2], 8, None)
+        store.append_tokens("r1", [9, 9])
+        with open(store._path("r1"), "ab") as f:
+            f.write(b'{"kind": "tok", "t": [7')   # the torn append
+        [rec] = store.recover()
+        assert rec["tokens"] == [9, 9]            # complete lines only
+        assert store.drops["torn_lines"] == 1
+
+    def test_headerless_file_quarantined(self, tmp_path):
+        store = JournalStore(str(tmp_path))
+        store._append("ghost", {"kind": "tok", "t": [1]})
+        assert store.recover() == []
+        assert store.drops["corrupt_files"] == 1
+        assert any(n.endswith(".corrupt")
+                   for n in os.listdir(store.directory))
+
+    def test_fin_line_survives_crash_before_rename(self, tmp_path):
+        store = JournalStore(str(tmp_path))
+        store.open("r1", [1], 4, None)
+        store.append_tokens("r1", [2, 3])
+        # crash between the fin append and the rename: simulate by
+        # appending the fin line without retiring
+        store._append("r1", {"kind": "fin", "reason": "length"})
+        [rec] = store.recover()
+        assert rec["finished"] and rec["reason"] == "length"
+
+    def test_disp_line_names_last_replica(self, tmp_path):
+        store = JournalStore(str(tmp_path))
+        store.open("r1", [1], 4, None)
+        store._append("r1", {"kind": "disp", "replica": 0})
+        store._append("r1", {"kind": "disp", "replica": 1})
+        [rec] = store.recover()
+        assert rec["replica"] == 1                # last dispatch wins
+
+    def test_gc_bounds_retired_files(self, tmp_path):
+        store = JournalStore(str(tmp_path), keep=2)
+        for i in range(5):
+            store.open(f"r{i}", [1], 4, None)
+            store.retire(f"r{i}", "length")
+        done = [n for n in os.listdir(store.directory)
+                if n.endswith(".done")]
+        assert len(done) == 2
+
+    def test_keep_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PTPU_FLEET_JOURNAL_KEEP", "3")
+        assert JournalStore(str(tmp_path)).keep == 3
+
+    def test_discard_removes_live_file(self, tmp_path):
+        store = JournalStore(str(tmp_path))
+        store.open("r1", [1], 4, None)
+        store.discard("r1")
+        assert store.live_count() == 0
+        store.discard("r1")                       # idempotent
+
+
+# ---------------------------------------------------------------------------
+# router crash/recover: deterministic + property
+# ---------------------------------------------------------------------------
+class TestRouterRecovery:
+    def test_recover_reattach_token_exact(self, tmp_path):
+        model = tiny_model()
+        want = {i: dense_continuation(model, [1, 2, 3 + i], 10)
+                for i in range(4)}
+        reps, reg = local_fleet(2, max_seqs=4, kv_block_size=4)
+        router = Router(reps, registry=reg, run_dir=str(tmp_path),
+                        retry_budget=fresh_budget())
+        rids = [router.submit([1, 2, 3 + i], max_new_tokens=10)
+                for i in range(4)]
+        while any(len(router.journals[r].tokens) < 2 for r in rids):
+            router.pump()
+        del router                                # the "crash"
+        r2 = Router(reps, registry=reg, recover=str(tmp_path),
+                    retry_budget=fresh_budget())
+        assert r2.recovered["streams"] == 4
+        assert r2.recovered["reattached"] == 4    # replicas survived
+        outs = [r2.collect(r, timeout=60) for r in rids]
+        for i, out in enumerate(outs):
+            assert out["tokens"] == want[i], (i, out)
+        assert r2.store.live_count() == 0         # all retired
+        for rep in reps:
+            assert rep.engine.cache.leak_report()["leaked_blocks"] == 0
+
+    def test_recover_redispatches_orphans(self, tmp_path):
+        model = tiny_model()
+        want = dense_continuation(model, [1, 2, 3], 10)
+        reps, reg = local_fleet(2, max_seqs=4, kv_block_size=4)
+        router = Router(reps, registry=reg, run_dir=str(tmp_path),
+                        retry_budget=fresh_budget())
+        rid = router.submit([1, 2, 3], max_new_tokens=10)
+        while len(router.journals[rid].tokens) < 3:
+            router.pump()
+        victim = router.journals[rid].replica_id
+        del router
+        reps[victim].engine._state = "stopped"    # replica died too
+        r2 = Router(reps, registry=reg, recover=str(tmp_path),
+                    retry_budget=fresh_budget())
+        assert r2.recovered["redispatched"] == 1
+        out = r2.collect(rid, timeout=60)
+        assert out["tokens"] == want              # recompute-prefill
+
+    def test_recover_finished_stream_is_terminal(self, tmp_path):
+        reps, reg = local_fleet(1, max_seqs=2, kv_block_size=4)
+        router = Router(reps, registry=reg, run_dir=str(tmp_path))
+        rid = router.submit([1, 2], max_new_tokens=3)
+        out1 = router.collect(rid, timeout=60)
+        # crash AFTER the fin append but BEFORE the rename: re-create
+        # that window by re-journaling the finished stream
+        store = JournalStore(str(tmp_path))
+        store.open(rid, [1, 2], 3, None, tokens=out1["tokens"])
+        store._append(rid, {"kind": "fin", "reason": "length"})
+        r2 = Router(reps, registry=reg, recover=str(tmp_path))
+        assert r2.recovered["finished"] == 1
+        assert r2.collect(rid, timeout=5)["tokens"] == out1["tokens"]
+        assert r2.store.live_count() == 0         # retire completed
+
+    def test_recovered_router_accepts_new_anonymous_streams(self,
+                                                            tmp_path):
+        """The auto-id counter restarts at 0 after a crash but the
+        recovered journals keep their fleet-N names — new submissions
+        must skip past them instead of refusing as duplicates."""
+        model = tiny_model()
+        reps, reg = local_fleet(1, max_seqs=4, kv_block_size=4)
+        router = Router(reps, registry=reg, run_dir=str(tmp_path),
+                        retry_budget=fresh_budget())
+        old = router.submit([1, 2, 3], max_new_tokens=6)   # fleet-0
+        router.collect(old, timeout=60)
+        del router
+        r2 = Router(reps, registry=reg, recover=str(tmp_path),
+                    retry_budget=fresh_budget())
+        new = r2.submit([1, 2, 4], max_new_tokens=6)
+        assert new != old
+        want = dense_continuation(model, [1, 2, 4], 6)
+        assert r2.collect(new, timeout=60)["tokens"] == want
+
+    def test_shed_submission_leaves_no_ghost_journal(self, tmp_path):
+        from paddle_tpu.inference.fleet import FleetOverloaded
+        reps, reg = local_fleet(1, max_seqs=2, kv_block_size=4)
+        router = Router(reps, registry=reg, run_dir=str(tmp_path),
+                        shed_queue_depth=64,
+                        retry_budget=fresh_budget())
+        reps[0].engine._state = "stopped"
+        with pytest.raises(FleetOverloaded):
+            router.submit([1, 2], max_new_tokens=4)
+        assert router.journals == {}
+        assert router.store.live_count() == 0
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_wal_property_random_crash_recover(self, tmp_path, seed):
+        """Random accept/crash/torn-truncate/recover interleavings:
+        completions stay token-exact and allocators leak-free.  Torn
+        truncation only ever shortens the accepted prefix — re-attach
+        polls the replica from the journaled offset and greedy decode
+        regenerates the identical tail."""
+        rng = random.Random(seed)
+        model = tiny_model()
+        prompts = [[1, 2, 3 + i] for i in range(6)]
+        want = [dense_continuation(model, p, 12) for p in prompts]
+        reps, reg = local_fleet(2, max_seqs=4, kv_block_size=4)
+        router = Router(reps, registry=reg, run_dir=str(tmp_path),
+                        retry_budget=fresh_budget())
+        rids = [router.submit(p, max_new_tokens=12) for p in prompts]
+        for _round in range(rng.randint(1, 4)):
+            for _ in range(rng.randint(1, 6)):
+                router.pump()
+            # crash the router; tear a random live journal's tail
+            # (never into the header — a torn header is the separate
+            # quarantine path, not the resume path)
+            store = router.store
+            del router
+            live = [n for n in os.listdir(store.directory)
+                    if n.endswith(".jsonl")]
+            if live and rng.random() < 0.7:
+                path = os.path.join(store.directory, rng.choice(live))
+                raw = open(path, "rb").read()
+                header_end = raw.index(b"\n") + 1
+                if len(raw) > header_end:
+                    cut = rng.randint(header_end, len(raw) - 1)
+                    with open(path, "wb") as f:
+                        f.write(raw[:cut])
+            router = Router(reps, registry=reg, recover=str(tmp_path),
+                            retry_budget=fresh_budget())
+            assert router.recovered["streams"] == sum(
+                1 for r in rids if r in router.journals)
+        outs = [router.collect(r, timeout=120) for r in rids]
+        for i, out in enumerate(outs):
+            assert out["tokens"] == want[i], (seed, i, out)
+        assert router.store.live_count() == 0
+        for rep in reps:                          # empty leak report
+            assert rep.engine.cache.leak_report()["leaked_blocks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker + retry budget
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_trips_at_n_failures_in_window(self):
+        clk = faults.expire_clock(0.0)
+        br = CircuitBreaker(failures=3, window_secs=10,
+                            backoff_secs=2, clock=clk)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        assert br.state == "open" and not br.allow()
+        assert br.trips == 1
+
+    def test_failures_age_out_of_window(self):
+        clk = faults.expire_clock(0.0)
+        br = CircuitBreaker(failures=3, window_secs=5,
+                            backoff_secs=2, clock=clk)
+        br.record_failure()
+        clk.advance(6.0)                          # first failure ages out
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_half_open_probe_success_closes(self):
+        clk = faults.expire_clock(0.0)
+        br = CircuitBreaker(failures=1, window_secs=10,
+                            backoff_secs=2, clock=clk)
+        br.record_failure()
+        assert br.state == "open" and not br.allow()
+        clk.advance(2.0)
+        assert br.allow()                         # THE probe
+        assert br.state == "half_open"
+        assert not br.allow()                     # one probe at a time
+        br.record_success()
+        assert br.state == "closed"
+        assert br.current_backoff() == 2.0        # consecutive trips reset
+
+    def test_probe_failure_doubles_backoff_capped(self):
+        clk = faults.expire_clock(0.0)
+        br = CircuitBreaker(failures=1, window_secs=10,
+                            backoff_secs=2, clock=clk)
+        br.record_failure()
+        for expect in (4.0, 8.0, 16.0, 32.0, 32.0, 32.0):
+            clk.advance(br.current_backoff())
+            assert br.allow()                     # half-open probe
+            br.record_failure()                   # probe fails: reopen
+            assert br.state == "open"
+            assert br.current_backoff() == expect  # doubles, caps x16
+
+    def test_transitions_fire_callback(self):
+        seen = []
+        clk = faults.expire_clock(0.0)
+        br = CircuitBreaker(failures=1, window_secs=10, backoff_secs=1,
+                            clock=clk,
+                            on_transition=lambda p, n, _b: seen.append(
+                                (p, n)))
+        br.record_failure()
+        clk.advance(1.0)
+        br.allow()
+        br.record_success()
+        assert seen == [("closed", "open"), ("open", "half_open"),
+                        ("half_open", "closed")]
+
+
+class TestRetryBudget:
+    def test_spend_and_deny(self):
+        clk = faults.expire_clock(0.0)
+        b = RetryBudget(capacity=3, refill_per_s=0.0, clock=clk)
+        assert all(b.try_acquire() for _ in range(3))
+        assert not b.try_acquire()
+        assert b.spent == 3 and b.denied == 1
+
+    def test_refill_restores_tokens(self):
+        clk = faults.expire_clock(0.0)
+        b = RetryBudget(capacity=2, refill_per_s=1.0, clock=clk)
+        b.try_acquire(2)
+        assert not b.try_acquire()
+        clk.advance(1.5)
+        assert b.try_acquire()                    # 1.5 tokens refilled
+        assert not b.try_acquire()                # 0.5 left < 1
+
+    def test_process_wide_singleton(self):
+        reset_retry_budget()
+        try:
+            a = get_retry_budget()
+            assert get_retry_budget() is a
+            reset_retry_budget()
+            assert get_retry_budget() is not a
+        finally:
+            reset_retry_budget()
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PTPU_FLEET_RETRY_BUDGET", "7")
+        monkeypatch.setenv("PTPU_FLEET_RETRY_REFILL_PER_S", "0.5")
+        b = RetryBudget()
+        assert b.capacity == 7.0 and b.refill_per_s == 0.5
+
+
+# ---------------------------------------------------------------------------
+# the flap drill: breaker + budget + census under flaky_replica
+# ---------------------------------------------------------------------------
+class TestFlapDrill:
+    def test_flaky_replica_injector_restores(self):
+        reps, _ = local_fleet(1, max_seqs=2, kv_block_size=4)
+        with faults.flaky_replica(reps[0], error_rate=1.0,
+                                  seed=0) as flake:
+            assert "submit" in reps[0].__dict__   # transport wrapped
+            with pytest.raises(ConnectionError, match="injected flake"):
+                reps[0].submit({"request_id": "x", "prompt": [1],
+                                "max_new_tokens": 1,
+                                "eos_token_id": None})
+            assert flake.injected_errors == 1
+        assert "submit" not in reps[0].__dict__   # restored on exit
+        assert reps[0].engine.sched.counts()["waiting"] == 0
+
+    def test_breaker_opens_streams_complete_budget_bounded(self):
+        model = tiny_model()
+        prompts = [[1, 2, 3 + i] for i in range(6)]
+        want = [dense_continuation(model, p, 10) for p in prompts]
+        sink = CaptureSink()
+        reps, reg = local_fleet(3, max_seqs=4, kv_block_size=4)
+        reg.add_sink(sink)
+        budget = RetryBudget(capacity=32, refill_per_s=0.0)
+        router = Router(reps, registry=reg, retry_budget=budget,
+                        breaker_kw={"failures": 3, "window_secs": 60.0,
+                                    "backoff_secs": 1000.0})
+        victim = 1
+        with faults.flaky_replica(reps[victim], error_rate=0.3,
+                                  seed=7) as flake:
+            rids = [router.submit(p, max_new_tokens=10) for p in prompts]
+            outs = [router.collect(r, timeout=120) for r in rids]
+        for i, out in enumerate(outs):
+            assert out["tokens"] == want[i], (i, out)
+        assert flake.injected_errors > 0
+        # the breaker opened on the flapping replica — and only it
+        assert router.breakers[victim].trips >= 1
+        for rid_ in (0, 2):
+            assert router.breakers.get(rid_) is None \
+                or router.breakers[rid_].trips == 0
+        # flapping surfaced: census overlay + timeline records
+        assert router.census()[victim] == "flapping"
+        assert router.stats()["states"].get("flapping") == 1
+        assert any(r["kind"] == "fleet.breaker"
+                   and r["state"] == "open"
+                   and r["replica"] == victim for r in sink.records)
+        # no retry storm: every retry/failover spent the bounded budget
+        assert budget.spent <= budget.capacity
+        assert budget.spent == 32 - budget.available()
+        # the doctor names the flapping replica from the records alone
+        from paddle_tpu.observability.doctor import check_fleet_flapping
+        [finding] = check_fleet_flapping({0: sink.records})
+        assert finding["kind"] == "fleet_flapping"
+        assert str(victim) in json.dumps(finding["data"]["trips"])
+
+    def test_dry_budget_sheds_new_submissions(self):
+        reps, reg = local_fleet(2, max_seqs=4, kv_block_size=4)
+        from paddle_tpu.inference.fleet import FleetOverloaded
+        router = Router(reps, registry=reg, retry_max=3,
+                        retry_backoff_ms=0.0, sleep=lambda _t: None,
+                        retry_budget=RetryBudget(capacity=0,
+                                                 refill_per_s=0.0))
+        router.dispatch_fault = faults.drop_dispatch(count=1)
+        # first attempt is free; the drop forces a second send, which
+        # needs a budget token — dry bucket degrades to load-shed
+        with pytest.raises(FleetOverloaded, match="retry budget dry"):
+            router.submit([1, 2], max_new_tokens=4)
+        assert router.journals == {}
+
+    def test_manager_census_gains_flapping_state(self):
+        reg = MetricsRegistry()
+        mgr = LocalReplicaManager(
+            lambda i: ServingEngine(tiny_model(), registry=reg,
+                                    replica_id=i, max_seqs=2,
+                                    kv_block_size=4),
+            replicas=2, registry=reg)
+        mgr.set_flapping(1, True)
+        assert mgr.poll_states()[1] == "flapping"
+        snap = reg.snapshot()
+        assert snap["fleet.replicas[state=flapping]"]["value"] == 1.0
+        assert snap["fleet.replicas[state=healthy]"]["value"] == 1.0
+        mgr.set_flapping(1, False)
+        assert mgr.poll_states()[1] == "healthy"
+        assert reg.snapshot()[
+            "fleet.replicas[state=flapping]"]["value"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# autoscaler control loop (fake clock, real LocalReplicaManager)
+# ---------------------------------------------------------------------------
+class ScalableStub:
+    """Replica stub with mutable pressure (autoscaler unit tests)."""
+
+    def __init__(self, replica_id, pressure=0.0):
+        self.replica_id = replica_id
+        self.pressure = float(pressure)
+        self.up = True
+
+    def serving_stats(self):
+        return {"queue_depth": self.pressure, "waiting": 0, "running": 0}
+
+    def healthz(self):
+        return (200, "serving")
+
+    def alive(self):
+        return self.up
+
+    def stop(self):
+        self.up = False
+
+
+class StubManager:
+    """Minimal actuator-protocol manager over :class:`ScalableStub`."""
+
+    def __init__(self, n=1, pressure=0.0, registry=None):
+        self.replicas = [ScalableStub(i, pressure) for i in range(n)]
+        self._retired = set()
+        self._registry = registry or MetricsRegistry()
+        self.spawns = 0
+        self.retires = []
+
+    def poll_states(self):
+        return {i: ("retired" if i in self._retired else "healthy")
+                for i in range(len(self.replicas))}
+
+    def spawn(self):
+        for idx in sorted(self._retired):
+            self._retired.discard(idx)
+            self.replicas[idx] = ScalableStub(
+                idx, self.replicas[0].pressure)
+            self.spawns += 1
+            return self.replicas[idx]
+        self.replicas.append(ScalableStub(len(self.replicas),
+                                          self.replicas[0].pressure))
+        self.spawns += 1
+        return self.replicas[-1]
+
+    def retire(self, idx):
+        self._retired.add(idx)
+        self.retires.append(idx)
+
+    def set_pressure(self, p):
+        for r in self.replicas:
+            r.pressure = float(p)
+
+
+class TestAutoscaler:
+    def mk(self, reg=None, **kw):
+        clk = faults.expire_clock(0.0)
+        mgr = StubManager(n=1, pressure=0.0, registry=reg)
+        kw.setdefault("slo", ServingSLO(queue_depth=4))
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 3)
+        kw.setdefault("window_secs", 10.0)
+        kw.setdefault("cooldown_secs", 5.0)
+        auto = FleetAutoscaler(mgr, registry=reg or MetricsRegistry(),
+                               clock=clk, **kw)
+        return auto, mgr, clk
+
+    def drive(self, auto, clk, seconds, dt=1.0):
+        actions = []
+        t = 0.0
+        while t < seconds:
+            clk.advance(dt)
+            t += dt
+            a = auto.step()
+            if a:
+                actions.append(a)
+        return actions
+
+    def test_burst_scales_up_within_burn_window(self):
+        sink = CaptureSink()
+        reg = MetricsRegistry()
+        reg.add_sink(sink)
+        auto, mgr, clk = self.mk(reg=reg)
+        mgr.set_pressure(10.0)                    # SLO burns (>4)
+        actions = self.drive(auto, clk, 30)
+        assert actions[:2] == ["up", "up"]        # 1 -> 3 replicas
+        assert mgr.spawns == 2
+        ups = [r for r in sink.records
+               if r["kind"] == "fleet.autoscale" and r["action"] == "up"]
+        assert len(ups) == 2
+        assert all("queue_depth" in u["why"] for u in ups)
+
+    def test_blocked_at_max_is_a_record(self):
+        sink = CaptureSink()
+        reg = MetricsRegistry()
+        reg.add_sink(sink)
+        auto, mgr, clk = self.mk(reg=reg, max_replicas=1)
+        mgr.set_pressure(10.0)
+        actions = self.drive(auto, clk, 20)
+        assert "blocked_at_max" in actions
+        assert mgr.spawns == 0
+        blocked = [r for r in sink.records
+                   if r["kind"] == "fleet.autoscale"
+                   and r["action"] == "blocked_at_max"]
+        assert blocked and blocked[0]["replicas"] == 1
+
+    def test_idle_scales_down_after_cooldown(self):
+        sink = CaptureSink()
+        reg = MetricsRegistry()
+        reg.add_sink(sink)
+        auto, mgr, clk = self.mk(reg=reg)
+        mgr.set_pressure(10.0)
+        self.drive(auto, clk, 16)                 # scale up first
+        assert len(mgr.replicas) >= 2
+        mgr.set_pressure(0.0)                     # burst over
+        actions = self.drive(auto, clk, 60)
+        assert "down" in actions
+        assert mgr.retires                        # a slot was retired
+        downs = [r for r in sink.records
+                 if r["kind"] == "fleet.autoscale"
+                 and r["action"] == "down"]
+        assert downs and "idle through window" in downs[0]["why"]
+        # never below the floor
+        active = [i for i, s in mgr.poll_states().items()
+                  if s == "healthy"]
+        assert len(active) >= auto.min_replicas
+
+    def test_cooldown_rate_limits_actions(self):
+        auto, mgr, clk = self.mk(cooldown_secs=30.0)
+        mgr.set_pressure(10.0)
+        actions = self.drive(auto, clk, 35)
+        assert actions == ["up"]                  # second up still cooling
+
+    def test_one_slow_sample_does_not_flap_the_fleet(self):
+        auto, mgr, clk = self.mk()
+        # 12 idle-ish samples, one burning blip: burn fraction stays
+        # far under the threshold — no scale-up
+        for i in range(12):
+            clk.advance(1.0)
+            mgr.set_pressure(10.0 if i == 5 else 1.0)
+            assert auto.step() is None
+        assert mgr.spawns == 0
+
+    def test_scale_down_quiesces_through_router(self, tmp_path):
+        """End-to-end against a real LocalReplicaManager: the victim's
+        live stream migrates (drain) before the slot retires."""
+        reg = MetricsRegistry()
+        clk = faults.expire_clock(0.0)
+        mgr = LocalReplicaManager(
+            lambda i: ServingEngine(tiny_model(), registry=reg,
+                                    replica_id=i, max_seqs=4,
+                                    kv_block_size=4),
+            replicas=2, registry=reg)
+        router = Router(mgr.replicas, manager=mgr, registry=reg,
+                        retry_budget=fresh_budget())
+        model = tiny_model()
+        want = dense_continuation(model, [1, 2, 3], 12)
+        rid = router.submit([1, 2, 3], max_new_tokens=12)
+        router.pump()
+        auto = FleetAutoscaler(mgr, router=router,
+                               slo=ServingSLO(queue_depth=50),
+                               min_replicas=1, max_replicas=2,
+                               window_secs=5.0, cooldown_secs=1.0,
+                               registry=reg, clock=clk)
+        # the fleet holds work, so it is never "idle" — finish first
+        out = router.collect(rid, timeout=60)
+        assert out["tokens"] == want
+        for _ in range(8):
+            clk.advance(1.0)
+            auto.step()
+        assert auto.actions["down"] == 1
+        assert "retired" in mgr.poll_states().values()
+        # spawn() reuses the retired slot — ids stay stable
+        mgr.spawn()
+        assert sorted(mgr.poll_states().values()) == [
+            "healthy", "healthy"]
+
+    def test_min_max_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("PTPU_FLEET_MIN", "2")
+        monkeypatch.setenv("PTPU_FLEET_MAX", "5")
+        auto = FleetAutoscaler(StubManager(n=2),
+                               registry=MetricsRegistry(),
+                               window_secs=1.0, cooldown_secs=1.0)
+        assert auto.min_replicas == 2 and auto.max_replicas == 5
+
+
+# ---------------------------------------------------------------------------
+# doctor verdicts + satellite knobs
+# ---------------------------------------------------------------------------
+class TestDoctorVerdicts:
+    def test_fleet_flapping_names_replica_and_budget_pressure(self):
+        from paddle_tpu.observability.doctor import check_fleet_flapping
+        recs = [{"kind": "fleet.breaker", "replica": 1,
+                 "prev": "closed", "state": "open", "trips": 1},
+                {"kind": "fleet.breaker", "replica": 1,
+                 "prev": "half_open", "state": "open", "trips": 2},
+                {"kind": "fleet.shed", "why": "retry_budget"},
+                {"kind": "fleet.deferred", "request_id": "r1",
+                 "why": "retry_budget"}]
+        [f] = check_fleet_flapping({0: recs})
+        assert f["kind"] == "fleet_flapping"
+        assert f["data"]["trips"] == {"1": 2}
+        assert f["data"]["budget_sheds"] == 1
+        assert any("retry storm" in e for e in f["evidence"])
+        # closed->closed noise alone: no verdict
+        assert not check_fleet_flapping(
+            {0: [{"kind": "fleet.breaker", "replica": 0,
+                  "prev": "open", "state": "half_open"}]})
+
+    def test_fleet_slo_burn_escalates_on_blocked_at_max(self):
+        from paddle_tpu.observability.doctor import check_fleet_slo_burn
+        ups = [{"kind": "fleet.autoscale", "action": "up",
+                "replicas": 1, "target": 2, "burn": 0.8,
+                "why": "replica 0: queue_depth 12 > 4"}]
+        [mild] = check_fleet_slo_burn({0: ups})
+        assert mild["kind"] == "fleet_slo_burn"
+        blocked = ups + [{"kind": "fleet.autoscale",
+                          "action": "blocked_at_max", "replicas": 2,
+                          "target": 2, "burn": 1.0, "why": "still hot"}]
+        [hot] = check_fleet_slo_burn({0: blocked})
+        assert hot["severity"] > mild["severity"]
+        assert any("PTPU_FLEET_MAX" in e for e in hot["evidence"])
+        assert not check_fleet_slo_burn(
+            {0: [{"kind": "fleet.autoscale", "action": "down"}]})
+
+    def test_diagnose_surfaces_fleet_autonomy_verdicts(self, tmp_path):
+        from paddle_tpu.observability import doctor
+        from paddle_tpu.observability.sinks import (MetricsWriter,
+                                                    metrics_dir)
+        reg = MetricsRegistry()
+        reg.add_sink(MetricsWriter(metrics_dir(str(tmp_path)),
+                                   worker_id=0, flush_every=1))
+        reg.emit("fleet.breaker", replica=0, prev="closed",
+                 state="open", trips=1)
+        reg.emit("fleet.autoscale", action="blocked_at_max", replicas=2,
+                 target=2, burn=1.0, why="hot")
+        reg.flush()
+        diag = doctor.diagnose(str(tmp_path), write=False)
+        kinds = {f["kind"] for f in diag["findings"]}
+        assert {"fleet_flapping", "fleet_slo_burn"} <= kinds
+
+
+class TestSatelliteKnobs:
+    def test_drain_slack_env_knob(self, monkeypatch):
+        assert default_drain_slack_secs() == 30.0
+        monkeypatch.setenv("PTPU_FLEET_DRAIN_SLACK_SECS", "2.5")
+        assert default_drain_slack_secs() == 2.5
+
+    def test_http_drain_uses_slack(self, monkeypatch):
+        from paddle_tpu.inference.fleet import HttpReplica
+        monkeypatch.setenv("PTPU_FLEET_DRAIN_SLACK_SECS", "1.5")
+        rep = HttpReplica(0, port=1)
+        seen = {}
+
+        def fake_call(path, payload=None, timeout=None):
+            seen["timeout"] = timeout
+            return {"finished": 0, "spilled_records": []}
+
+        rep._call = fake_call
+        rep.drain(timeout=2.0)
+        assert seen["timeout"] == pytest.approx(3.5)
+
+    def test_engine_stats_slo_section(self):
+        reg = MetricsRegistry()
+        eng = ServingEngine(tiny_model(), registry=reg, max_seqs=2,
+                            kv_block_size=4)
+        eng.generate([[1, 2, 3]], max_new_tokens=4)
+        slo = eng.stats()["slo"]
+        assert slo["ttft_ms"]["samples"] >= 1
+        assert slo["ttft_ms"]["p99"] >= slo["ttft_ms"]["p50"] >= 0.0
+        assert slo["tpot_ms"]["samples"] >= 1
+
+    def test_admit_record_idempotent_on_duplicate_rid(self):
+        reg = MetricsRegistry()
+        eng = ServingEngine(tiny_model(), registry=reg, max_seqs=4,
+                            kv_block_size=4)
+        rec = {"request_id": "dup", "prompt": [1, 2],
+               "max_new_tokens": 4, "eos_token_id": None, "output": []}
+        assert eng.admit_record(rec) == "dup"
+        assert eng.admit_record(dict(rec)) == "dup"   # no double admit
+        counts = eng.sched.counts()
+        assert counts["waiting"] + counts["running"] == 1
+        assert reg.snapshot()["serve.readmit_dupes"]["value"] == 1.0

@@ -179,9 +179,17 @@ PYEOF
     # complete token-exact vs an uninterrupted single-engine reference,
     # fleet.failovers >= 1, survivor allocators clean) and a rolling
     # upgrade (drain each replica in turn under load, zero drops)
-    python -m pytest -q -m serving tests/test_serve_fleet.py
+    python -m pytest -q -m serving tests/test_serve_fleet.py \
+        tests/test_fleet_autonomy.py
     JAX_PLATFORMS=cpu python examples/serve_fleet.py --sigkill_drill
     JAX_PLATFORMS=cpu python examples/serve_fleet.py --rolling_upgrade
+    # fleet autonomy drills (ISSUE 17): SIGKILL the *router* mid-stream
+    # (the workers survive) — Router(recover=run_dir) must finish every
+    # stream token-exact from the journal directory alone with zero
+    # replica restarts; then the SLO autoscaler on fake time — burst ->
+    # up, ceiling -> blocked_at_max, idle window -> drain + retire down
+    JAX_PLATFORMS=cpu python examples/serve_fleet.py --router_crash_drill
+    JAX_PLATFORMS=cpu python examples/serve_fleet.py --autoscale_drill
     # serve_fleet smoke row into the ledger (advisory gate on first rows)
     JAX_PLATFORMS=cpu python -m paddle_tpu.bench \
         --scenario serve_fleet --smoke
