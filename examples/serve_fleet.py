@@ -32,12 +32,21 @@ reference.
         + retire back down — every transition a ``fleet.autoscale``
         record, and the burst's streams still token-exact.
 
+    python examples/serve_fleet.py --trace_drill
+        ISSUE 18 request-tracing acceptance: 8 ragged streams through
+        a journaled 2-replica fleet, replica 0 SIGKILLed mid-stream.
+        The assembler must produce exactly ONE waterfall per request
+        (the victims stitched across both replicas), coverage >= 95%
+        with zero orphan spans, and the tail-latency doctor must name
+        failover recompute as the dominant p99 component.
+
 All drills print one JSON line of evidence and exit nonzero on any
 violated invariant, so ci.sh can run them as smokes.
 """
 import argparse
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -325,12 +334,126 @@ def autoscale_drill(run_dir):
         "scaler": scaler.stats()["actions"]}))
 
 
+_TRACE_PROMPTS = [[1, 2, 3 + i % 6, 4 + i % 3] for i in range(8)]
+_TRACE_MAX_NEW = [24 + 2 * i for i in range(8)]     # ragged 24..38
+
+
+def trace_drill(run_dir):
+    """ISSUE 18 acceptance: per-request waterfalls survive a replica
+    SIGKILL.  Every victim stream's trace must stitch across BOTH
+    replicas, every request must assemble into exactly one trace with
+    coverage >= 95% and zero orphan spans, and both the attribution
+    helper and the doctor must name failover recompute as what the
+    p99 tail pays extra for (migrants requeue + re-prefill behind the
+    survivor's residents)."""
+    from paddle_tpu.observability import doctor, requesttrace
+    from paddle_tpu.observability.aggregate import read_worker_stream
+    from paddle_tpu.observability.sinks import MetricsWriter, metrics_dir
+
+    mdir = metrics_dir(run_dir)
+    reg = MetricsRegistry()
+    # router spans go to worker-0; each engine worker writes its own
+    # stream (worker-i+1) via PTPU_METRICS_DIR, flushed per record so
+    # the SIGKILL victim's spans survive
+    writer = reg.add_sink(MetricsWriter(mdir, worker_id=0,
+                                        flush_every=1))
+    mgr = ReplicaManager(SPEC, replicas=2, registry=reg,
+                         run_dir=run_dir,
+                         env={"PTPU_METRICS_DIR": mdir})
+    mgr.start()
+    router = Router(mgr.replicas, manager=mgr, registry=reg,
+                    run_dir=run_dir)       # journaled: WAL cross-check
+    rids = []
+    try:
+        # warm EVERY replica directly (least-loaded dispatch can pile
+        # all warmup onto one replica, leaving the other to compile
+        # mid-drill and serialize the whole fleet behind its worker
+        # lock): the len-4 prefill bucket + the padded decode batch.
+        # ``"trace_id": None`` is an explicit not-traced decision, so
+        # warmup streams never enter the assembly
+        for i, rep in enumerate(mgr.replicas):
+            warm = [f"warm-{i}-{w}" for w in range(4)]
+            for rid in warm:
+                rep.submit({"request_id": rid, "prompt": [1, 2, 3, 4],
+                            "output": [], "max_new_tokens": 4,
+                            "eos_token_id": None, "preemptions": 0,
+                            "trace_id": None})
+            for rid in warm:
+                deadline = time.monotonic() + 120
+                while not rep.poll(rid, start=0)["finished"]:
+                    assert time.monotonic() < deadline, \
+                        f"warmup stream {rid} never finished"
+                    time.sleep(0.01)
+        rids = [router.submit(p, max_new_tokens=_TRACE_MAX_NEW[i])
+                for i, p in enumerate(_TRACE_PROMPTS)]
+        kill = faults.kill_replica(
+            mgr, index=0,
+            when=lambda: any(
+                len(j.tokens) >= 2 for j in router.journals.values()
+                if j.replica_id == 0 and not j.finished))
+        deadline = time.monotonic() + 120
+        while not kill.fired and time.monotonic() < deadline:
+            router.pump()
+            kill.maybe()
+            time.sleep(0.01)
+        assert kill.fired == 1, "kill predicate never held"
+        outs = [router.collect(r, timeout=120) for r in rids]
+        truncated = sum(len(o["tokens"]) != _TRACE_MAX_NEW[i]
+                        for i, o in enumerate(outs))
+        assert truncated == 0, f"{truncated} truncated streams"
+        assert router.failovers >= 1, "no failover observed"
+    finally:
+        mgr.stop()
+    reg.remove_sink(writer)                # flush + close worker-0
+
+    result = requesttrace.assemble_run(run_dir)
+    traces = result["traces"]
+    assert len(traces) == len(rids), \
+        f"{len(traces)} traces for {len(rids)} requests"
+    assert {t["request_id"] for t in traces} == set(rids), \
+        "assembled request ids do not match the submitted set"
+    assert result["complete"] == len(rids), result
+    assert not result["orphan_spans"], result["orphan_spans"]
+    stitched = [t for t in traces
+                if {"replica-0", "replica-1"} <= set(t["procs"])]
+    assert stitched, "no trace stitched across both replicas"
+    min_cov = min(t["coverage"] for t in traces)
+    assert min_cov >= 0.95, \
+        f"trace coverage floor {min_cov:.1%} < 95%"
+    attrib = requesttrace.tail_latency_attribution(traces)
+    assert attrib is not None and \
+        attrib["dominant"] == "failover_recompute", attrib
+
+    workers = {}
+    for name in sorted(os.listdir(mdir)):
+        m = re.match(r"^worker-(\d+)\.jsonl$", name)
+        if m:
+            workers[int(m.group(1))] = read_worker_stream(
+                os.path.join(mdir, name))
+    findings = doctor.check_tail_latency(workers)
+    assert findings, "doctor produced no tail_latency verdict"
+    assert findings[0]["data"]["dominant"] == "failover_recompute", \
+        findings[0]
+    print(json.dumps({
+        "drill": "trace", "streams": len(rids),
+        "traces": len(traces), "complete": result["complete"],
+        "stitched_across_replicas": len(stitched),
+        "coverage_min": round(min_cov, 4),
+        "orphan_spans": len(result["orphan_spans"]),
+        "wal_matched": result["wal_matched"],
+        "tail_dominant": attrib["dominant"],
+        "tail_p99_ms": attrib["p99_ms"],
+        "tail_median_ms": attrib["median_ms"],
+        "doctor_severity": findings[0]["severity"]}))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sigkill_drill", action="store_true")
     ap.add_argument("--rolling_upgrade", action="store_true")
     ap.add_argument("--router_crash_drill", action="store_true")
     ap.add_argument("--autoscale_drill", action="store_true")
+    ap.add_argument("--trace_drill", action="store_true")
     ap.add_argument("--_crash_child", metavar="RUN_DIR", default=None,
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -347,9 +470,12 @@ def main():
             router_crash_drill(run_dir)
         elif args.autoscale_drill:
             autoscale_drill(run_dir)
+        elif args.trace_drill:
+            trace_drill(run_dir)
         else:
             ap.error("pick --sigkill_drill, --rolling_upgrade, "
-                     "--router_crash_drill or --autoscale_drill")
+                     "--router_crash_drill, --autoscale_drill or "
+                     "--trace_drill")
 
 
 if __name__ == "__main__":

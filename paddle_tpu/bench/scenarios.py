@@ -432,7 +432,21 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
     replicas behind the Router, one mid-run failover.  A bench "step"
     is one router pump (poll + step every live replica); the timed
     window includes journal replay of the failed-over streams, so the
-    figure prices what resilience costs, not just the happy path."""
+    figure prices what resilience costs, not just the happy path.
+
+    Runs twice (ISSUE 18): once with request tracing OFF (the parity
+    baseline) and once ON (the reported pass).  ``extra`` carries the
+    assembled trace coverage, per-component breakdown medians, and
+    ``trace_overhead_frac`` — the typical (p50) pump's span-emission
+    cost as a fraction of step p50, which CI asserts stays under 1%.
+    One-off emission bursts (prefill fan-out, failover re-dispatch)
+    stay visible in the reported per-pump mean.  The cost
+    is measured directly (``requesttrace.emission_cost`` meters the
+    emit hot path) rather than by differencing the two passes: at
+    millisecond-scale CPU steps, run-to-run jitter swamps a 1% budget,
+    while direct accounting resolves microseconds.  The off-pass p50
+    is still reported so gross regressions stay visible."""
+    import os as _os
     import time as _time
 
     import numpy as np
@@ -441,12 +455,13 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
     from paddle_tpu.inference import ServingEngine
     from paddle_tpu.inference.fleet import LocalReplica, Router
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import requesttrace
     from paddle_tpu.observability.mfu import (flops_per_token, mfu,
                                               param_count)
     from paddle_tpu.observability.registry import MetricsRegistry
 
     n_streams = 8 if mode == "full" else 4
-    max_new = 48 if mode == "full" else 12
+    max_new = 48 if mode == "full" else 24
     cfg = GPTConfig(vocab_size=512,
                     hidden_size=128 if mode == "full" else 64,
                     num_layers=2, num_heads=4,
@@ -462,51 +477,119 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
                                     kv_block_size=4, registry=reg,
                                     replica_id=i)
 
-    reg = MetricsRegistry()
-    models, replicas = [], []
-    for i in range(2):
-        model, eng = build_engine(reg, i)
-        models.append(model)
-        replicas.append(LocalReplica(eng, replica_id=i))
-    router = Router(replicas, registry=reg)
-    rng = np.random.RandomState(7)
-    prompts = [rng.randint(1, cfg.vocab_size,
-                           rng.randint(3, 8)).tolist()
-               for _ in range(n_streams)]
-    # warm both replicas' compile caches outside the timed window
-    for r in replicas:
-        r.engine.generate([prompts[0][:3]], max_new_tokens=2)
-    rids = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+    class _ListSink:                  # in-memory trace record capture
+        def __init__(self):
+            self.records: List[Dict[str, Any]] = []
 
-    kill_after = 3                    # pumps before the failover drill
-    step_ms: List[float] = []
-    t0 = _time.perf_counter()
-    while len(step_ms) < 4096:
-        ta = _time.perf_counter()
-        live = router.pump()
-        step_ms.append((_time.perf_counter() - ta) * 1e3)
-        if len(step_ms) == kill_after:
-            victim = next((j.replica_id
-                           for j in router.journals.values()
-                           if not j.finished
-                           and j.replica_id is not None), None)
-            if victim is not None:
-                replicas[victim].engine._state = "stopped"
-        if live == 0:
-            break
-    elapsed = _time.perf_counter() - t0
-    results = [router.collect(r, timeout=60) for r in rids]
-    generated = sum(len(r["tokens"]) for r in results)
-    tok_s = generated / max(1e-9, elapsed)
+        def write(self, rec):
+            self.records.append(rec)
 
-    n_params = param_count(models[0].trainable_variables())
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    def run_pass(traced: bool) -> Dict[str, Any]:
+        prev = _os.environ.get(requesttrace.TRACE_REQUESTS_ENV)
+        _os.environ[requesttrace.TRACE_REQUESTS_ENV] = \
+            "1" if traced else "0"
+        try:
+            reg = MetricsRegistry()
+            sink = _ListSink()
+            if traced:
+                reg.add_sink(sink)
+            models, replicas = [], []
+            for i in range(2):
+                model, eng = build_engine(reg, i)
+                models.append(model)
+                replicas.append(LocalReplica(eng, replica_id=i))
+            router = Router(replicas, registry=reg)
+            rng = np.random.RandomState(7)
+            prompts = [rng.randint(1, cfg.vocab_size,
+                                   rng.randint(3, 8)).tolist()
+                       for _ in range(n_streams)]
+            # warm both replicas' compile caches outside the timed
+            # window — untraced, so assembled traces == client streams
+            _os.environ[requesttrace.TRACE_REQUESTS_ENV] = "0"
+            for r in replicas:
+                r.engine.generate([prompts[0][:3]], max_new_tokens=2)
+            _os.environ[requesttrace.TRACE_REQUESTS_ENV] = \
+                "1" if traced else "0"
+            rids = [router.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+
+            kill_after = 3            # pumps before the failover drill
+            step_ms: List[float] = []
+            emit_ms: List[float] = []   # per-pump metered emit cost
+            cost = requesttrace.emission_cost
+            if traced:                # meter emit cost over the timed
+                cost.start()          # window only
+            t0 = _time.perf_counter()
+            while len(step_ms) < 4096:
+                es0 = cost.seconds
+                ta = _time.perf_counter()
+                live = router.pump()
+                step_ms.append((_time.perf_counter() - ta) * 1e3)
+                emit_ms.append((cost.seconds - es0) * 1e3)
+                if len(step_ms) == kill_after:
+                    victim = next((j.replica_id
+                                   for j in router.journals.values()
+                                   if not j.finished
+                                   and j.replica_id is not None), None)
+                    if victim is not None:
+                        replicas[victim].engine._state = "stopped"
+                if live == 0:
+                    break
+            elapsed = _time.perf_counter() - t0
+            emit_n = cost.count
+            cost.stop()
+            results = [router.collect(r, timeout=60) for r in rids]
+            return {"step_ms": step_ms, "elapsed": elapsed,
+                    "generated": sum(len(r["tokens"]) for r in results),
+                    "records": sink.records, "router": router,
+                    "models": models, "n_requests": len(rids),
+                    "emit_ms": emit_ms, "emit_count": emit_n}
+        finally:
+            if prev is None:
+                _os.environ.pop(requesttrace.TRACE_REQUESTS_ENV, None)
+            else:
+                _os.environ[requesttrace.TRACE_REQUESTS_ENV] = prev
+
+    def p50(series):
+        return harness.pct(sorted(series), 50) or 0.0
+
+    base = run_pass(traced=False)     # parity baseline: same token
+    run = run_pass(traced=True)       # count, untraced step p50
+    step_ms = run["step_ms"]
+    generated = run["generated"]
+    tok_s = generated / max(1e-9, run["elapsed"])
+    p50_off, p50_on = p50(base["step_ms"]), p50(step_ms)
+    # overhead = the typical pump's metered emission cost over the
+    # typical pump's duration — p50 against p50, so one-off bursts
+    # (prefill fan-out, failover re-dispatch) land in the mean, which
+    # is still reported, not in the gate (direct measurement; see the
+    # docstring for why not pass differencing)
+    emit_p50 = p50(run["emit_ms"])
+    emit_mean = sum(run["emit_ms"]) / max(1, len(run["emit_ms"]))
+    overhead = emit_p50 / p50_on if p50_on > 0 else 0.0
+
+    asm = requesttrace.TraceAssembler().from_records(run["records"])
+    traces = asm["traces"]
+    coverages = sorted(t["coverage"] for t in traces)
+    comps = sorted({c for t in traces for c in t["components"]})
+    comp_medians = {
+        c: round(harness.pct(sorted(t["components"].get(c, 0.0)
+                                    for t in traces), 50) or 0.0, 3)
+        for c in comps}
+    attrib = requesttrace.tail_latency_attribution(traces)
+
+    n_params = param_count(run["models"][0].trainable_variables())
     flops_tok = flops_per_token(n_params, num_layers=cfg.num_layers,
                                 hidden_size=cfg.hidden_size,
                                 seq_len=cfg.max_position_embeddings,
                                 fwd_only=True)
-
-    def p50(series):
-        return harness.pct(sorted(series), 50) or 0.0
+    router = run["router"]
 
     return {
         "config": {"n_streams": n_streams, "max_new_tokens": max_new,
@@ -523,5 +606,20 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
         "extra": {"generated_tokens": generated,
                   "router_pumps": len(step_ms),
                   "failovers": router.failovers,
-                  "dispatches": len(rids) + router.failovers},
+                  "dispatches": run["n_requests"] + router.failovers,
+                  "trace_overhead_frac": round(overhead, 6),
+                  "trace_emit_p50_ms": round(emit_p50, 5),
+                  "trace_emit_ms_per_pump": round(emit_mean, 5),
+                  "trace_emit_records": run["emit_count"],
+                  "trace_step_p50_off_ms": round(p50_off, 3),
+                  "trace_step_p50_on_ms": round(p50_on, 3),
+                  "traces_assembled": len(traces),
+                  "traces_complete": asm["complete"],
+                  "trace_orphan_spans": len(asm["orphan_spans"]),
+                  "trace_coverage_p50": round(
+                      harness.pct(coverages, 50) or 0.0, 4),
+                  "trace_coverage_min": round(
+                      coverages[0] if coverages else 0.0, 4),
+                  "trace_component_median_ms": comp_medians,
+                  "tail_dominant": (attrib or {}).get("dominant")},
     }

@@ -92,6 +92,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.errors import enforce
+from ..observability import requesttrace
 from ..observability.compilation import track_jit
 from ..supervisor.watchdog import StepTimeout, Watchdog, guarded
 from ..utils import fsio
@@ -113,6 +114,12 @@ DRAIN_SECS_ENV = "PTPU_SERVE_DRAIN_SECS"
 
 _PAD_SEQ = "__pad__"          # never a real request id
 _CB_STOP = object()           # callback-thread shutdown sentinel
+
+# recompute cause → trace-span component (ISSUE 18): the re-prefill (and
+# the re-queue wait before it) is attributed to whatever evicted the KV
+_RESUME_COMPONENT = {"preempt": "preempt_recompute",
+                     "failover": "failover_recompute",
+                     "migration": "migration_recompute"}
 
 
 def _pctl(values, p: float) -> Optional[float]:
@@ -269,6 +276,13 @@ class ServingEngine:
         # histograms, so the autoscaler sees THIS engine's p99
         self._ttft_ms: Deque[float] = deque(maxlen=512)
         self._tpot_ms: Deque[float] = deque(maxlen=512)
+        # request tracing (ISSUE 18): the process tag every span this
+        # engine emits carries, and the set of request ids whose trace
+        # lifecycle THIS engine owns (direct submissions — fleet
+        # streams are owned by the router, which emits the
+        # trace.request / trace.request_end records itself)
+        self._proc = f"replica-{self.replica_id or 0}"
+        self._trace_owned: set = set()
 
     # -- plumbing ----------------------------------------------------------
     def serve_dir(self) -> Optional[str]:
@@ -339,7 +353,8 @@ class ServingEngine:
                eos_token_id: Optional[int] = None,
                on_token: Optional[Callable] = None,
                deadline_ms: Optional[float] = None,
-               ttft_deadline_ms: Optional[float] = None) -> str:
+               ttft_deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> str:
         """Queue one request; returns its id.  ``on_token(request_id,
         token, finished)`` — when given — is invoked from the callback
         drain thread, decoupled from the step loop.
@@ -367,12 +382,23 @@ class ServingEngine:
                             ttft_deadline=(
                                 None if ttft_deadline_ms is None
                                 else now + float(ttft_deadline_ms) / 1e3))
+        # trace context (ISSUE 18): a fleet router passes its minted
+        # ``trace_id``; direct submissions mint (and own) their own, so
+        # standalone engines get waterfalls too
+        if trace_id is None:
+            trace_id = requesttrace.mint_trace_id(rid)
+            if trace_id is not None:
+                self._trace_owned.add(rid)
+        seq.trace_id = trace_id
         self.sched.submit(seq)
         self._submit_order.append(rid)
         reg = self._reg()
         reg.counter("serve.requests").inc()
         reg.emit("serve.request", request_id=rid, prompt_len=len(prompt),
-                 max_new_tokens=seq.max_new_tokens)
+                 max_new_tokens=seq.max_new_tokens, trace_id=trace_id)
+        if rid in self._trace_owned:
+            reg.emit("trace.request", trace_id=trace_id, request_id=rid,
+                     t0=now, prompt_len=len(prompt), proc=self._proc)
         self._update_gauges()
         return rid
 
@@ -392,6 +418,18 @@ class ServingEngine:
         return self.sched.queue_depth > self.shed_queue_depth
 
     # -- the step ----------------------------------------------------------
+    def _trace_end(self, seq: SequenceState, reason: str) -> None:
+        """Close an engine-owned trace at a terminal transition.  Fleet
+        streams are closed by the router (it observes the finish through
+        its own poll, which is the client-observed end)."""
+        if seq.trace_id is None or seq.request_id not in self._trace_owned:
+            return
+        self._trace_owned.discard(seq.request_id)
+        self._reg().emit("trace.request_end", trace_id=seq.trace_id,
+                         request_id=seq.request_id,
+                         t1=float(self.clock()), reason=reason,
+                         tokens=len(seq.output), proc=self._proc)
+
     def _evict(self, seq: SequenceState, reason: str) -> Dict[str, Any]:
         """Terminal eviction with reason ``deadline`` / ``cancelled``:
         free blocks, bump counters, emit the timeline record, and deliver
@@ -402,17 +440,18 @@ class ServingEngine:
         if reason == "cancelled":
             reg.counter("serve.cancelled").inc()
             reg.emit("serve.cancel", request_id=seq.request_id,
-                     generated=len(seq.output))
+                     generated=len(seq.output), trace_id=seq.trace_id)
         else:
             reg.counter("serve.deadline_misses").inc()
             reg.emit("serve.deadline_miss", request_id=seq.request_id,
-                     generated=len(seq.output),
+                     generated=len(seq.output), trace_id=seq.trace_id,
                      miss=("ttft" if seq.first_token_time is None
                            and seq.ttft_deadline is not None else "total"))
+        self._trace_end(seq, reason)
         event = {"request_id": seq.request_id, "token": None,
                  "finished": True, "reason": reason}
         if seq.on_token is not None:
-            self._dispatch_callback(seq.on_token, event)
+            self._dispatch_callback(seq.on_token, event, seq)
         return event
 
     def _reap(self) -> List[Dict[str, Any]]:
@@ -461,12 +500,39 @@ class ServingEngine:
         for victim in plan.preempted:
             reg.counter("serve.preemptions").inc()
             reg.emit("serve.preempt", request_id=victim.request_id,
-                     generated=len(victim.output))
+                     generated=len(victim.output),
+                     trace_id=victim.trace_id)
+            now = float(self.clock())
+            requesttrace.emit_span(reg, victim.trace_id,
+                                   victim.request_id, "preempt",
+                                   "preempt", now, now, self._proc)
+        if plan.kind not in ("prefill", "decode"):
+            return []
+        # head-of-line stall: residents live on this engine but not in
+        # this step's batch wait the full step out.  When the served
+        # step is induced work (a recompute prefill), their stall is
+        # that cause's cost — the survivor decodes late *because of*
+        # the failover, not by scheduler bad luck.
+        stall_comp = "stall"
+        if plan.kind == "prefill" and plan.seqs:
+            why = plan.seqs[0].resume_why
+            if why:
+                stall_comp = _RESUME_COMPONENT.get(why, "stall")
+        served = {s.request_id for s in plan.seqs}
+        t_step0 = float(self.clock())
         if plan.kind == "prefill":
-            return self._run_prefill(plan)
-        if plan.kind == "decode":
-            return self._run_decode(plan)
-        return []
+            events = self._run_prefill(plan)
+        else:
+            events = self._run_decode(plan)
+        stalled = [(s.request_id, s.trace_id)
+                   for s in self.sched.running
+                   if s.request_id not in served and s.trace_id is not None]
+        if stalled:
+            requesttrace.emit_stall_span(reg, stalled, t_step0,
+                                         float(self.clock()), self._proc,
+                                         component=stall_comp,
+                                         cause=plan.kind)
+        return events
 
     def _recover_from_hang(self) -> List[Dict[str, Any]]:
         """Hung-step recovery: the watchdog already dumped every thread's
@@ -575,6 +641,7 @@ class ServingEngine:
     def _run_prefill(self, plan: StepPlan) -> List[Dict[str, Any]]:
         seq = plan.seqs[0]
         key = self._next_key()
+        t_prefill0 = float(self.clock())
         try:
             nxt_np, logits_np, new_caches = self._apply_prefill(
                 seq, plan.bucket, key)
@@ -585,7 +652,26 @@ class ServingEngine:
             return []
         self.cache.update_pages(new_caches)
         self.sched.mark_prefilled(seq)
-        self._reg().counter("serve.prefills").inc()
+        reg = self._reg()
+        reg.counter("serve.prefills").inc()
+        if seq.trace_id is not None:
+            # the (re-)prefill plus the queue wait before it; a
+            # recompute's wait is attributed to its cause, not "queue"
+            comp = _RESUME_COMPONENT.get(seq.resume_why, "prefill")
+            t_q0 = seq.trace_enqueued
+            if t_q0 is None:
+                t_q0 = seq.arrival
+            if t_prefill0 > t_q0:
+                requesttrace.emit_span(
+                    reg, seq.trace_id, seq.request_id, "queue",
+                    "queue" if seq.resume_why is None else comp,
+                    t_q0, t_prefill0, self._proc)
+            requesttrace.emit_span(reg, seq.trace_id, seq.request_id,
+                                   "prefill", comp, t_prefill0,
+                                   float(self.clock()), self._proc,
+                                   bucket=plan.bucket)
+        seq.resume_why = None
+        seq.trace_enqueued = None
         if seq.pending is not None:
             # recompute prefill after preemption: the next token was
             # already sampled (and streamed) before eviction — only the
@@ -597,6 +683,7 @@ class ServingEngine:
     def _run_decode(self, plan: StepPlan) -> List[Dict[str, Any]]:
         seqs = plan.seqs
         key = self._next_key()
+        t0 = float(self.clock())
         try:
             nxt_np, logits_np, new_caches = self._apply_decode(seqs, key)
         except StepTimeout:
@@ -619,6 +706,11 @@ class ServingEngine:
             self.sched.mark_decoded(s)
             events.append(self._accept_token(s, int(nxt_np[i]),
                                              logits_np[i], first=False))
+        # one batch-level decode span; the assembler amortizes the step
+        # across its residents to produce per-request decode time
+        requesttrace.emit_decode_span(
+            reg, [(s.request_id, s.trace_id) for s in seqs], len(seqs),
+            t0, float(self.clock()), self._proc)
         return events
 
     # -- poisoned-request quarantine ---------------------------------------
@@ -655,6 +747,7 @@ class ServingEngine:
         """Fault-boundary handler: identify the culprit rows, evict each
         with ``reason="poisoned"`` and a durable record, return the
         surviving sequences for replay."""
+        t0 = float(self.clock())
         if isinstance(error, _NonfiniteLogits):
             bad = set(error.request_ids)
             culprits = [s for s in seqs if s.request_id in bad]
@@ -664,6 +757,14 @@ class ServingEngine:
             culprits = self._bisect(seqs, key)
         for seq in culprits:
             self._quarantine(seq, error, kind)
+        # the bisect stalls every row in the faulted batch — attribute
+        # that time to quarantine for culprits and survivors alike
+        t1 = float(self.clock())
+        reg = self._reg()
+        for seq in seqs:
+            requesttrace.emit_span(reg, seq.trace_id, seq.request_id,
+                                   "quarantine_bisect", "quarantine",
+                                   t0, t1, self._proc)
         return [s for s in seqs if s not in culprits]
 
     def _quarantine(self, seq: SequenceState, error: Exception,
@@ -676,11 +777,13 @@ class ServingEngine:
                   "prompt_len": len(seq.prompt),
                   "generated": len(seq.output),
                   "output": list(seq.output),
+                  "trace_id": seq.trace_id,
                   "time": float(self.clock())}
         self.quarantined[seq.request_id] = record
         reg = self._reg()
         reg.counter("serve.poisoned").inc()
         reg.emit("serve.quarantine", **record)
+        self._trace_end(seq, "poisoned")
         if self.run_dir is not None:
             qdir = os.path.join(self.serve_dir(), "quarantine")
             os.makedirs(qdir, exist_ok=True)
@@ -691,7 +794,7 @@ class ServingEngine:
         event = {"request_id": seq.request_id, "token": None,
                  "finished": True, "reason": "poisoned"}
         if seq.on_token is not None:
-            self._dispatch_callback(seq.on_token, event)
+            self._dispatch_callback(seq.on_token, event, seq)
 
     def _accept_token(self, seq: SequenceState, token: int, logits_row,
                       first: bool) -> Dict[str, Any]:
@@ -718,16 +821,17 @@ class ServingEngine:
             reg.counter("serve.finished").inc()
             reg.emit("serve.finish", request_id=seq.request_id,
                      reason=reason, generated=len(seq.output),
-                     preemptions=seq.preemptions)
+                     preemptions=seq.preemptions, trace_id=seq.trace_id)
+            self._trace_end(seq, reason)
         event = {"request_id": seq.request_id, "token": token,
                  "finished": reason is not None, "reason": reason}
         if seq.on_token is not None:
-            self._dispatch_callback(seq.on_token, event)
+            self._dispatch_callback(seq.on_token, event, seq)
         return event
 
     # -- decoupled token callbacks ----------------------------------------
-    def _dispatch_callback(self, cb: Callable,
-                           event: Dict[str, Any]) -> None:
+    def _dispatch_callback(self, cb: Callable, event: Dict[str, Any],
+                           seq: Optional[SequenceState] = None) -> None:
         if self._cb_queue is None:
             self._cb_queue = queue.Queue()
             self._cb_thread = threading.Thread(
@@ -735,7 +839,8 @@ class ServingEngine:
                 daemon=True)
             self._cb_thread.start()
         self._cb_dispatched += 1
-        self._cb_queue.put((cb, event))
+        self._cb_queue.put((cb, event,
+                            None if seq is None else seq.trace_id))
 
     def _cb_worker(self) -> None:
         while True:
@@ -743,7 +848,8 @@ class ServingEngine:
             try:
                 if item is _CB_STOP:
                     return
-                cb, event = item
+                cb, event, trace_id = item
+                cb_t0 = float(self.clock())
                 try:
                     cb(event["request_id"], event["token"],
                        event["finished"])
@@ -758,6 +864,10 @@ class ServingEngine:
                     from ..framework.log import vlog
                     vlog(0, "serving: on_token callback failed for %s: %r",
                          event["request_id"], e)
+                requesttrace.emit_span(self._reg(), trace_id,
+                                       event["request_id"], "callback",
+                                       "callback", cb_t0,
+                                       float(self.clock()), self._proc)
             finally:
                 self._cb_queue.task_done()
 
@@ -900,7 +1010,14 @@ class ServingEngine:
                             "output": list(seq.output),
                             "max_new_tokens": seq.max_new_tokens,
                             "eos_token_id": seq.eos_token_id,
-                            "preemptions": seq.preemptions})
+                            "preemptions": seq.preemptions,
+                            # trace context survives the spill; ownership
+                            # transfers to whichever engine resumes it
+                            "trace_id": seq.trace_id,
+                            "trace_owner": seq.request_id in
+                            self._trace_owned,
+                            "resume_why": "migration"})
+            self._trace_owned.discard(seq.request_id)
             self.sched.evict(seq, "spilled")
             self.lifecycle_counts["spilled"] += 1
             self._reg().counter("serve.spilled").inc()
@@ -959,6 +1076,26 @@ class ServingEngine:
         seq.output = [int(t) for t in record.get("output", [])]
         seq.pending = seq.output[-1] if seq.output else None
         seq.preemptions = int(record.get("preemptions", 0))
+        # trace context (ISSUE 18): keep the record's trace_id so the
+        # assembled waterfall stitches across engines.  An explicit
+        # ``"trace_id": None`` is a deliberate decision (disabled or
+        # sampled out at the router) and must survive the process
+        # boundary; only a record WITHOUT the key (pre-tracing spill,
+        # direct admit) gets an engine-owned trace minted here
+        if "trace_id" in record:
+            seq.trace_id = record["trace_id"]
+            if seq.trace_id is not None and record.get("trace_owner"):
+                self._trace_owned.add(rid)
+        else:
+            seq.trace_id = requesttrace.mint_trace_id(rid)
+            if seq.trace_id is not None:
+                self._trace_owned.add(rid)
+                self._reg().emit("trace.request", trace_id=seq.trace_id,
+                                 request_id=rid, t0=seq.arrival,
+                                 prompt_len=len(seq.prompt),
+                                 proc=self._proc)
+        if seq.output:
+            seq.resume_why = record.get("resume_why") or "failover"
         self.sched.submit(seq)
         self._submit_order.append(seq.request_id)
         self._reg().counter("serve.resumed").inc()

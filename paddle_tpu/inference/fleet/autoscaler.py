@@ -45,15 +45,16 @@ from ...framework.errors import enforce
 from ...framework.log import vlog
 
 __all__ = ["MIN_ENV", "MAX_ENV", "SCALE_WINDOW_SECS_ENV",
-           "SCALE_COOLDOWN_SECS_ENV", "default_fleet_min",
-           "default_fleet_max", "default_scale_window_secs",
-           "default_scale_cooldown_secs", "ServingSLO",
-           "FleetAutoscaler"]
+           "SCALE_COOLDOWN_SECS_ENV", "SLO_SOURCE_ENV",
+           "default_fleet_min", "default_fleet_max",
+           "default_scale_window_secs", "default_scale_cooldown_secs",
+           "default_slo_source", "ServingSLO", "FleetAutoscaler"]
 
 MIN_ENV = "PTPU_FLEET_MIN"
 MAX_ENV = "PTPU_FLEET_MAX"
 SCALE_WINDOW_SECS_ENV = "PTPU_FLEET_SCALE_WINDOW_SECS"
 SCALE_COOLDOWN_SECS_ENV = "PTPU_FLEET_SCALE_COOLDOWN_SECS"
+SLO_SOURCE_ENV = "PTPU_FLEET_SLO_SOURCE"
 
 
 def default_fleet_min() -> int:
@@ -70,6 +71,18 @@ def default_scale_window_secs() -> float:
 
 def default_scale_cooldown_secs() -> float:
     return float(os.environ.get(SCALE_COOLDOWN_SECS_ENV, "30"))
+
+
+def default_slo_source() -> str:
+    """Whose latency tails the autoscaler burns on: ``engine`` =
+    per-replica engine-local p99s (the PR 17 behavior), ``router`` =
+    the router's client-observed ``fleet.ttft_ms``/``fleet.tpot_ms``
+    tails, which include queueing, retries and failover recompute
+    (ISSUE 18)."""
+    src = os.environ.get(SLO_SOURCE_ENV, "engine").strip().lower()
+    enforce(src in ("engine", "router"),
+            f"{SLO_SOURCE_ENV}={src!r}: expected 'engine' or 'router'")
+    return src
 
 
 class ServingSLO:
@@ -133,11 +146,18 @@ class FleetAutoscaler:
                  window_secs: Optional[float] = None,
                  burn_threshold: float = 0.5,
                  cooldown_secs: Optional[float] = None,
+                 slo_source: Optional[str] = None,
                  registry=None,
                  clock: Callable[[], float] = time.monotonic):
         self.manager = manager
         self.router = router
         self.slo = slo if slo is not None else ServingSLO()
+        self.slo_source = (slo_source if slo_source is not None
+                           else default_slo_source())
+        enforce(self.slo_source in ("engine", "router"),
+                f"bad slo_source {self.slo_source!r}")
+        enforce(self.slo_source != "router" or router is not None,
+                "slo_source='router' needs a router")
         self.min_replicas = int(min_replicas if min_replicas is not None
                                 else default_fleet_min())
         self.max_replicas = int(max_replicas if max_replicas is not None
@@ -174,7 +194,7 @@ class FleetAutoscaler:
         """One observation: per-replica SLO verdicts folded into a
         (burning, idle) window sample."""
         now = float(self.clock())
-        violations: Dict[int, List[str]] = {}
+        violations: Dict[Any, List[str]] = {}
         pressure = 0.0
         for idx in self.active_ids():
             replica = self.manager.replicas[idx]
@@ -182,12 +202,20 @@ class FleetAutoscaler:
                 stats = replica.serving_stats()
             except ConnectionError:
                 continue              # census handles dead/flapping
-            v = self.slo.violations(stats)
-            if v:
-                violations[idx] = v
+            if self.slo_source == "engine":
+                v = self.slo.violations(stats)
+                if v:
+                    violations[idx] = v
             pressure += (float(stats.get("queue_depth", 0))
                          + float(stats.get("waiting", 0))
                          + float(stats.get("running", 0)))
+        if self.slo_source == "router":
+            # burn on the client-observed tails: the router's numbers
+            # include queueing, retries and failover recompute — the
+            # components engine-local p99s cannot see (ISSUE 18)
+            v = self.slo.violations(self.router.slo_stats())
+            if v:
+                violations["router"] = v
         burning = bool(violations)
         idle = pressure == 0.0
         self._window.append((now, burning, idle))
@@ -251,9 +279,12 @@ class FleetAutoscaler:
             return None
         burn = self.burn_fraction()
         if burn >= self.burn_threshold:
-            why = "; ".join(f"replica {i}: {', '.join(v)}"
-                            for i, v in sorted(obs["violations"].items())
-                            ) or f"burn {burn:.2f} over window"
+            why = "; ".join(
+                (f"{i}: {', '.join(v)}" if isinstance(i, str)
+                 else f"replica {i}: {', '.join(v)}")
+                for i, v in sorted(obs["violations"].items(),
+                                   key=str)
+                ) or f"burn {burn:.2f} over window"
             if n >= self.max_replicas:
                 self._last_action_at = now
                 self._emit("blocked_at_max", n, n, why)
@@ -291,4 +322,5 @@ class FleetAutoscaler:
                 "idle": round(self.idle_fraction(), 3),
                 "samples": len(self._window),
                 "actions": dict(self.actions),
-                "slo": self.slo.describe()}
+                "slo": self.slo.describe(),
+                "slo_source": self.slo_source}

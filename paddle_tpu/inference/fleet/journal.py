@@ -12,10 +12,16 @@ alone and completions stay token-exact across a router SIGKILL.
 File format (one JSON object per line):
 
     {"v": 1, "kind": "open", "request_id": ..., "prompt": [...],
-     "max_new_tokens": N, "eos_token_id": E, "session": S}
-    {"kind": "disp", "replica": R}              # dispatched/failed-over
+     "max_new_tokens": N, "eos_token_id": E, "session": S,
+     "trace_id": T}
+    {"kind": "disp", "replica": R, "trace_id": T}   # dispatched/failed-over
     {"kind": "tok", "t": [t0, t1, ...]}        # accepted tokens
     {"kind": "fin", "reason": "length"}         # terminal marker
+
+Every line additionally carries ``ts`` (wall clock) and ``mono``
+(monotonic) stamps (ISSUE 18) so the trace assembler can align WAL
+events with router/worker spans and order them within a file even
+across wall-clock steps.
 
 Recovery follows the ``aggregate.StreamTail`` / ledger reader
 discipline: only complete lines count — a torn tail (the append the
@@ -36,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ...utils import fsio
@@ -82,6 +89,11 @@ class JournalStore:
         return os.path.join(self.directory, safe + _SUFFIX)
 
     def _append(self, request_id: str, payload: Dict[str, Any]) -> None:
+        # every WAL line is timestamped (ISSUE 18): wall clock for
+        # cross-process trace alignment, monotonic for intra-file
+        # ordering that survives wall-clock steps
+        payload.setdefault("ts", time.time())
+        payload.setdefault("mono", time.monotonic())
         fsio.append_bytes(self._path(request_id),
                           (json.dumps(payload) + "\n").encode())
         self.appends += 1
@@ -90,7 +102,8 @@ class JournalStore:
     def open(self, request_id: str, prompt: Sequence[int],
              max_new_tokens: int, eos_token_id: Optional[int],
              session: Optional[str] = None,
-             tokens: Sequence[int] = ()) -> None:
+             tokens: Sequence[int] = (),
+             trace_id: Optional[str] = None) -> None:
         """Durably record a stream's existence (before first dispatch).
         ``tokens`` seeds an already-accepted prefix — the re-journal
         path when recovery itself crashes before finishing."""
@@ -98,7 +111,8 @@ class JournalStore:
                      {"v": 1, "kind": "open", "request_id": request_id,
                       "prompt": [int(t) for t in prompt],
                       "max_new_tokens": int(max_new_tokens),
-                      "eos_token_id": eos_token_id, "session": session})
+                      "eos_token_id": eos_token_id, "session": session,
+                      "trace_id": trace_id})
         if tokens:
             self.append_tokens(request_id, tokens)
 
@@ -177,7 +191,9 @@ class JournalStore:
                 "eos_token_id": header.get("eos_token_id"),
                 "session": header.get("session"),
                 "tokens": tokens, "finished": finished,
-                "reason": reason, "replica": replica}
+                "reason": reason, "replica": replica,
+                "trace_id": header.get("trace_id"),
+                "opened_ts": header.get("ts")}
 
     def recover(self) -> List[Dict[str, Any]]:
         """Every stream's durable state, oldest-first — the input

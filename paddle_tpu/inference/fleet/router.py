@@ -58,10 +58,12 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence
 
 from ...framework.errors import enforce
 from ...framework.log import vlog
+from ...observability import requesttrace
 from .health import CircuitBreaker, get_retry_budget
 from .journal import JournalStore
 
@@ -75,6 +77,12 @@ RETRY_MAX_ENV = "PTPU_FLEET_RETRY_MAX"
 RETRY_BACKOFF_MS_ENV = "PTPU_FLEET_RETRY_BACKOFF_MS"
 SHED_QUEUE_DEPTH_ENV = "PTPU_FLEET_SHED_QUEUE_DEPTH"
 
+#: seconds a stream's coalesced "deliver" span may stay open before
+#: the router flushes it (finish always flushes).  Bounds both the
+#: span-emission rate on the pump hot path and the deliver coverage a
+#: router crash can lose.
+DELIVER_FLUSH_S = 0.25
+
 
 def default_retry_max() -> int:
     return int(os.environ.get(RETRY_MAX_ENV, "3"))
@@ -86,6 +94,15 @@ def default_retry_backoff_ms() -> float:
 
 def default_shed_queue_depth() -> int:
     return int(os.environ.get(SHED_QUEUE_DEPTH_ENV, "64"))
+
+
+def _pctl(values, p: float) -> Optional[float]:
+    """Nearest-rank percentile over a small sample; None when empty."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(len(ordered) * p / 100.0))
+    return float(ordered[idx])
 
 
 class FleetOverloaded(RuntimeError):
@@ -106,7 +123,8 @@ class StreamJournal:
 
     def __init__(self, request_id: str, prompt: Sequence[int],
                  max_new_tokens: int, eos_token_id: Optional[int],
-                 session: Optional[str] = None):
+                 session: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -117,15 +135,43 @@ class StreamJournal:
         self.reason: Optional[str] = None
         self.replica_id: Optional[int] = None
         self.failovers = 0
+        # request tracing (ISSUE 18): the fleet-wide trace context plus
+        # the router-side (client-observed) clock marks.  All wall
+        # clock — spans must compare across processes on this host.
+        self.trace_id = trace_id
+        self.resume_why: Optional[str] = None   # stamps re-dispatches
+        self.submit_wall: float = time.time()
+        self.first_token_wall: Optional[float] = None
+        self.last_token_wall: Optional[float] = None
+        self.last_progress_wall: Optional[float] = None
+        self.end_wall: Optional[float] = None
+        self.ttft_ms: Optional[float] = None
+        # start of the not-yet-emitted "deliver" stretch.  Deliver
+        # spans chain contiguously poll-to-poll, so the router
+        # coalesces them and flushes one span per ~DELIVER_FLUSH_S
+        # (or at finish) — same interval union as per-poll emission
+        # at a fraction of the hot-path emit cost.
+        self.deliver_open_wall: Optional[float] = None
+        # router-observed per-component milliseconds (the /statusz
+        # slow_requests breakdown; the full waterfall needs the
+        # assembler)
+        self.components: Dict[str, float] = {}
 
     def record(self) -> Dict[str, Any]:
-        """Spill-format record re-admitting this stream mid-flight."""
-        return {"request_id": self.request_id,
-                "prompt": list(self.prompt),
-                "output": list(self.tokens),
-                "max_new_tokens": self.max_new_tokens,
-                "eos_token_id": self.eos_token_id,
-                "preemptions": 0}
+        """Spill-format record re-admitting this stream mid-flight.
+        ``trace_id`` carries the trace context across the process
+        boundary; ``resume_why`` tells the receiving engine what to
+        attribute the recompute-prefill to."""
+        out = {"request_id": self.request_id,
+               "prompt": list(self.prompt),
+               "output": list(self.tokens),
+               "max_new_tokens": self.max_new_tokens,
+               "eos_token_id": self.eos_token_id,
+               "preemptions": 0,
+               "trace_id": self.trace_id}
+        if self.resume_why is not None:
+            out["resume_why"] = self.resume_why
+        return out
 
 
 class Router:
@@ -186,6 +232,13 @@ class Router:
                       else None)
         self.recovered = {"streams": 0, "reattached": 0,
                           "redispatched": 0, "finished": 0}
+        # client-observed latency tails (ISSUE 18): measured at the
+        # router, so queueing / retries / failover recompute are all
+        # inside the number — the gap to the engine-local serve.* SLO
+        # histograms is itself the signal
+        self._ttft_ms: Deque[float] = deque(maxlen=512)
+        self._tpot_ms: Deque[float] = deque(maxlen=512)
+        self._recent: Deque[Dict[str, Any]] = deque(maxlen=64)
         if recover is not None:
             self._recover()
 
@@ -194,6 +247,18 @@ class Router:
             return self._registry
         from ...observability.registry import get_registry
         return get_registry()
+
+    def _span(self, journal: StreamJournal, name: str, component: str,
+              t0: float, t1: float, **fields) -> None:
+        """Emit one router-side trace span and fold its duration into
+        the journal's component breakdown (the /statusz table works
+        even when the stream is unsampled)."""
+        bucket = requesttrace.component_bucket(component)
+        journal.components[bucket] = (journal.components.get(bucket, 0.0)
+                                      + max(0.0, t1 - t0) * 1e3)
+        requesttrace.emit_span(self._reg(), journal.trace_id,
+                               journal.request_id, name, component,
+                               t0, t1, "router", **fields)
 
     # -- replica set -------------------------------------------------------
     def _available_ids(self) -> List[int]:
@@ -291,6 +356,13 @@ class Router:
         tried: List[str] = []
         backoff = self.retry_backoff_ms / 1e3
         first_free = fresh
+        # trace attribution: a failover/migration re-dispatch is that
+        # component's cost, not generic "dispatch"; backoff sleeps get
+        # their own segments so nothing is double-counted
+        comp = {"failover": "failover",
+                "migration": "migration"}.get(journal.resume_why,
+                                              "dispatch")
+        seg0 = time.time()
         for attempt in range(self.retry_max + 1):
             healthy = self._available_ids()
             for rid in self._pick(journal.session, healthy):
@@ -315,6 +387,8 @@ class Router:
                     reg.emit("fleet.deferred",
                              request_id=journal.request_id,
                              why="retry_budget")
+                    self._span(journal, "dispatch", comp, seg0,
+                               time.time(), deferred=True)
                     return None
                 try:
                     if self.dispatch_fault is not None:
@@ -330,15 +404,28 @@ class Router:
                     self._sessions[journal.session] = rid
                 if self.store is not None:
                     self.store._append(journal.request_id,
-                                       {"kind": "disp", "replica": rid})
+                                       {"kind": "disp", "replica": rid,
+                                        "trace_id": journal.trace_id})
                 reg.counter("fleet.dispatch").inc()
                 reg.emit("fleet.dispatch", request_id=journal.request_id,
                          replica=rid, attempt=attempt,
-                         resumed_at=len(journal.tokens))
+                         resumed_at=len(journal.tokens),
+                         trace_id=journal.trace_id)
+                now = time.time()
+                self._span(journal, "dispatch", comp, seg0, now,
+                           replica=rid, attempt=attempt)
+                journal.last_progress_wall = now
+                journal.resume_why = None
                 return rid
             if attempt < self.retry_max:
                 reg.counter("fleet.retries").inc()
+                now = time.time()
+                self._span(journal, "dispatch", comp, seg0, now,
+                           attempt=attempt)
                 self._sleep(backoff)
+                seg0 = time.time()
+                self._span(journal, "retry_backoff", "retry_backoff",
+                           now, seg0, attempt=attempt)
                 backoff *= 2
         if not fresh:
             reg.counter("fleet.deferred").inc()
@@ -378,18 +465,35 @@ class Router:
         enforce(request_id not in self.journals,
                 f"duplicate request id {request_id!r}")
         journal = StreamJournal(request_id, prompt, max_new_tokens,
-                                eos_token_id, session=session)
+                                eos_token_id, session=session,
+                                trace_id=requesttrace.mint_trace_id(
+                                    request_id))
         self.journals[request_id] = journal
         if self.store is not None:
             # write-ahead: the stream exists durably before dispatch
             self.store.open(request_id, journal.prompt, max_new_tokens,
-                            eos_token_id, session=session)
+                            eos_token_id, session=session,
+                            trace_id=journal.trace_id)
+        if journal.trace_id is not None:
+            # lifecycle open: the client-observed window starts here —
+            # before dispatch, so a refusal still closes to a complete
+            # trace instead of leaking orphan spans
+            self._reg().emit("trace.request", trace_id=journal.trace_id,
+                             request_id=request_id,
+                             t0=journal.submit_wall,
+                             prompt_len=len(journal.prompt),
+                             proc="router")
         self._reg().gauge("fleet.streams").set(float(len(
             [j for j in self.journals.values() if not j.finished])))
         try:
             self._dispatch(journal, fresh=True)
         except (FleetOverloaded, DispatchExhausted):
             # the client saw a refusal — no ghost stream may linger
+            if journal.trace_id is not None:
+                self._reg().emit("trace.request_end",
+                                 trace_id=journal.trace_id,
+                                 request_id=request_id, t1=time.time(),
+                                 reason="shed", tokens=0, proc="router")
             del self.journals[request_id]
             if self.store is not None:
                 self.store.discard(request_id)
@@ -423,8 +527,13 @@ class Router:
             journal = StreamJournal(rid, rec["prompt"],
                                     rec["max_new_tokens"],
                                     rec["eos_token_id"],
-                                    session=rec["session"])
+                                    session=rec["session"],
+                                    trace_id=rec.get("trace_id"))
             journal.tokens = list(rec["tokens"])
+            # the trace window survives the router crash: latency is
+            # still measured from the journaled open, not the restart
+            if rec.get("opened_ts") is not None:
+                journal.submit_wall = float(rec["opened_ts"])
             self.journals[rid] = journal
             self.recovered["streams"] += 1
             if rec["finished"]:
@@ -441,7 +550,9 @@ class Router:
                 self.recovered["reattached"] += 1
             else:
                 # orphaned (its replica died with the router): replay
-                # the journal record; None = deferred to pump()
+                # the journal record; None = deferred to pump().  The
+                # recompute this forces is failover cost.
+                journal.resume_why = "failover"
                 if self._dispatch(journal, fresh=False) is not None:
                     self.recovered["redispatched"] += 1
         if self.recovered["streams"]:
@@ -462,16 +573,64 @@ class Router:
         replica = self.replicas[journal.replica_id]
         out = replica.poll(journal.request_id, start=len(journal.tokens))
         new = [int(t) for t in out["tokens"]]
+        now = time.time()
+        if new or out["finished"]:
+            # client-observed delivery: the stretch since the router
+            # last saw progress on this stream.  Generation overlaps
+            # it, so the assembler charges "deliver" only the residue
+            # no other span covers (poll starvation, HTTP lag) —
+            # emitted straight to the registry, NOT folded into the
+            # journal's component table, which tracks exclusive time.
+            # Consecutive stretches chain contiguously, so they are
+            # coalesced and flushed at finish or every DELIVER_FLUSH_S
+            # (bounding what a router crash can lose).
+            if journal.deliver_open_wall is None:
+                journal.deliver_open_wall = (journal.last_progress_wall
+                                             or journal.submit_wall)
+            if (out["finished"]
+                    or now - journal.deliver_open_wall >= DELIVER_FLUSH_S):
+                requesttrace.emit_span(self._reg(), journal.trace_id,
+                                       journal.request_id, "deliver",
+                                       "deliver",
+                                       journal.deliver_open_wall, now,
+                                       "router")
+                journal.deliver_open_wall = now
         if new:
             if self.store is not None:
                 # write-ahead: tokens are durable before they count
                 self.store.append_tokens(journal.request_id, new)
             journal.tokens.extend(new)
+            reg = self._reg()
+            if journal.first_token_wall is None:
+                journal.first_token_wall = now
+                ttft = (now - journal.submit_wall) * 1e3
+                journal.ttft_ms = ttft
+                reg.histogram("fleet.ttft_ms").observe(ttft)
+                self._ttft_ms.append(ttft)
+            elif journal.last_token_wall is not None:
+                # client-observed inter-token time, split evenly over
+                # the tokens this poll surfaced
+                per_tok = ((now - journal.last_token_wall)
+                           / len(new)) * 1e3
+                for _ in new:
+                    reg.histogram("fleet.tpot_ms").observe(per_tok)
+                    self._tpot_ms.append(per_tok)
+            journal.last_token_wall = now
+            journal.last_progress_wall = now
         if out["finished"]:
             journal.finished = True
             journal.reason = out.get("reason")
+            journal.end_wall = now
             if self.store is not None:
                 self.store.retire(journal.request_id, journal.reason)
+            if journal.trace_id is not None:
+                self._reg().emit("trace.request_end",
+                                 trace_id=journal.trace_id,
+                                 request_id=journal.request_id,
+                                 t1=now, reason=journal.reason,
+                                 tokens=len(journal.tokens),
+                                 proc="router")
+            self._recent.append(self._slow_row(journal, now))
         return bool(new) or journal.finished
 
     def _failover(self, journal: StreamJournal, why: str) -> None:
@@ -487,11 +646,20 @@ class Router:
         if (journal.session is not None
                 and self._sessions.get(journal.session) == dead):
             del self._sessions[journal.session]
+        # detection gap: from the stream's last observed progress to
+        # the moment the router noticed the replica was gone — the
+        # first component of the failover's latency cost
+        t_detect = time.time()
+        self._span(journal, "failover_detect", "failover",
+                   journal.last_progress_wall or t_detect, t_detect,
+                   from_replica=dead)
+        journal.resume_why = "failover"
         rid = self._dispatch(journal, fresh=False)
         reg.counter("fleet.failovers").inc()
         reg.emit("fleet.failover", request_id=journal.request_id,
                  from_replica=dead, to_replica=rid, why=why,
-                 accepted_tokens=len(journal.tokens))
+                 accepted_tokens=len(journal.tokens),
+                 trace_id=journal.trace_id)
         vlog(0, "fleet: failover %s replica %s -> %s (%s, %d tokens "
              "accepted)", journal.request_id, dead, rid, why,
              len(journal.tokens))
@@ -596,6 +764,11 @@ class Router:
             if (journal.session is not None
                     and self._sessions.get(journal.session) == rid):
                 del self._sessions[journal.session]
+            now = time.time()
+            self._span(journal, "migration_wait", "migration",
+                       journal.last_progress_wall or now, now,
+                       from_replica=rid)
+            journal.resume_why = "migration"
             self._dispatch(journal, fresh=True)
             migrated += 1
             self.migrations += 1
@@ -654,6 +827,50 @@ class Router:
                     base[i] = "flapping"
         return base
 
+    def _slow_row(self, journal: StreamJournal,
+                  now: Optional[float] = None) -> Dict[str, Any]:
+        """One ``slow_requests`` table row: client-observed latency so
+        far plus the router-side component breakdown."""
+        now = time.time() if now is None else now
+        end = journal.end_wall if journal.finished else now
+        return {"request_id": journal.request_id,
+                "trace_id": journal.trace_id,
+                "state": "finished" if journal.finished else "live",
+                "latency_ms": round(
+                    (end - journal.submit_wall) * 1e3, 3),
+                "ttft_ms": (None if journal.ttft_ms is None
+                            else round(journal.ttft_ms, 3)),
+                "tokens": len(journal.tokens),
+                "failovers": journal.failovers,
+                "replica": journal.replica_id,
+                "components": {k: round(v, 3) for k, v
+                               in sorted(journal.components.items())}}
+
+    def slow_requests(self, k: int = 8) -> List[Dict[str, Any]]:
+        """Top-``k`` slowest streams (in-flight + recently finished) by
+        client-observed latency — the ``/statusz`` tail table."""
+        now = time.time()
+        rows = [self._slow_row(j, now)
+                for j in self.journals.values() if not j.finished]
+        rows += list(self._recent)
+        rows.sort(key=lambda r: r["latency_ms"], reverse=True)
+        return rows[:max(0, int(k))]
+
+    def slo_stats(self) -> Dict[str, Any]:
+        """Client-observed SLO snapshot shaped like the engine's
+        ``serving_stats()`` — the ``PTPU_FLEET_SLO_SOURCE=router``
+        feed for :class:`..autoscaler.ServingSLO`."""
+        live = [j for j in self.journals.values() if not j.finished]
+        return {"queue_depth": self.fleet_depth(self._available_ids()),
+                "waiting": 0,
+                "running": len(live),
+                "slo": {"ttft_ms": {"p50": _pctl(self._ttft_ms, 50),
+                                    "p99": _pctl(self._ttft_ms, 99),
+                                    "samples": len(self._ttft_ms)},
+                        "tpot_ms": {"p50": _pctl(self._tpot_ms, 50),
+                                    "p99": _pctl(self._tpot_ms, 99),
+                                    "samples": len(self._tpot_ms)}}}
+
     def stats(self) -> Dict[str, Any]:
         """Fleet snapshot for ``/statusz`` and the doctor."""
         live = [j for j in self.journals.values() if not j.finished]
@@ -670,7 +887,9 @@ class Router:
                "sessions": len(self._sessions),
                "breakers": {i: br.snapshot()
                             for i, br in sorted(self.breakers.items())},
-               "retry_budget": self.budget.snapshot()}
+               "retry_budget": self.budget.snapshot(),
+               "slo": self.slo_stats()["slo"],
+               "slow_requests": self.slow_requests()}
         if self.store is not None:
             out["journal"] = {"live": self.store.live_count(),
                               "appends": self.store.appends,

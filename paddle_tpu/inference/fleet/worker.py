@@ -204,6 +204,20 @@ def main(argv=None) -> int:
         # worker without an operator-chosen run_dir gets a private one
         import tempfile
         run_dir = tempfile.mkdtemp(prefix="ptpu-fleet-worker-")
+    mdir = os.environ.get("PTPU_METRICS_DIR")
+    if mdir:
+        # request tracing (ISSUE 18): write this worker's stream under
+        # its own id (router owns worker-0) so the per-replica JSONL
+        # files merge without colliding, and flush every record so the
+        # SIGKILL victim's spans survive for the trace assembler
+        from ...observability.registry import get_registry
+        from ...observability.sinks import MetricsWriter
+        reg = get_registry()
+        for sink in list(reg.sinks):
+            if isinstance(sink, MetricsWriter):
+                reg.remove_sink(sink)
+        reg.add_sink(MetricsWriter(mdir, worker_id=args.replica_id + 1,
+                                   flush_every=1))
     engine = build_engine(spec, args.replica_id, run_dir=run_dir)
     serve_worker(engine, args.replica_id, port=args.port)
     return 0

@@ -113,6 +113,17 @@ class SequenceState:
     finish_reason: Optional[str] = None
     preemptions: int = 0
 
+    # request tracing (ISSUE 18): the fleet-wide trace context.
+    # ``trace_id`` is minted by the router (or the engine for direct
+    # submissions) and rides every ``trace.span`` this sequence emits;
+    # ``resume_why`` marks a recompute's cause ("preempt" / "failover" /
+    # "migration") so the next prefill span is attributed to it;
+    # ``trace_enqueued`` is the wall time the sequence (re-)entered the
+    # waiting queue — the start of its next queue span.
+    trace_id: Optional[str] = None
+    resume_why: Optional[str] = None
+    trace_enqueued: Optional[float] = None
+
     def context(self) -> List[int]:
         """Tokens needing cached KV before the next decode step.
         ``pending`` (invariantly ``output[-1]`` when set) is excluded:
@@ -183,6 +194,8 @@ class ContinuousBatchingScheduler:
         enforce(len(seq.prompt) >= 1, f"{seq.request_id}: empty prompt")
         seq.state = WAITING
         seq.arrival = seq.arrival or float(self.clock())
+        if seq.trace_enqueued is None:
+            seq.trace_enqueued = seq.arrival
         self.waiting.append(seq)
 
     @property
@@ -243,6 +256,10 @@ class ContinuousBatchingScheduler:
         seq.state = PREEMPTED
         seq.preemptions += 1
         self.preemptions += 1
+        # trace attribution (ISSUE 18): the wait + re-prefill this
+        # preemption causes belongs to the preemption, not to "queue"
+        seq.resume_why = "preempt"
+        seq.trace_enqueued = float(self.clock())
         # head of the queue: preempted work re-admits before new arrivals
         self.waiting.appendleft(seq)
 

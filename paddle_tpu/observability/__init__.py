@@ -38,7 +38,13 @@ they all report through:
 - :mod:`flight` — the crash flight recorder: a bounded ring of the
   newest records (``PTPU_FLIGHT_BUFFER``), dumped to
   ``<run_dir>/flight/worker-<i>.json`` on signals/atexit/fault paths
-  and ingested by the doctor when the JSONL tail was lost.
+  and ingested by the doctor when the JSONL tail was lost;
+- :mod:`requesttrace` — fleet request tracing (ISSUE 18): per-request
+  ``trace.span`` waterfalls stitched across router + replicas + WAL
+  by :class:`~paddle_tpu.observability.requesttrace.TraceAssembler`
+  (``python -m paddle_tpu.observability.requesttrace <run_dir>``),
+  with tail-latency attribution feeding the doctor's ``tail_latency``
+  verdict (knobs ``PTPU_TRACE_REQUESTS``, ``PTPU_TRACE_SAMPLE``).
 
 Emitters across the stack (hapi step breakdown, collective latencies,
 supervisor events) talk to :func:`get_registry` unconditionally; records
@@ -70,6 +76,8 @@ from .monitor import (LiveAggregator, StatusServer,
                       maybe_start_server)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
+from .requesttrace import (TraceAssembler, assemble_run, component_bucket,
+                           mint_trace_id, tail_latency_attribution)
 from .sinks import (MetricsWriter, PrometheusTextfile, StderrSummary,
                     default_interval, metrics_dir, render_prometheus)
 from .tracing import (export_chrome_trace, reset_tracing, span,
@@ -103,4 +111,9 @@ __all__ = [
     "MemorySampler", "get_sampler", "is_oom_error", "oom_postmortem",
     # run doctor (ISSUE 4)
     "diagnose", "render_report",
+    # request tracing (ISSUE 18) — the chrome exporter stays module-
+    # scoped (requesttrace.export_chrome_trace) to avoid shadowing the
+    # in-process tracing exporter above
+    "TraceAssembler", "assemble_run", "tail_latency_attribution",
+    "mint_trace_id", "component_bucket",
 ]

@@ -24,7 +24,8 @@ from ..utils import fsio
 from .sinks import metrics_dir
 
 __all__ = ["read_worker_stream", "StreamTail", "aggregate_run",
-           "straggler_stats", "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS"]
+           "straggler_stats", "export_chrome_trace",
+           "SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS"]
 
 _WORKER_RE = re.compile(r"^worker-(\d+)\.jsonl$")
 
@@ -276,16 +277,124 @@ def aggregate_run(run_dir: str,
     return summary
 
 
+def _read_all_workers(run_dir: str
+                      ) -> Dict[int, List[Dict[str, Any]]]:
+    mdir = metrics_dir(run_dir)
+    workers: Dict[int, List[Dict[str, Any]]] = {}
+    if not os.path.isdir(mdir):
+        return workers
+    for name in sorted(os.listdir(mdir)):
+        m = _WORKER_RE.match(name)
+        if m:
+            workers[int(m.group(1))] = read_worker_stream(
+                os.path.join(mdir, name))
+    return workers
+
+
+def export_chrome_trace(run_dir: str,
+                        out_path: Optional[str] = None) -> Optional[int]:
+    """Merge every worker stream into ONE multi-process Chrome/Perfetto
+    timeline (ISSUE 18 satellite).
+
+    The per-process ``tracing.export_chrome_trace`` stamps everything
+    with its own ``os.getpid()``, so naively concatenating worker
+    streams collapses all processes onto whatever pid the reader runs
+    as.  Here each ``worker-<i>.jsonl`` stream gets its own pid = i,
+    announced with a ``process_name`` metadata event (label taken from
+    the stream's own ``trace.span`` ``proc`` field — ``router`` for
+    worker-0, ``replica-<k>`` for engine workers — falling back to
+    ``worker-<i>``), and tracks within a process get ``thread_name``
+    metadata: one track per request, plus a shared ``decode`` track for
+    batch-level decode spans and a ``steps`` track for train/serve step
+    records.  Timestamps are wall-clock µs, matching
+    :func:`..requesttrace.chrome_trace_events`, so the two exports line
+    up when opened together.
+
+    Writes ``<run_dir>/metrics/trace.json`` unless ``out_path`` is
+    given; returns the event count, or None when the run has no
+    metrics."""
+    workers = _read_all_workers(run_dir)
+    if not workers:
+        return None
+    events: List[Dict[str, Any]] = []
+    for wid, records in sorted(workers.items()):
+        pid = wid
+        proc = next((str(r["proc"]) for r in records
+                     if str(r.get("kind", "")).startswith("trace.")
+                     and r.get("proc")), None)
+        label = proc or f"worker-{wid}"
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        tids: Dict[str, int] = {}
+
+        def track(name: str, pid=pid, tids=tids) -> int:
+            if name not in tids:
+                tids[name] = len(tids) + 1
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tids[name],
+                               "args": {"name": name}})
+            return tids[name]
+
+        for r in records:
+            kind = r.get("kind")
+            if kind == "trace.span":
+                t0, dur = r.get("t0"), r.get("dur_ms")
+                if t0 is None or dur is None:
+                    continue
+                if r.get("requests") is not None:   # batch decode span
+                    tname = "decode"
+                else:
+                    tname = str(r.get("request_id")
+                                or r.get("trace_id") or "spans")
+                events.append({
+                    "name": str(r.get("name")), "ph": "X",
+                    "cat": str(r.get("component") or "span"),
+                    "pid": pid, "tid": track(tname),
+                    "ts": float(t0) * 1e6, "dur": float(dur) * 1e3,
+                    "args": {k: r[k] for k in
+                             ("trace_id", "component", "residents")
+                             if r.get(k) is not None}})
+            elif kind == "step" and r.get("ts") is not None \
+                    and r.get("step_time_ms") is not None:
+                dur = float(r["step_time_ms"])
+                events.append({
+                    "name": f"step {r.get('step', '?')}", "ph": "X",
+                    "cat": "step", "pid": pid, "tid": track("steps"),
+                    "ts": (float(r["ts"]) - dur / 1e3) * 1e6,
+                    "dur": dur * 1e3,
+                    "args": {k: r[k] for k in ("step", "tokens", "mfu")
+                             if r.get(k) is not None}})
+    out_path = out_path or os.path.join(metrics_dir(run_dir),
+                                        "trace.json")
+    fsio.atomic_write_bytes(
+        out_path, json.dumps({"traceEvents": events,
+                              "displayTimeUnit": "ms"}).encode("utf-8"))
+    vlog(1, "observability: chrome trace %d events → %s", len(events),
+         out_path)
+    return len(events)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
+    chrome = None
+    if "--chrome" in args:
+        i = args.index("--chrome")
+        try:
+            chrome = args[i + 1]
+        except IndexError:
+            chrome = ""
+        del args[i:i + 2]
     if len(args) != 1:
         print("usage: python -m paddle_tpu.observability.aggregate "  # noqa: print
-              "<run_dir>", file=sys.stderr)
+              "<run_dir> [--chrome out.json]", file=sys.stderr)
         return 2
     summary = aggregate_run(args[0])
     if summary is None:
         print(f"no metrics under {args[0]}", file=sys.stderr)  # noqa: print
         return 1
+    if chrome is not None:
+        n = export_chrome_trace(args[0], chrome or None)
+        summary["chrome_trace_events"] = n
     print(json.dumps(summary, indent=1, default=str))  # noqa: print
     return 0
 

@@ -47,6 +47,12 @@ evidence lines):
                        deadline: the engine is underprovisioned for its
                        SLO (raise ``max_seqs`` / the KV pool, or shed
                        earlier).
+- ``tail_latency``   — request traces (ISSUE 18) name the dominant
+                       component of the p99-slowest requests by excess
+                       over the fleet-median breakdown (queue vs
+                       retry/backoff vs prefill vs decode vs
+                       failover-recompute vs preempt-recompute) — the
+                       request-centric view of "why is p99 slow".
 
 Verdicts are mirrored into ``supervisor_report.json`` (kind
 ``doctor.verdict``) so the run's one post-mortem file carries the
@@ -70,7 +76,7 @@ __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_comm_bound", "check_supervisor",
            "check_perf_regression", "check_perf_trend", "check_serving",
            "check_fleet", "check_fleet_flapping",
-           "check_fleet_slo_burn"]
+           "check_fleet_slo_burn", "check_tail_latency"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -777,6 +783,48 @@ def check_fleet_slo_burn(workers) -> List[Dict[str, Any]]:
     return findings
 
 
+def check_tail_latency(workers) -> List[Dict[str, Any]]:
+    """Request-trace verdict (ISSUE 18): assemble every ``trace.*``
+    record in the window into per-request waterfalls and name the
+    dominant component of the p99-slowest ones.  Severity scales with
+    how far the tail sits above the median — a tail that is just the
+    median again is healthy dispersion, not a finding."""
+    from .requesttrace import TraceAssembler, tail_latency_attribution
+    merged: List[Dict[str, Any]] = []
+    for recs in workers.values():
+        merged.extend(r for r in recs
+                      if str(r.get("kind", "")).startswith("trace."))
+    if not merged:
+        return []
+    result = TraceAssembler().from_records(merged)
+    att = tail_latency_attribution(result["traces"])
+    if att is None:
+        return []
+    p99, med = att["p99_ms"], att["median_ms"]
+    ratio = p99 / med if med > 0 else 1.0
+    if ratio < 1.2:
+        return []                     # flat tail — nothing to attribute
+    dom = att["dominant"]
+    worst = att["slow"][0] if att["slow"] else {}
+    ev = [f"p99 {p99:.1f}ms vs median {med:.1f}ms ({ratio:.1f}x) over "
+          f"{result['complete']} complete trace(s)",
+          f"dominant excess component: {dom} "
+          f"(+{att['excess'].get(dom, 0.0):.1f}ms over the median "
+          f"breakdown across the slow set)"]
+    if worst:
+        ev.append(f"slowest: {worst.get('request_id')} "
+                  f"{worst.get('latency_ms'):.1f}ms, breakdown "
+                  f"{worst.get('components')}")
+    ev.append("waterfalls: python -m "
+              "paddle_tpu.observability.requesttrace <run_dir>")
+    return [_finding(
+        "tail_latency", 30 + 30 * min(1.0, (ratio - 1.2) / 3.0),
+        f"p99 latency dominated by {dom} ({ratio:.1f}x the median)",
+        ev, dominant=dom, p99_ms=p99, median_ms=med,
+        excess=att["excess"], slow=att["slow"][:4],
+        orphan_spans=len(result["orphan_spans"]))]
+
+
 def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     """Run every check against ``run_dir``; returns the diagnosis dict
     (findings ranked most-severe first) or ``None`` when the run left no
@@ -808,6 +856,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_fleet(workers)
     findings += check_fleet_flapping(workers)
     findings += check_fleet_slo_burn(workers)
+    findings += check_tail_latency(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

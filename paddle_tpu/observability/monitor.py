@@ -219,6 +219,11 @@ class StatusServer:
             fleet = {
                 "replicas": states or None,
                 "streams": gauge("fleet.streams"),
+                # client-observed latency tails (ISSUE 18) — next to
+                # the engine-local serve.* histograms so the gap is
+                # visible at a glance
+                "ttft_ms": hist("fleet.ttft_ms"),
+                "tpot_ms": hist("fleet.tpot_ms"),
                 "dispatch": counter("fleet.dispatch"),
                 "retries": counter("fleet.retries"),
                 "failovers": counter("fleet.failovers"),
@@ -601,6 +606,7 @@ class LiveAggregator:
         findings += doctor.check_fleet(workers)
         findings += doctor.check_fleet_flapping(workers)
         findings += doctor.check_fleet_slo_burn(workers)
+        findings += doctor.check_tail_latency(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

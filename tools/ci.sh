@@ -37,11 +37,20 @@ if (( SHARD == 0 )); then
     # sharding happens to place its files elsewhere
     python -m pytest -q -m faults tests/test_fault_tolerance.py \
         tests/test_supervisor.py
-    # telemetry tier (ISSUE 3/4/5): registry/tracing/sinks/aggregation +
+    # telemetry tier (ISSUE 3/4/5/18): registry/tracing/sinks/aggregation +
     # compile/memory/doctor diagnosis + live monitor/flight recorder +
-    # the e2e records contracts
+    # the e2e records contracts + request-trace continuity (failover,
+    # migration, router crash-recovery, preemption, quarantine)
     python -m pytest -q -m telemetry tests/test_observability.py \
-        tests/test_doctor.py tests/test_monitor.py
+        tests/test_doctor.py tests/test_monitor.py \
+        tests/test_request_trace.py
+    # request-trace chaos drill (ISSUE 18 acceptance): 8 ragged streams
+    # through a 2-replica fleet, one replica SIGKILLed mid-stream —
+    # every request must assemble into exactly ONE waterfall (the
+    # victims stitched across both replicas), coverage >= 95%, and the
+    # tail-latency doctor must name failover recompute as the dominant
+    # p99 component
+    JAX_PLATFORMS=cpu python examples/serve_fleet.py --trace_drill
     # live-monitor smoke (ISSUE 5): a supervised run with the status
     # server on an ephemeral port; scrape /healthz + /metrics mid-fit
     # and assert a known instrument is exposed
@@ -193,6 +202,29 @@ PYEOF
     # serve_fleet smoke row into the ledger (advisory gate on first rows)
     JAX_PLATFORMS=cpu python -m paddle_tpu.bench \
         --scenario serve_fleet --smoke
+    # trace overhead bound (ISSUE 18 acceptance): request tracing must
+    # cost < 1% of the router-pump step p50 — the row just appended
+    # carries the metered emit-path cost (emission_cost), the
+    # untraced-vs-traced p50s, and the assembled coverage
+    python - <<'PYEOF'
+import json
+from paddle_tpu.bench.ledger import default_ledger_path
+rows = [json.loads(l)
+        for l in open(default_ledger_path(), encoding="utf-8")
+        if l.strip()]
+row = next(r for r in reversed(rows)
+           if r.get("scenario") == "serve_fleet")
+ex = row["extra"]
+frac = ex["trace_overhead_frac"]
+assert frac < 0.01, \
+    f"request tracing overhead {frac:.3%} >= 1% of pump step p50"
+assert ex["traces_assembled"] >= 4, ex
+assert ex["traces_complete"] == ex["traces_assembled"], ex
+assert ex["trace_orphan_spans"] == 0, ex
+print(f"trace overhead: {frac:.3%} of pump step p50 (< 1% bound), "
+      f"{ex['traces_complete']} traces complete, coverage p50 "
+      f"{ex['trace_coverage_p50']:.0%}")
+PYEOF
     # kernels tier (ISSUE 7): Pallas/fused-op parity — flash attention,
     # fused block (both routes), fused CE, rope cache
     python -m pytest -q -m kernels tests/test_ops.py tests/test_fused_block.py
@@ -453,10 +485,10 @@ PYEOF
     # warm-start drill (ROADMAP 5a): the persistent-compile-cache test is
     # `slow` (two fresh jax processes), so tier-1 skips it — run it here
     python -m pytest -q -m slow tests/test_compile_cache.py
-    echo "api-guard + ptlint + faults tier + telemetry tier + doctor" \
-         "smoke + monitor smoke + serving tier + serve smoke + serve" \
-         "chaos drill + drain smoke + fleet tier + fleet drills +" \
-         "kernels tier + fused-block smoke" \
+    echo "api-guard + ptlint + faults tier + telemetry tier + trace" \
+         "drill + doctor smoke + monitor smoke + serving tier + serve" \
+         "smoke + serve chaos drill + drain smoke + fleet tier + fleet" \
+         "drills + trace overhead + kernels tier + fused-block smoke" \
          "+ comm tier + comm smoke + elastic tier + elastic smoke +" \
          "integrity tier + integrity smoke + integrity overhead +" \
          "bench smoke + perf tier + trends + dashboard + warm-start ok"
