@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional
 
 from ..utils import fsio
 from . import ledger
-from .schema import PHASES
+from .schema import GAP_SINKS, PHASES
 
 __all__ = ["attribute", "diff_rows", "render", "main"]
 
@@ -79,7 +79,7 @@ def attribute(base: Dict[str, Any],
     explained = sum(m["delta_ms"] for m in movers)
     comp_b = float((base.get("compile") or {}).get("wall_ms", 0.0) or 0.0)
     comp_c = float((cur.get("compile") or {}).get("wall_ms", 0.0) or 0.0)
-    return {
+    out = {
         "movers": movers,
         "dominant": dominant,
         "step_p50_delta_ms": total_delta,
@@ -87,6 +87,27 @@ def attribute(base: Dict[str, Any],
                             else total_delta - explained),
         "compile_wall_delta_ms": comp_c - comp_b,
     }
+    # MFU-gap movers (ISSUE 19): only when *both* rows carry a roofline
+    # block — doctor's regression check builds row-alikes without one,
+    # and v1 rows predate the block entirely.
+    base_gb = (base.get("roofline") or {}).get("buckets_ms")
+    cur_gb = (cur.get("roofline") or {}).get("buckets_ms")
+    if isinstance(base_gb, dict) and isinstance(cur_gb, dict):
+        gap_movers: List[Dict[str, Any]] = []
+        for s in GAP_SINKS:
+            if s == "mxu":   # useful-work bucket, not a gap sink
+                continue
+            b = float(base_gb.get(s, 0.0) or 0.0)
+            c = float(cur_gb.get(s, 0.0) or 0.0)
+            gap_movers.append({"sink": s, "base_ms": b, "cur_ms": c,
+                               "delta_ms": c - b,
+                               "ratio": (c / b) if b > 0 else None})
+        gap_movers.sort(key=lambda m: -m["delta_ms"])
+        out["gap_movers"] = gap_movers
+        out["gap_dominant"] = (gap_movers[0]["sink"]
+                               if gap_movers and gap_movers[0]["delta_ms"] > 0
+                               else None)
+    return out
 
 
 def diff_rows(base: Dict[str, Any], cur: Dict[str, Any],
@@ -148,6 +169,15 @@ def render(report: Dict[str, Any]) -> str:
     if ua is not None:
         lines.append(f"    {'unattributed':<10} {ua:+.2f}ms "
                      "(p50 delta not explained by phases)")
+    if att.get("gap_movers"):
+        lines.append("  MFU-gap sinks (roofline bucket delta, worst "
+                     "first):")
+        for m in att["gap_movers"]:
+            mark = (" <-- dominant"
+                    if m["sink"] == att.get("gap_dominant") else "")
+            lines.append(
+                f"    {m['sink']:<14} {_fmt_ms(m['base_ms'])} -> "
+                f"{_fmt_ms(m['cur_ms'])}  ({m['delta_ms']:+.2f}ms){mark}")
     cw = att.get("compile_wall_delta_ms") or 0.0
     if abs(cw) > 1.0:
         lines.append(f"  compile wall moved {cw:+.0f}ms (one-time cost, "

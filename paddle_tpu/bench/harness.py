@@ -28,8 +28,8 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["measure_steps", "CompileWindow", "peak_hbm", "xla_memory",
-           "bytes_on_wire", "tpu_reachable", "pct"]
+__all__ = ["measure_steps", "CompileWindow", "RooflineWindow", "peak_hbm",
+           "xla_memory", "bytes_on_wire", "tpu_reachable", "pct"]
 
 
 def pct(sorted_vals: List[float], p: float) -> Optional[float]:
@@ -170,6 +170,38 @@ class CompileWindow:
             "persistent_hits": int(hits - self._pc0[0]),
             "persistent_requests": int(reqs - self._pc0[1]),
         }
+
+
+class RooflineWindow:
+    """Bracket one scenario with the MFU-microscope capture (ISSUE 19):
+    on entry the roofline observatory starts recording the abstract
+    signatures ``track_jit`` sees; :meth:`block` lowers + compiles each
+    captured program (outside any timed region) and returns the row's
+    ``roofline`` gap-budget block.  Never raises — a failed capture
+    degrades to the phase-only block so the row still validates.
+    """
+
+    def __enter__(self) -> "RooflineWindow":
+        from ..observability import roofline
+        self._win = roofline.capture_window()
+        self._win.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._win.__exit__(*exc)
+
+    def block(self, step_times_ms: List[float],
+              phases_ms: Dict[str, float], *,
+              padding_frac: float = 0.0) -> Dict[str, Any]:
+        p50 = pct(sorted(float(t) for t in step_times_ms), 50) or 0.0
+        try:
+            return self._win.build_block(p50, phases_ms,
+                                         padding_frac=padding_frac)
+        except Exception as e:
+            from ..observability import roofline
+            return roofline.degraded_block(
+                p50, phases_ms, padding_frac=padding_frac,
+                reason=f"capture failed: {e!r}")
 
 
 def xla_memory(jitted, *args) -> Optional[Dict[str, int]]:

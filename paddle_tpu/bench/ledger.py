@@ -48,6 +48,11 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "comm_int8_max_loss_rel": 0.01,
     "comm_zero1_max_loss_rel": 1e-4,
     "comm_zero1_min_state_shrink": 4.0,
+    # MFU microscope (ISSUE 19): the modeled-vs-measured reconciliation
+    # bound — |roofline residual| must stay under this fraction of the
+    # measured step p50 on every smoke row (enforced by
+    # `python -m paddle_tpu.observability.roofline` in the perf tier)
+    "roofline_max_residual_frac": 0.35,
 }
 
 

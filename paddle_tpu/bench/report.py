@@ -23,9 +23,10 @@ from typing import Any, Dict, List, Optional
 
 from ..utils import fsio
 from . import ledger, trends
-from .schema import METRICS
+from .schema import CORE_METRICS, GAP_SINKS
 
-__all__ = ["sparkline_svg", "render_html", "write_report", "main"]
+__all__ = ["sparkline_svg", "gap_bar_svg", "render_html", "write_report",
+           "main"]
 
 _METRIC_LABEL = {
     "step_p50": "step p50 (ms)",
@@ -33,6 +34,21 @@ _METRIC_LABEL = {
     "compile_wall_ms": "compile wall (ms)",
     "bytes_on_wire": "bytes on wire",
     "peak_hbm_bytes": "peak HBM",
+    "roofline_coverage": "roofline coverage",
+}
+_METRIC_LABEL.update({f"gap_{_s}_ms": f"gap:{_s} (ms)"
+                      for _s in GAP_SINKS if _s != "mxu"})
+
+# stacked-bar palette for the MFU gap budget (ISSUE 19); mxu is the
+# useful-work segment, everything else is gap
+_SINK_COLOR = {
+    "mxu": "#2f855a",
+    "memory_bound": "#d69e2e",
+    "comm": "#3182ce",
+    "host": "#805ad5",
+    "padding": "#dd6b20",
+    "unknown_device": "#718096",
+    "residual": "#c53030",
 }
 
 _CSS = """
@@ -103,6 +119,29 @@ def sparkline_svg(values: List[float],
     return "".join(parts)
 
 
+def gap_bar_svg(buckets: Dict[str, float], measured_ms: float,
+                width: int = 340, height: int = 18) -> str:
+    """One horizontal stacked bar of the MFU-gap budget: a colored
+    segment per sink, widths proportional to bucket ms over the measured
+    step time (negative buckets — e.g. an over-modeled residual — get
+    zero width; their sign still shows in the numbers table)."""
+    parts = [f"<svg class='spark' width='{width}' height='{height}' "
+             f"viewBox='0 0 {width} {height}' role='img'>"]
+    total = max(float(measured_ms), 1e-12)
+    x = 0.0
+    for s in GAP_SINKS:
+        w = width * max(0.0, float(buckets.get(s, 0.0) or 0.0)) / total
+        if w < 0.5:
+            continue
+        parts.append(f"<rect x='{x:.1f}' y='0' width='{w:.1f}' "
+                     f"height='{height}' "
+                     f"fill='{_SINK_COLOR.get(s, '#a0aec0')}'>"
+                     f"<title>{html.escape(s)}</title></rect>")
+        x += w
+    parts.append("</svg>")
+    return "".join(parts)
+
+
 def _esc(v: Any) -> str:
     return html.escape(str(v))
 
@@ -153,7 +192,9 @@ def _collect_events(analyses: List[Dict[str, Any]]
 
 
 def render_html(analyses: List[Dict[str, Any]],
-                ledger_path: Optional[str] = None) -> str:
+                ledger_path: Optional[str] = None,
+                latest_rows: Optional[Dict[str, Dict[str, Any]]] = None
+                ) -> str:
     """The whole dashboard as one HTML string (no external assets)."""
     window = trends.trend_window()
     k = trends.trend_k()
@@ -187,17 +228,18 @@ def render_html(analyses: List[Dict[str, Any]],
                + "</b>worst flakiness</div>")
     out.append("</div>")
 
-    # per-scenario sparkline matrix
+    # per-scenario sparkline matrix (core axes only — the gap-bucket
+    # axes get their own budget section below)
     out.append("<h2>Series</h2><table><tr><th>scenario</th><th>mode</th>"
                "<th>partition</th>"
                + "".join(f"<th>{_esc(_METRIC_LABEL[m])}</th>"
-                         for m in METRICS)
+                         for m in CORE_METRICS)
                + "<th>trend</th></tr>")
     for a in analyses:
         out.append(f"<tr><td>{_esc(a['scenario'])}</td>"
                    f"<td>{_esc(a['mode'])}</td>"
                    f"<td>{_esc(a.get('partition') or '—')}</td>")
-        for m in METRICS:
+        for m in CORE_METRICS:
             an = a["metrics"].get(m) or {}
             vals = an.get("values") or []
             if not vals:
@@ -212,6 +254,54 @@ def render_html(analyses: List[Dict[str, Any]],
         step = a["metrics"].get("step_p50") or {}
         out.append(f"<td>{_trend_cell(step.get('trend'))}</td></tr>")
     out.append("</table>")
+
+    # MFU gap budgets (ISSUE 19): roofline attribution of the newest row
+    # per scenario — where the gap between achieved and peak went
+    out.append("<h2>MFU gap budgets (roofline, newest row)</h2>")
+    roof_rows = [(name, row) for name, row in sorted(
+                     (latest_rows or {}).items())
+                 if isinstance((row.get("roofline") or {})
+                               .get("buckets_ms"), dict)]
+    if not roof_rows:
+        out.append("<p class='flat'>no roofline data yet — rows predate "
+                   "schema v2 or the observatory was disabled.</p>")
+    else:
+        out.append("<table><tr><th>scenario</th><th>budget</th>"
+                   "<th>measured</th><th>modeled</th>"
+                   "<th>dominant sink</th><th>coverage</th>"
+                   "<th>buckets (ms)</th></tr>")
+        for name, row in roof_rows:
+            roof = row["roofline"]
+            buckets = roof.get("buckets_ms") or {}
+            measured = float(roof.get("measured_step_ms") or 0.0)
+            cov = roof.get("coverage")
+            dom = roof.get("dominant_sink")
+            nums = ", ".join(
+                f"{s}={float(buckets.get(s, 0.0) or 0.0):.2f}"
+                for s in GAP_SINKS)
+            flags = []
+            if roof.get("degraded"):
+                flags.append("degraded")
+            if roof.get("injected"):
+                flags.append("injected")
+            dom_cell = _esc(dom or "—") + (
+                f" <small class='flat'>[{', '.join(flags)}]</small>"
+                if flags else "")
+            out.append(
+                f"<tr><td>{_esc(name)} ({_esc(row.get('mode'))})</td>"
+                f"<td>{gap_bar_svg(buckets, measured)}</td>"
+                f"<td class='num'>{measured:.2f}ms</td>"
+                f"<td class='num'>"
+                f"{float(roof.get('modeled_step_ms') or 0.0):.2f}ms</td>"
+                f"<td>{dom_cell}</td>"
+                f"<td class='num'>"
+                + (f"{float(cov):.1%}" if cov is not None else "—")
+                + f"</td><td><small>{_esc(nums)}</small></td></tr>")
+        out.append("</table>")
+        legend = " &middot; ".join(
+            f"<span style='color:{_SINK_COLOR[s]}'>&#9632;</span> "
+            f"{_esc(s)}" for s in GAP_SINKS)
+        out.append(f"<p class='meta'>{legend}</p>")
 
     # regression / event table
     out.append("<h2>Changepoints &amp; drifts</h2>")
@@ -263,9 +353,13 @@ def write_report(path: Optional[str] = None,
                  window: Optional[int] = None,
                  k: Optional[float] = None) -> str:
     """Render the dashboard to ``path`` (atomic write); returns it."""
-    analyses = trends.scan_ledger(path=ledger_path, mode=mode,
+    rows = ledger.read_ledger(ledger_path)
+    if mode is not None:
+        rows = [r for r in rows if r.get("mode") == mode]
+    analyses = trends.scan_ledger(rows=rows, mode=mode,
                                   window=window, k=k)
-    doc = render_html(analyses, ledger_path=ledger_path)
+    doc = render_html(analyses, ledger_path=ledger_path,
+                      latest_rows=ledger.latest_rows(rows))
     path = path or default_report_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     fsio.atomic_write_bytes(path, doc.encode("utf-8"))

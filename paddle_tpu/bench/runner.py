@@ -61,12 +61,18 @@ def run_scenario(name: str, mode: str = "smoke",
     maybe_enable_persistent_cache(registry=registry)
     fn = scenarios.get(name)
     wire = harness.bytes_on_wire(registry)
-    with harness.CompileWindow(registry) as cw:
+    with harness.CompileWindow(registry) as cw, \
+            harness.RooflineWindow() as rw:
         payload = fn(mode)
+    phases = payload.get("phases_ms") or {}
+    padding_frac = float(
+        (payload.get("extra") or {}).get("padding_frac") or 0.0)
+    roof = rw.block(payload["step_times_ms"], phases,
+                    padding_frac=padding_frac)
     row = schema.new_row(
         name, mode,
         step_times_ms=payload["step_times_ms"],
-        phases_ms=payload.get("phases_ms") or {},
+        phases_ms=phases,
         config=payload.get("config"),
         tokens_per_sec=payload.get("tokens_per_sec"),
         mfu=payload.get("mfu"),
@@ -74,6 +80,7 @@ def run_scenario(name: str, mode: str = "smoke",
         bytes_on_wire=wire.delta(),
         peak_hbm_bytes=payload.get("peak_hbm_bytes"),
         fallback_reason=fallback_reason,
+        roofline=roof,
         extra=payload.get("extra"),
     )
     # mirror the headline figures into the live registry so /statusz and
@@ -88,11 +95,32 @@ def run_scenario(name: str, mode: str = "smoke",
     for phase, ms in row["phases_ms"].items():
         registry.gauge(
             f"perf.phase_ms[scenario={name},phase={phase}]").set(ms)
+    rl = row.get("roofline") or {}
+    for sink, ms in (rl.get("buckets_ms") or {}).items():
+        registry.gauge(
+            f"roofline.bucket_ms[scenario={name},sink={sink}]").set(ms)
+    if isinstance(rl.get("coverage"), (int, float)):
+        registry.gauge(
+            f"roofline.coverage[scenario={name}]").set(rl["coverage"])
+    if isinstance(rl.get("modeled_step_ms"), (int, float)):
+        registry.gauge(
+            f"roofline.modeled_step_ms[scenario={name}]").set(
+                rl["modeled_step_ms"])
     registry.emit("bench.row", scenario=name, mode=mode,
                   step_time_p50_ms=p50, phases_ms=row["phases_ms"],
                   compile_wall_ms=row["compile"].get("wall_ms"),
                   device_kind=row["device_kind"],
-                  fallback_reason=fallback_reason)
+                  fallback_reason=fallback_reason,
+                  mfu=row["mfu"],
+                  roofline={
+                      "dominant_sink": rl.get("dominant_sink"),
+                      "coverage": rl.get("coverage"),
+                      "measured_step_ms": rl.get("measured_step_ms"),
+                      "modeled_step_ms": rl.get("modeled_step_ms"),
+                      "buckets_ms": rl.get("buckets_ms"),
+                      "injected": bool(rl.get("injected")),
+                      "device_known": (rl.get("device") or {}).get("known"),
+                  })
     return row
 
 

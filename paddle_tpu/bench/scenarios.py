@@ -422,7 +422,11 @@ def serve(mode: str) -> Dict[str, Any]:
                   "ttft_ms_p99": hpct("serve.ttft_ms", "p99"),
                   "tpot_ms_p50": hpct("serve.tpot_ms", "p50"),
                   "tpot_ms_p99": hpct("serve.tpot_ms", "p99"),
-                  "preemptions": engine.sched.preemptions},
+                  "preemptions": engine.sched.preemptions,
+                  # real-vs-padded token slots (ISSUE 19): pow2 prefill
+                  # buckets + fixed decode batch; feeds the roofline
+                  # padding sink so pad rows stop inflating serve MFU
+                  "padding_frac": round(engine.padding_frac(), 6)},
     }
 
 
@@ -549,6 +553,7 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
                     "generated": sum(len(r["tokens"]) for r in results),
                     "records": sink.records, "router": router,
                     "models": models, "n_requests": len(rids),
+                    "engines": [r.engine for r in replicas],
                     "emit_ms": emit_ms, "emit_count": emit_n}
         finally:
             if prev is None:
@@ -590,6 +595,10 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
                                 seq_len=cfg.max_position_embeddings,
                                 fwd_only=True)
     router = run["router"]
+    # fleet-wide padding: pooled real/slot counts across both replicas
+    pad_real = sum(e._pad_real_tokens for e in run["engines"])
+    pad_slots = sum(e._pad_slot_tokens for e in run["engines"])
+    padding_frac = (1.0 - pad_real / pad_slots) if pad_slots else 0.0
 
     return {
         "config": {"n_streams": n_streams, "max_new_tokens": max_new,
@@ -621,5 +630,6 @@ def serve_fleet(mode: str) -> Dict[str, Any]:
                   "trace_coverage_min": round(
                       coverages[0] if coverages else 0.0, 4),
                   "trace_component_median_ms": comp_medians,
-                  "tail_dominant": (attrib or {}).get("dominant")},
+                  "tail_dominant": (attrib or {}).get("dominant"),
+                  "padding_frac": round(padding_frac, 6)},
     }

@@ -32,21 +32,40 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES", "METRICS",
+           "CORE_METRICS", "GAP_SINKS", "GAP_METRICS",
            "fingerprint", "fingerprint_key", "metric_value", "new_row",
            "validate_row"]
 
-SCHEMA_VERSION = 1
-KNOWN_SCHEMA_VERSIONS = (1,)
+# v2 (ISSUE 19): every row carries a ``roofline`` MFU-gap budget block
+# whose buckets (with residual) sum to the measured step p50; v1 rows
+# remain readable — gap axes are simply None on them
+SCHEMA_VERSION = 2
+KNOWN_SCHEMA_VERSIONS = (1, 2)
 
 # the step-time decomposition perfdiff attributes regressions to; every
 # row carries all four (0.0 when a scenario has no such phase)
 PHASES = ("data", "compute", "readback", "collective")
 
+# the MFU-gap sink taxonomy (ISSUE 19) — a literal mirror of
+# ``observability.roofline.SINKS`` (pinned equal by a test) so this
+# module never imports the roofline at module scope
+GAP_SINKS = ("mxu", "memory_bound", "comm", "host", "padding",
+             "unknown_device", "residual")
+
+# the original five metric axes — what the report's sparkline table
+# shows; the gap axes below join them in the full trendable set
+CORE_METRICS = ("step_p50", "mfu", "compile_wall_ms", "bytes_on_wire",
+                "peak_hbm_bytes")
+
+# per-sink gap axes (mxu excluded — it is the useful part, not a gap)
+# plus the attribution-honesty coverage gauge
+GAP_METRICS = tuple("gap_%s_ms" % s for s in GAP_SINKS if s != "mxu") \
+    + ("roofline_coverage",)
+
 # the metric axes the trend engine models as per-scenario series
 # (ISSUE 14); each maps to one numeric field of the row via
 # :func:`metric_value`
-METRICS = ("step_p50", "mfu", "compile_wall_ms", "bytes_on_wire",
-           "peak_hbm_bytes")
+METRICS = CORE_METRICS + GAP_METRICS
 
 _MODES = ("smoke", "full")
 
@@ -64,6 +83,13 @@ def metric_value(row: Dict[str, Any], metric: str) -> Optional[float]:
         v = row.get("bytes_on_wire")
     elif metric == "peak_hbm_bytes":
         v = row.get("peak_hbm_bytes")
+    elif metric == "roofline_coverage":
+        v = (row.get("roofline") or {}).get("coverage")
+    elif metric.startswith("gap_") and metric.endswith("_ms"):
+        sink = metric[len("gap_"):-len("_ms")]
+        if sink not in GAP_SINKS:
+            raise KeyError(f"unknown metric {metric!r}; have {METRICS}")
+        v = ((row.get("roofline") or {}).get("buckets_ms") or {}).get(sink)
     else:
         raise KeyError(f"unknown metric {metric!r}; have {METRICS}")
     return float(v) if isinstance(v, (int, float)) else None
@@ -115,12 +141,16 @@ def new_row(scenario: str, mode: str, *,
             bytes_on_wire: int = 0,
             peak_hbm_bytes: Optional[int] = None,
             fallback_reason: Optional[str] = None,
+            roofline: Optional[Dict[str, Any]] = None,
             extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-    """Assemble one schema-v1 row from a scenario's measurements.
+    """Assemble one schema-v2 row from a scenario's measurements.
 
     ``step_times_ms`` is the raw per-step series (percentiles are
     computed here so every scenario uses the same definition);
     ``phases_ms`` maps each :data:`PHASES` entry to its per-step p50.
+    ``roofline`` is the MFU-gap budget block from a capture window; when
+    omitted, a degraded phase-only block is synthesized so every v2 row
+    still carries buckets that sum to the measured step time.
     """
     times = sorted(float(t) for t in step_times_ms)
 
@@ -132,6 +162,13 @@ def new_row(scenario: str, mode: str, *,
         return times[idx]
 
     fp = fingerprint()
+    if roofline is None:
+        from ..observability.roofline import degraded_block
+        roofline = degraded_block(
+            pct(50) or 0.0,
+            {p: float(phases_ms.get(p, 0.0) or 0.0) for p in PHASES},
+            padding_frac=float((extra or {}).get("padding_frac") or 0.0),
+            reason="producer passed no roofline block")
     row: Dict[str, Any] = {
         "schema_version": SCHEMA_VERSION,
         "scenario": str(scenario),
@@ -155,6 +192,7 @@ def new_row(scenario: str, mode: str, *,
         "bytes_on_wire": int(bytes_on_wire),
         "peak_hbm_bytes": (None if peak_hbm_bytes is None
                            else int(peak_hbm_bytes)),
+        "roofline": roofline,
         "extra": dict(extra or {}),
     }
     return row
@@ -216,4 +254,49 @@ def validate_row(row: Any) -> List[str]:
             errors.append(f"{opt_num} must be null or a number")
     if not isinstance(row.get("extra", {}), dict):
         errors.append("extra must be an object")
+    if row.get("schema_version") == 2:
+        errors.extend(_validate_roofline(row))
+    return errors
+
+
+def _validate_roofline(row: Dict[str, Any]) -> List[str]:
+    """The v2 contract: a complete gap-bucket set whose values (with
+    residual) sum to the block's measured step time — a roofline block
+    that doesn't reconcile with itself must never reach the ledger."""
+    errors: List[str] = []
+    rl = row.get("roofline")
+    if not isinstance(rl, dict):
+        return ["schema v2 row missing roofline block"]
+    measured = rl.get("measured_step_ms")
+    if not isinstance(measured, (int, float)):
+        errors.append("roofline.measured_step_ms missing/invalid")
+        measured = None
+    buckets = rl.get("buckets_ms")
+    if not isinstance(buckets, dict):
+        errors.append("roofline.buckets_ms missing")
+    else:
+        total = 0.0
+        complete = True
+        for s in GAP_SINKS:
+            v = buckets.get(s)
+            if not isinstance(v, (int, float)):
+                errors.append(f"roofline.buckets_ms.{s} missing/invalid")
+                complete = False
+            else:
+                total += float(v)
+        if complete and measured is not None:
+            tol = max(0.01, 0.005 * abs(float(measured)))
+            if abs(total - float(measured)) > tol:
+                errors.append(
+                    "roofline buckets sum %.4fms != measured %.4fms"
+                    % (total, float(measured)))
+    cov = rl.get("coverage")
+    if not isinstance(cov, (int, float)) or not (0.0 <= cov <= 1.0):
+        errors.append("roofline.coverage must be in [0, 1]")
+    if rl.get("dominant_sink") not in GAP_SINKS:
+        errors.append("roofline.dominant_sink must be one of GAP_SINKS")
+    dev = rl.get("device")
+    if not isinstance(dev, dict) or not isinstance(
+            dev.get("known"), bool):
+        errors.append("roofline.device.known missing/invalid")
     return errors

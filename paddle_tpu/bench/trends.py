@@ -38,7 +38,7 @@ import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import ledger
-from .schema import METRICS, PHASES
+from .schema import GAP_SINKS, METRICS, PHASES
 
 __all__ = ["DEFAULT_WINDOW", "DEFAULT_K", "MIN_SHIFT_FRAC",
            "SMALL_SERIES_FLOOR", "trend_window", "trend_k", "median",
@@ -234,6 +234,27 @@ def median_row(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "mfu": med_of(lambda r: r.get("mfu")),
         "bytes_on_wire": med_of(lambda r: r.get("bytes_on_wire")),
         "peak_hbm_bytes": med_of(lambda r: r.get("peak_hbm_bytes")),
+        # ISSUE 19: per-sink medians so perfdiff's gap attribution works
+        # against a median baseline too (only when any window row has a
+        # roofline block — v1-only windows stay block-free)
+        "roofline": _median_roofline(rows, med_of),
+    }
+
+
+def _median_roofline(rows: Sequence[Dict[str, Any]],
+                     med_of) -> Optional[Dict[str, Any]]:
+    if not any(isinstance((r.get("roofline") or {}).get("buckets_ms"),
+                          dict) for r in rows):
+        return None
+    return {
+        "buckets_ms": {s: (med_of(lambda r, _s=s:
+                                  ((r.get("roofline") or {})
+                                   .get("buckets_ms") or {}).get(_s)) or 0.0)
+                       for s in GAP_SINKS},
+        "coverage": med_of(lambda r:
+                           (r.get("roofline") or {}).get("coverage")),
+        "measured_step_ms": med_of(
+            lambda r: (r.get("roofline") or {}).get("measured_step_ms")),
     }
 
 
@@ -379,7 +400,13 @@ _METRIC_FMT = {
     "compile_wall_ms": ("compile wall", lambda v: f"{v:.0f}ms"),
     "bytes_on_wire": ("bytes on wire", lambda v: f"{v:,.0f}B"),
     "peak_hbm_bytes": ("peak HBM", lambda v: f"{v / (1 << 20):.1f}MiB"),
+    "roofline_coverage": ("roofline coverage", lambda v: f"{v:.1%}"),
 }
+# gap-bucket axes (ISSUE 19): one trendable series per non-mxu sink
+_METRIC_FMT.update({
+    f"gap_{_s}_ms": (f"gap:{_s}", lambda v: f"{v:.2f}ms")
+    for _s in GAP_SINKS if _s != "mxu"
+})
 
 
 def _fmt_metric(metric: str, v: Optional[float]) -> str:

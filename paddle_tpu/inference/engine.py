@@ -283,6 +283,12 @@ class ServingEngine:
         # trace.request / trace.request_end records itself)
         self._proc = f"replica-{self.replica_id or 0}"
         self._trace_owned: set = set()
+        # padding-waste accounting (ISSUE 19): pow2 prefill buckets and
+        # fixed-shape decode both process padded slots; real-vs-padded
+        # counts feed serve.padding_frac (and the bench row's roofline
+        # padding sink) so padded rows stop inflating tokens/s and MFU
+        self._pad_real_tokens = 0
+        self._pad_slot_tokens = 0
 
     # -- plumbing ----------------------------------------------------------
     def serve_dir(self) -> Optional[str]:
@@ -598,6 +604,7 @@ class ServingEngine:
         L = len(ctx)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :L] = ctx
+        self._note_padding(L, bucket)
         tables = self.cache.table_array([seq.request_id],
                                         self.sched.max_blocks_per_seq)
         lens = np.asarray([L], np.int32)
@@ -614,6 +621,7 @@ class ServingEngine:
     def _apply_decode(self, seqs: List[SequenceState], key):
         B = self.max_seqs
         enforce(len(seqs) <= B, f"{len(seqs)} decode rows > max_seqs {B}")
+        self._note_padding(len(seqs), B)
         sids = [s.request_id for s in seqs] + \
             [_PAD_SEQ] * (B - len(seqs))
         ids = np.zeros((B, 1), np.int32)
@@ -1130,6 +1138,28 @@ class ServingEngine:
         return [self.admit_record(rec) for rec in payload["spilled"]]
 
     # -- observability ------------------------------------------------------
+    def _note_padding(self, real: int, total: int) -> None:
+        """One padded launch (prefill bucket or fixed decode batch):
+        ``real`` of ``total`` token slots carried actual work.  Keeps
+        the cumulative ``serve.padding_frac`` gauge current."""
+        real = max(0, int(real))
+        total = max(real, int(total))
+        self._pad_real_tokens += real
+        self._pad_slot_tokens += total
+        reg = self._reg()
+        reg.counter("serve.tokens_real").inc(real)
+        reg.counter("serve.tokens_padded").inc(total - real)
+        if self._pad_slot_tokens:
+            reg.gauge("serve.padding_frac").set(
+                1.0 - self._pad_real_tokens / self._pad_slot_tokens)
+
+    def padding_frac(self) -> float:
+        """Cumulative fraction of launched token slots that were pad
+        (0.0 before any launch)."""
+        if not self._pad_slot_tokens:
+            return 0.0
+        return 1.0 - self._pad_real_tokens / self._pad_slot_tokens
+
     def _update_gauges(self) -> None:
         reg = self._reg()
         c = self.sched.counts()
@@ -1166,6 +1196,9 @@ class ServingEngine:
                           "balanced": leak["balanced"]},
             "load_shed": {"active": self.should_shed(),
                           "queue_threshold": self.shed_queue_depth},
+            "padding": {"real_tokens": self._pad_real_tokens,
+                        "padded_slots": self._pad_slot_tokens,
+                        "frac": self.padding_frac()},
             "slo": {"ttft_ms": {"p50": _pctl(self._ttft_ms, 50),
                                 "p99": _pctl(self._ttft_ms, 99),
                                 "samples": len(self._ttft_ms)},

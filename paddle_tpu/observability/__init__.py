@@ -39,6 +39,17 @@ they all report through:
   newest records (``PTPU_FLIGHT_BUFFER``), dumped to
   ``<run_dir>/flight/worker-<i>.json`` on signals/atexit/fault paths
   and ingested by the doctor when the JSONL tail was lost;
+- :mod:`roofline` — the MFU microscope (ISSUE 19): per-program
+  ``cost_analysis()`` + parsed HLO captured for every jitted step the
+  compile tracker sees (:class:`~paddle_tpu.observability.roofline
+  .RooflineObservatory`), fitted against the per-``device_kind``
+  roofline (:func:`~paddle_tpu.observability.mfu.device_spec`) into a
+  modeled step time and an **MFU-gap budget** with named sinks
+  (memory-bound, exposed comm, host gaps, padding waste, unknown
+  device, residual); lands in every bench row (schema v2), feeds the
+  doctor's ``mfu_gap`` verdict and the ``/statusz`` roofline section
+  (knobs ``PTPU_HLO_DUMP_DIR``, ``PTPU_HLO_DUMP_KEEP``,
+  ``PTPU_ROOFLINE_TEST_INFLATE``);
 - :mod:`requesttrace` — fleet request tracing (ISSUE 18): per-request
   ``trace.span`` waterfalls stitched across router + replicas + WAL
   by :class:`~paddle_tpu.observability.requesttrace.TraceAssembler`
@@ -76,8 +87,11 @@ from .monitor import (LiveAggregator, StatusServer,
                       maybe_start_server)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
+from .mfu import DEVICE_SPECS, device_spec
 from .requesttrace import (TraceAssembler, assemble_run, component_bucket,
                            mint_trace_id, tail_latency_attribution)
+from .roofline import (RooflineObservatory, capture_window, degraded_block,
+                       gap_budget, get_observatory, parse_hlo_ops)
 from .sinks import (MetricsWriter, PrometheusTextfile, StderrSummary,
                     default_interval, metrics_dir, render_prometheus)
 from .tracing import (export_chrome_trace, reset_tracing, span,
@@ -116,4 +130,9 @@ __all__ = [
     # in-process tracing exporter above
     "TraceAssembler", "assemble_run", "tail_latency_attribution",
     "mint_trace_id", "component_bucket",
+    # MFU microscope (ISSUE 19) — note `mfu` above is the *function*;
+    # the device table lives in the mfu module, re-exported here
+    "DEVICE_SPECS", "device_spec",
+    "RooflineObservatory", "get_observatory", "capture_window",
+    "gap_budget", "degraded_block", "parse_hlo_ops",
 ]

@@ -285,6 +285,7 @@ def track_jit(fn: Callable, name: Optional[str] = None,
     def tracked(*args, **kwargs):
         tr = tracker or get_tracker()
         sigs = names = None
+        abstract = None
         try:
             # signatures BEFORE the call: donated buffers are gone after
             all_args = list(args) + [kwargs[k] for k in sorted(kwargs)]
@@ -294,13 +295,29 @@ def track_jit(fn: Callable, name: Optional[str] = None,
                 names = names[:len(args)] + sorted(kwargs)
         except Exception:
             sigs = None  # tracking must never break the call
+        if sigs is not None:
+            try:
+                # abstract shapes too, and for the same reason: the
+                # roofline observatory re-lowers this signature later,
+                # after any donated buffers are dead (ISSUE 19)
+                from . import roofline
+                if roofline.capture_active():
+                    abstract = roofline.abstractify(args, kwargs)
+            except Exception:
+                abstract = None
         t0 = time.perf_counter()
         result = fn(*args, **kwargs)
         if sigs is not None:
             try:
                 wall_ms = (time.perf_counter() - t0) * 1e3
-                tr.observe_signatures(sigs, name=name, arg_names=names,
-                                      wall_ms=wall_ms)
+                rec = tr.observe_signatures(sigs, name=name,
+                                            arg_names=names,
+                                            wall_ms=wall_ms)
+                if abstract is not None:
+                    roofline.get_observatory().record(
+                        name, fn, abstract[0], abstract[1],
+                        sig_key=hash(tuple(sigs)),
+                        miss=rec is not None)
             except Exception as e:
                 from ..framework.log import vlog
                 vlog(1, "observability: compile tracking failed for %s: "

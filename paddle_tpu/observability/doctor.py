@@ -76,7 +76,8 @@ __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_comm_bound", "check_supervisor",
            "check_perf_regression", "check_perf_trend", "check_serving",
            "check_fleet", "check_fleet_flapping",
-           "check_fleet_slo_burn", "check_tail_latency"]
+           "check_fleet_slo_burn", "check_tail_latency",
+           "check_mfu_gap"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -86,6 +87,8 @@ STRAGGLER_REL_SPREAD = 0.2  # p99 spread / median step time
 DATA_STARVED_FRAC = 0.3     # data_ms / step_time_ms
 COMM_BOUND_FRAC = 0.25      # collective.<op>.ms p50 / step p50 (override
                             # with PTPU_COMM_BOUND_FRAC)
+MFU_GAP_FRAC = 0.25         # dominant roofline gap sink / measured step
+                            # (override with PTPU_MFU_GAP_FRAC)
 
 
 def _finding(kind: str, severity: float, title: str,
@@ -825,6 +828,84 @@ def check_tail_latency(workers) -> List[Dict[str, Any]]:
         orphan_spans=len(result["orphan_spans"]))]
 
 
+def check_mfu_gap(workers) -> List[Dict[str, Any]]:
+    """MFU-microscope verdict (ISSUE 19): ``bench.row`` records carry a
+    slim roofline gap budget; when one named sink eats more than
+    ``PTPU_MFU_GAP_FRAC`` (default 0.25) of the measured step, the doctor
+    names it.  ``unknown_device`` and ``residual`` get honest wording —
+    they mean the microscope could not attribute, not that the step is
+    fine.  A synthetic drill row (``injected``) is flagged as such so the
+    CI assertion and a human reading the report both see it is staged."""
+    frac = float(os.environ.get("PTPU_MFU_GAP_FRAC", MFU_GAP_FRAC))
+    newest: Dict[str, Dict[str, Any]] = {}
+    for records in workers.values():
+        for r in records:
+            if r.get("kind") != "bench.row":
+                continue
+            roof = r.get("roofline")
+            if not isinstance(roof, dict) or not isinstance(
+                    roof.get("buckets_ms"), dict):
+                continue
+            name = str(r.get("scenario"))
+            prev = newest.get(name)
+            if prev is None or (r.get("ts") or 0) >= (prev.get("ts") or 0):
+                newest[name] = r
+    findings = []
+    for name in sorted(newest):
+        r = newest[name]
+        roof = r["roofline"]
+        buckets = roof["buckets_ms"]
+        measured = float(roof.get("measured_step_ms") or 0.0)
+        if measured <= 0:
+            continue
+        dom = roof.get("dominant_sink")
+        dom_ms = float(buckets.get(dom, 0.0) or 0.0) if dom else 0.0
+        share = dom_ms / measured
+        if dom is None or dom == "mxu" or share <= frac:
+            continue
+        cov = roof.get("coverage")
+        if dom == "unknown_device":
+            what = ("device kind is not in the roofline table — the "
+                    "whole compute phase is unattributable (fix: add "
+                    "the device to observability.mfu.DEVICE_SPECS)")
+        elif dom == "residual":
+            what = ("time the roofline model cannot explain — treat "
+                    "the rest of this budget as a lower bound, not a "
+                    "diagnosis")
+        else:
+            what = {
+                "memory_bound": "HBM-bandwidth-bound ops dominate — the "
+                                "MXU is waiting on memory",
+                "comm": "exposed (unoverlapped) collectives dominate",
+                "host": "host-side data/readback gaps dominate",
+                "padding": "batch/sequence padding burns the largest "
+                           "share of compute",
+            }.get(dom, dom)
+        ev = [f"dominant gap sink: {dom} {dom_ms:.2f}ms of "
+              f"{measured:.2f}ms measured ({share:.0%}, threshold "
+              f"{frac:.0%})",
+              "buckets: " + ", ".join(
+                  f"{k}={float(v or 0.0):.2f}ms"
+                  for k, v in buckets.items())]
+        if cov is not None:
+            ev.append(f"model coverage {float(cov):.0%} "
+                      "(1 - |residual|/measured)")
+        if roof.get("injected"):
+            ev.append("NOTE: synthetic drill — this gap was injected "
+                      "via PTPU_ROOFLINE_TEST_INFLATE")
+        ev.append("full budget: python -m "
+                  "paddle_tpu.observability.roofline")
+        findings.append(_finding(
+            "mfu_gap", 25 + 40 * min(1.0, (share - frac) / 0.5),
+            f"{name}: MFU gap dominated by {dom} "
+            f"({share:.0%} of the step) — {what}",
+            ev, scenario=name, dominant=dom, share=share,
+            measured_step_ms=measured, coverage=cov,
+            injected=bool(roof.get("injected")),
+            mfu=r.get("mfu")))
+    return findings
+
+
 def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     """Run every check against ``run_dir``; returns the diagnosis dict
     (findings ranked most-severe first) or ``None`` when the run left no
@@ -857,6 +938,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_fleet_flapping(workers)
     findings += check_fleet_slo_burn(workers)
     findings += check_tail_latency(workers)
+    findings += check_mfu_gap(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

@@ -25,28 +25,67 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-__all__ = ["PEAK_TFLOPS", "peak_flops_per_sec", "param_count",
+__all__ = ["PEAK_TFLOPS", "DEVICE_SPECS", "device_spec",
+           "peak_flops_per_sec", "param_count",
            "flops_per_token", "mfu", "readback_sync"]
 
-# bf16 peak matmul TFLOPs per chip by TPU generation (public specs);
-# CPU fallback uses a nominal figure so the math still runs in dev envs.
-PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+# Per-chip roofline specs by TPU generation (public datasheet figures):
+# bf16 peak matmul TFLOPs, int8 peak TOPs, and peak HBM bandwidth in
+# GB/s.  The bandwidth column is what turns the MFU table into a
+# roofline — machine balance (flops/byte at the ridge point) falls
+# straight out of bf16_tflops / hbm_gbps.
+DEVICE_SPECS = {
+    "v2":  {"bf16_tflops": 46.0,   "int8_tops": 46.0,   "hbm_gbps": 700.0},
+    "v3":  {"bf16_tflops": 123.0,  "int8_tops": 123.0,  "hbm_gbps": 900.0},
+    "v4":  {"bf16_tflops": 275.0,  "int8_tops": 275.0,  "hbm_gbps": 1228.0},
+    "v5e": {"bf16_tflops": 197.0,  "int8_tops": 394.0,  "hbm_gbps": 819.0},
+    "v5p": {"bf16_tflops": 459.0,  "int8_tops": 918.0,  "hbm_gbps": 2765.0},
+    "v6e": {"bf16_tflops": 918.0,  "int8_tops": 1836.0, "hbm_gbps": 1640.0},
+}
+
+# bf16 peak matmul TFLOPs per chip — kept as a derived view so every
+# pre-roofline caller (bench.py, hapi live MFU) keeps working unchanged.
+PEAK_TFLOPS = {gen: spec["bf16_tflops"] for gen, spec in DEVICE_SPECS.items()}
+
+# Nominal spec used when the device kind is not in the table (CPU dev
+# boxes, future TPU generations): MFU math still produces a number, but
+# roofline attribution routes the whole compute phase into the explicit
+# "unknown_device" sink instead of pretending the fit is meaningful.
+_NOMINAL_GEN = "v5e"
+
+
+def device_spec(device_kind: Optional[str] = None) -> dict:
+    """Resolve a device kind to its roofline spec.
+
+    Returns a dict with ``device_kind``, ``gen``, ``known`` plus the
+    ``bf16_tflops`` / ``int8_tops`` / ``hbm_gbps`` columns.  Unknown
+    kinds come back with ``known=False``, ``gen=None`` and nominal
+    figures — callers that attribute time (the roofline) must surface
+    that as an explicit ``"unknown_device"`` sink rather than silently
+    skipping attribution.  ``PALLAS_AXON_TPU_GEN`` overrides the lookup
+    the same way it always has for :func:`peak_flops_per_sec`.
+    """
+    if device_kind is None:
+        import jax
+        device_kind = getattr(jax.devices()[0], "device_kind", "")
+    kind = (device_kind or "").lower()
+    for gen, spec in DEVICE_SPECS.items():
+        if gen in kind:
+            return {"device_kind": device_kind, "gen": gen, "known": True,
+                    **spec}
+    env_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if env_gen in DEVICE_SPECS:
+        return {"device_kind": device_kind, "gen": env_gen, "known": True,
+                **DEVICE_SPECS[env_gen]}
+    return {"device_kind": device_kind, "gen": None, "known": False,
+            **DEVICE_SPECS[_NOMINAL_GEN]}
 
 
 def peak_flops_per_sec() -> float:
     """Peak bf16 FLOP/s of the first visible device (nominal v5e figure
     on CPU so dev-box MFU numbers exist — they are labelled by the
     device field every step record carries)."""
-    import jax
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "").lower()
-    for gen, tf in PEAK_TFLOPS.items():
-        if gen in kind:
-            return tf * 1e12
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    if gen in PEAK_TFLOPS:
-        return PEAK_TFLOPS[gen] * 1e12
-    return PEAK_TFLOPS["v5e"] * 1e12
+    return device_spec()["bf16_tflops"] * 1e12
 
 
 def param_count(params: Any) -> int:

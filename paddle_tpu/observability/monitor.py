@@ -367,6 +367,66 @@ class StatusServer:
             except Exception:  # noqa: swallow — statusz must render
                 perf["trends"] = None
         status["perf"] = perf or None
+        # MFU microscope (ISSUE 19): the bench runner mirrors each row's
+        # roofline gap budget into `roofline.*` gauges — statusz shows
+        # the per-scenario buckets, coverage, and the doctor's mfu_gap
+        # verdict so a glance answers "where did the step time go"
+        roofline: Dict[str, Any] = {}
+        try:
+            roof_scen: Dict[str, Dict[str, Any]] = {}
+            for name, m in snap.items():
+                if (not name.startswith("roofline.")
+                        or m.get("type") != "gauge"
+                        or "[scenario=" not in name):
+                    continue
+                metric, _, rest = name.partition("[scenario=")
+                label = rest[:-1]
+                if metric == "roofline.bucket_ms" and ",sink=" in label:
+                    sname, _, sink = label.partition(",sink=")
+                    roof_scen.setdefault(sname, {}).setdefault(
+                        "buckets_ms", {})[sink] = m["value"]
+                else:
+                    roof_scen.setdefault(label, {})[
+                        metric[len("roofline."):]] = m["value"]
+            if roof_scen:
+                roofline["scenarios"] = roof_scen
+                # row-alikes from the gauges → the doctor's verdict
+                # (measured = bucket sum: the budget's own invariant;
+                # dominant = largest non-mxu bucket, same rule the
+                # roofline block uses)
+                recs = []
+                for sname, v in roof_scen.items():
+                    buckets = v.get("buckets_ms") or {}
+                    if not buckets:
+                        continue
+                    gaps = {s: b for s, b in buckets.items()
+                            if s != "mxu"}
+                    dom = (max(gaps, key=lambda s: gaps[s])
+                           if gaps and max(gaps.values()) > 0 else None)
+                    recs.append({
+                        "kind": "bench.row", "scenario": sname,
+                        "roofline": {
+                            "buckets_ms": buckets,
+                            "measured_step_ms": sum(
+                                float(b or 0.0)
+                                for b in buckets.values()),
+                            "dominant_sink": dom,
+                            "coverage": v.get("coverage"),
+                        }})
+                try:
+                    from .doctor import check_mfu_gap
+                    verdicts = check_mfu_gap({0: recs})
+                except Exception:  # noqa: swallow — statusz must render
+                    verdicts = []
+                roofline["mfu_gap"] = ([
+                    {"scenario": f["data"].get("scenario"),
+                     "dominant": f["data"].get("dominant"),
+                     "share": f["data"].get("share"),
+                     "injected": f["data"].get("injected"),
+                     "title": f["title"]} for f in verdicts] or None)
+        except Exception:  # noqa: swallow — statusz must render
+            roofline = {}
+        status["roofline"] = roofline or None
         if sup is not None:
             if status["step"] is None:
                 status["step"] = sup.gstep
@@ -607,6 +667,7 @@ class LiveAggregator:
         findings += doctor.check_fleet_flapping(workers)
         findings += doctor.check_fleet_slo_burn(workers)
         findings += doctor.check_tail_latency(workers)
+        findings += doctor.check_mfu_gap(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

@@ -91,7 +91,7 @@ def test_append_rejects_invalid_row(tmp_path):
 def test_torn_tail_and_foreign_schema_tolerated(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     good = _mk_row()
-    foreign = dict(_mk_row(scenario="from_the_future"), schema_version=2)
+    foreign = dict(_mk_row(scenario="from_the_future"), schema_version=99)
     fsio.append_bytes(path, (json.dumps(good) + "\n").encode())
     fsio.append_bytes(path, (json.dumps(foreign) + "\n").encode())
     # a mid-append death leaves a torn trailing line

@@ -8,6 +8,7 @@ import pytest
 
 from paddle_tpu.bench import diff as perfdiff
 from paddle_tpu.bench import gate, ledger, report, schema, trends
+from paddle_tpu.observability import roofline
 from paddle_tpu.utils import fsio
 
 _FP = {"platform": "cpu", "device_kind": "cpu", "device_count": 8,
@@ -30,7 +31,13 @@ def _row(scenario="moe", mode="smoke", p50=50.0, phases=None, sha="aaaa1111",
         "phases_ms": {k: float(v) for k, v in phases.items()},
         "tokens_per_sec": 1000.0, "mfu": mfu,
         "compile": {"wall_ms": compile_wall},
-        "bytes_on_wire": 0, "peak_hbm_bytes": 1 << 20, "extra": {},
+        "bytes_on_wire": 0, "peak_hbm_bytes": 1 << 20,
+        # schema v2: every row carries a gap budget; the degraded
+        # phase-only block keeps these drills schema-valid
+        "roofline": roofline.degraded_block(
+            p50, {k: float(v) for k, v in phases.items()},
+            reason="trends drill row"),
+        "extra": {},
     }
 
 

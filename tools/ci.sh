@@ -482,6 +482,35 @@ print(f"dashboard: {len(doc)} bytes, all {len(names())} scenarios, "
 PYEOF
     JAX_PLATFORMS=cpu python -m paddle_tpu.bench.gate
     JAX_PLATFORMS=cpu python -m paddle_tpu.bench.ledger --compact
+    # MFU microscope (ISSUE 19): every smoke row just appended must carry
+    # a roofline gap budget whose buckets (with residual) sum to the
+    # measured step; the unexplained residual must stay under the honesty
+    # bound even on the CPU smoke (advisory gap table printed)
+    JAX_PLATFORMS=cpu python -m paddle_tpu.observability.roofline \
+        --mode smoke
+    # roofline drill: inject a synthetic memory_bound gap and assert the
+    # doctor names exactly that sink — the alarm must fire for the right
+    # reason, not merely fire
+    JAX_PLATFORMS=cpu PTPU_ROOFLINE_TEST_INFLATE=memory_bound:0.6 \
+        python - <<'PYEOF'
+from paddle_tpu.bench import runner
+from paddle_tpu.observability import doctor
+row = runner.run_scenario("mnist", mode="smoke")
+roof = row["roofline"]
+assert roof["injected"], "inflation knob did not mark the block"
+assert roof["dominant_sink"] == "memory_bound", roof["dominant_sink"]
+total = sum(roof["buckets_ms"].values())
+tol = max(0.01, 0.005 * roof["measured_step_ms"])
+assert abs(total - roof["measured_step_ms"]) <= tol, (
+    total, roof["measured_step_ms"])
+rec = {"kind": "bench.row", "scenario": row["scenario"], "ts": 0.0,
+       "mfu": row["mfu"], "roofline": roof}
+(finding,) = doctor.check_mfu_gap({0: [rec]})
+assert finding["data"]["dominant"] == "memory_bound", finding
+assert finding["data"]["injected"] is True, finding
+print("roofline drill: injected memory_bound gap -> doctor verdict:",
+      finding["title"])
+PYEOF
     # warm-start drill (ROADMAP 5a): the persistent-compile-cache test is
     # `slow` (two fresh jax processes), so tier-1 skips it — run it here
     python -m pytest -q -m slow tests/test_compile_cache.py
@@ -491,6 +520,7 @@ PYEOF
          "drills + trace overhead + kernels tier + fused-block smoke" \
          "+ comm tier + comm smoke + elastic tier + elastic smoke +" \
          "integrity tier + integrity smoke + integrity overhead +" \
-         "bench smoke + perf tier + trends + dashboard + warm-start ok"
+         "bench smoke + perf tier + trends + dashboard + roofline" \
+         "residual bound + roofline drill + warm-start ok"
 fi
 echo "shard ${SHARD} green"
