@@ -107,6 +107,34 @@ def attribute(base: Dict[str, Any],
         out["gap_dominant"] = (gap_movers[0]["sink"]
                                if gap_movers and gap_movers[0]["delta_ms"] > 0
                                else None)
+    # comm movers (ISSUE 20): per-(op, axis) exposed-comm deltas — only
+    # when *both* rows carry interconnect entries (v3 rows); row-alikes
+    # and v1/v2 rows skip the axis entirely.
+    base_ic = (base.get("interconnect") or {}).get("entries")
+    cur_ic = (cur.get("interconnect") or {}).get("entries")
+    if isinstance(base_ic, list) and isinstance(cur_ic, list):
+        def by_key(entries):
+            keyed: Dict[tuple, float] = {}
+            for e in entries:
+                if isinstance(e, dict) and e.get("op"):
+                    k = (str(e["op"]), e.get("axis"))
+                    keyed[k] = keyed.get(k, 0.0) + float(
+                        e.get("measured_ms") or 0.0)
+            return keyed
+        b_keyed, c_keyed = by_key(base_ic), by_key(cur_ic)
+        comm_movers: List[Dict[str, Any]] = []
+        for k in sorted(set(b_keyed) | set(c_keyed),
+                        key=lambda k: (k[0], k[1] or "")):
+            b, c = b_keyed.get(k, 0.0), c_keyed.get(k, 0.0)
+            comm_movers.append({"op": k[0], "axis": k[1],
+                                "base_ms": b, "cur_ms": c,
+                                "delta_ms": c - b,
+                                "ratio": (c / b) if b > 0 else None})
+        comm_movers.sort(key=lambda m: -m["delta_ms"])
+        out["comm_movers"] = comm_movers
+        out["comm_dominant"] = (
+            {"op": comm_movers[0]["op"], "axis": comm_movers[0]["axis"]}
+            if comm_movers and comm_movers[0]["delta_ms"] > 0 else None)
     return out
 
 
@@ -177,6 +205,18 @@ def render(report: Dict[str, Any]) -> str:
                     if m["sink"] == att.get("gap_dominant") else "")
             lines.append(
                 f"    {m['sink']:<14} {_fmt_ms(m['base_ms'])} -> "
+                f"{_fmt_ms(m['cur_ms'])}  ({m['delta_ms']:+.2f}ms){mark}")
+    if att.get("comm_movers"):
+        lines.append("  exposed-comm collectives (per-(op, axis) delta, "
+                     "worst first):")
+        dom = att.get("comm_dominant") or {}
+        for m in att["comm_movers"]:
+            label = m["op"] + (f"[axis={m['axis']}]" if m["axis"] else "")
+            mark = (" <-- dominant"
+                    if (m["op"] == dom.get("op")
+                        and m["axis"] == dom.get("axis")) else "")
+            lines.append(
+                f"    {label:<24} {_fmt_ms(m['base_ms'])} -> "
                 f"{_fmt_ms(m['cur_ms'])}  ({m['delta_ms']:+.2f}ms){mark}")
     cw = att.get("compile_wall_delta_ms") or 0.0
     if abs(cw) > 1.0:

@@ -28,6 +28,8 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..observability.registry import split_labels
+
 __all__ = ["measure_steps", "CompileWindow", "RooflineWindow", "peak_hbm",
            "xla_memory", "bytes_on_wire", "tpu_reachable", "pct"]
 
@@ -42,13 +44,46 @@ def pct(sorted_vals: List[float], p: float) -> Optional[float]:
 
 
 def _collective_ms_total(registry) -> float:
-    """Sum of all ``collective.<op>.ms`` histogram totals right now."""
+    """Sum of all ``collective.<op>.ms`` histogram totals right now —
+    labeled (``[axis=..,n=..]``) and legacy-unlabeled families both
+    count, each exactly once."""
     total = 0.0
     for name, snap in registry.snapshot().items():
-        if (name.startswith("collective.") and name.endswith(".ms")
+        base, _labels = split_labels(name)
+        if (base.startswith("collective.") and base.endswith(".ms")
                 and snap.get("type") == "histogram"):
             total += float(snap.get("sum") or 0.0)
     return total
+
+
+def _collective_by_key(registry) -> Dict[Tuple[str, Optional[str], int],
+                                         Dict[str, float]]:
+    """Per-(op, axis, participants) totals of the collective instrument
+    families right now: ``{"ms": histogram sum, "calls": counter,
+    "bytes": counter}``.  Unlabeled legacy names land under
+    ``axis=None, participants=0`` — one bucket, never double-counted
+    against their labeled siblings (distinct instrument names)."""
+    out: Dict[Tuple[str, Optional[str], int], Dict[str, float]] = {}
+    for name, snap in registry.snapshot().items():
+        base, labels = split_labels(name)
+        if not base.startswith("collective."):
+            continue
+        parts = base.split(".")
+        if len(parts) != 3 or parts[2] not in ("ms", "calls", "bytes"):
+            continue
+        op, field = parts[1], parts[2]
+        try:
+            n = int(labels.get("n", "0"))
+        except ValueError:
+            n = 0
+        key = (op, labels.get("axis"), n)
+        rec = out.setdefault(key, {"ms": 0.0, "calls": 0.0, "bytes": 0.0})
+        if field == "ms":
+            if snap.get("type") == "histogram":
+                rec["ms"] += float(snap.get("sum") or 0.0)
+        elif snap.get("type") == "counter":
+            rec[field] += float(snap.get("value") or 0.0)
+    return out
 
 
 def measure_steps(step_fn: Callable[[int, Any], Any],
@@ -80,7 +115,7 @@ def measure_steps(step_fn: Callable[[int, Any], Any],
     data_ms: List[float] = []
     compute_ms: List[float] = []
     readback_ms: List[float] = []
-    coll0 = _collective_ms_total(registry)
+    coll_by0 = _collective_by_key(registry)
     last = None
     for i in range(steps):
         ta = time.perf_counter()
@@ -94,8 +129,26 @@ def measure_steps(step_fn: Callable[[int, Any], Any],
         compute_ms.append((tc - tb) * 1e3)
         readback_ms.append((td - tc) * 1e3)
         total_ms.append((td - ta) * 1e3)
-    collective_per_step = max(
-        0.0, _collective_ms_total(registry) - coll0) / max(1, steps)
+    coll_by1 = _collective_by_key(registry)
+    collective_by_op: List[Dict[str, Any]] = []
+    coll_total = 0.0
+    for key in sorted(coll_by1, key=lambda k: (k[0], str(k[1]), k[2])):
+        rec = coll_by1[key]
+        base0 = coll_by0.get(key, {"ms": 0.0, "calls": 0.0, "bytes": 0.0})
+        d_ms = max(0.0, rec["ms"] - base0["ms"])
+        d_calls = max(0.0, rec["calls"] - base0["calls"])
+        d_bytes = max(0.0, rec["bytes"] - base0["bytes"])
+        coll_total += d_ms
+        if d_ms <= 0.0 and d_calls <= 0.0 and d_bytes <= 0.0:
+            continue
+        op, axis, n = key
+        collective_by_op.append({
+            "op": op, "axis": axis, "participants": n or None,
+            "calls": d_calls / max(1, steps),
+            "ms": d_ms / max(1, steps),
+            "payload_bytes": d_bytes / max(1, steps),
+        })
+    collective_per_step = coll_total / max(1, steps)
 
     def p50(series: List[float]) -> float:
         return pct(sorted(series), 50) or 0.0
@@ -105,6 +158,7 @@ def measure_steps(step_fn: Callable[[int, Any], Any],
         "phases_ms": {"data": p50(data_ms), "compute": p50(compute_ms),
                       "readback": p50(readback_ms),
                       "collective": collective_per_step},
+        "collective_by_op": collective_by_op,
         "warmup_s": warm_s,
         "final_value": last,
     }
@@ -235,23 +289,38 @@ def peak_hbm(jitted=None, *args) -> Optional[int]:
     return None
 
 
+def _counter_family_total(registry, base: str) -> float:
+    """Sum of one counter family — the unlabeled ``base`` plus every
+    ``base[...]`` labeled variant (each a distinct instrument)."""
+    total = 0.0
+    for name, snap in registry.snapshot().items():
+        b, _labels = split_labels(name)
+        if b == base and snap.get("type") == "counter":
+            total += float(snap.get("value") or 0.0)
+    return total
+
+
 class BytesOnWire:
     """Delta reader over the comm package's trace-time byte accounting
     (PR 8): ``comm.compressed_bytes`` is what the run ships,
-    ``comm.bytes`` the exact-schedule equivalent."""
+    ``comm.bytes`` the exact-schedule equivalent.  Both are summed as
+    metric *families* — since ISSUE 20 the counters carry
+    ``[axis=..,leg=..]`` labels."""
 
     def __init__(self, registry=None):
         if registry is None:
             from ..observability import get_registry
             registry = get_registry()
         self._registry = registry
-        self._raw0 = registry.counter("comm.bytes").value
-        self._wire0 = registry.counter("comm.compressed_bytes").value
+        self._raw0 = _counter_family_total(registry, "comm.bytes")
+        self._wire0 = _counter_family_total(registry,
+                                            "comm.compressed_bytes")
 
     def delta(self) -> int:
         reg = self._registry
-        wire = reg.counter("comm.compressed_bytes").value - self._wire0
-        raw = reg.counter("comm.bytes").value - self._raw0
+        wire = (_counter_family_total(reg, "comm.compressed_bytes")
+                - self._wire0)
+        raw = _counter_family_total(reg, "comm.bytes") - self._raw0
         return int(wire if wire > 0 else raw)
 
 

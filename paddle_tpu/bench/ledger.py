@@ -53,6 +53,13 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # measured step p50 on every smoke row (enforced by
     # `python -m paddle_tpu.observability.roofline` in the perf tier)
     "roofline_max_residual_frac": 0.35,
+    # Interconnect microscope (ISSUE 20): bound on the |(unattributed)|
+    # share of a nonzero comm bucket (enforced by
+    # `python -m paddle_tpu.observability.interconnect`); 1.0 = advisory
+    # only by default — trace-time collective observation legitimately
+    # attributes ~nothing on jitted CPU smokes, so tightening this is a
+    # per-deployment golden decision, not a universal one
+    "interconnect_max_unattributed_frac": 1.0,
 }
 
 

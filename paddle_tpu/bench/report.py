@@ -25,8 +25,8 @@ from ..utils import fsio
 from . import ledger, trends
 from .schema import CORE_METRICS, GAP_SINKS
 
-__all__ = ["sparkline_svg", "gap_bar_svg", "render_html", "write_report",
-           "main"]
+__all__ = ["sparkline_svg", "gap_bar_svg", "comm_bar_svg", "render_html",
+           "write_report", "main"]
 
 _METRIC_LABEL = {
     "step_p50": "step p50 (ms)",
@@ -38,6 +38,11 @@ _METRIC_LABEL = {
 }
 _METRIC_LABEL.update({f"gap_{_s}_ms": f"gap:{_s} (ms)"
                       for _s in GAP_SINKS if _s != "mxu"})
+_METRIC_LABEL.update({
+    "comm_modeled_ms": "comm:modeled (ms)",
+    "comm_overlapped_ms": "comm:overlapped (ms)",
+    "comm_unattributed_ms": "comm:unattributed (ms)",
+})
 
 # stacked-bar palette for the MFU gap budget (ISSUE 19); mxu is the
 # useful-work segment, everything else is gap
@@ -137,6 +142,49 @@ def gap_bar_svg(buckets: Dict[str, float], measured_ms: float,
                      f"height='{height}' "
                      f"fill='{_SINK_COLOR.get(s, '#a0aec0')}'>"
                      f"<title>{html.escape(s)}</title></rect>")
+        x += w
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+# per-axis palette for the comm sub-budget bars (ISSUE 20); unmapped
+# axes cycle through the fallback list, "(unattributed)" stays grey
+_AXIS_COLOR = {
+    "dp": "#3182ce", "mp": "#805ad5", "pp": "#dd6b20",
+    "ep": "#d69e2e", "sp": "#2f855a",
+}
+_AXIS_FALLBACK = ("#319795", "#b83280", "#5a67d8", "#975a16")
+
+
+def comm_bar_svg(entries: List[Dict[str, Any]], bucket_ms: float,
+                 width: int = 340, height: int = 18) -> str:
+    """One horizontal stacked bar of the comm sub-budget: a colored
+    segment per (op, axis) entry, widths proportional to measured ms
+    over the comm bucket; ``(unattributed)`` renders grey.  Negative
+    entries (over-attribution absorbed by the remainder) get zero
+    width; their sign still shows in the numbers column."""
+    parts = [f"<svg class='spark' width='{width}' height='{height}' "
+             f"viewBox='0 0 {width} {height}' role='img'>"]
+    total = max(float(bucket_ms), 1e-12)
+    x = 0.0
+    fallback = 0
+    for e in entries or []:
+        op = str(e.get("op") or "?")
+        axis = e.get("axis")
+        w = width * max(0.0, float(e.get("measured_ms") or 0.0)) / total
+        if w < 0.5:
+            continue
+        if op == "(unattributed)":
+            color = "#a0aec0"
+        else:
+            color = _AXIS_COLOR.get(axis)
+            if color is None:
+                color = _AXIS_FALLBACK[fallback % len(_AXIS_FALLBACK)]
+                fallback += 1
+        label = op + (f"[axis={axis}]" if axis else "")
+        parts.append(f"<rect x='{x:.1f}' y='0' width='{w:.1f}' "
+                     f"height='{height}' fill='{color}'>"
+                     f"<title>{html.escape(label)}</title></rect>")
         x += w
     parts.append("</svg>")
     return "".join(parts)
@@ -301,6 +349,69 @@ def render_html(analyses: List[Dict[str, Any]],
         legend = " &middot; ".join(
             f"<span style='color:{_SINK_COLOR[s]}'>&#9632;</span> "
             f"{_esc(s)}" for s in GAP_SINKS)
+        out.append(f"<p class='meta'>{legend}</p>")
+
+    # interconnect comm sub-budgets (ISSUE 20): the roofline's comm
+    # bucket split per (op, axis), with efficiency vs the ICI model
+    out.append("<h2>Exposed-comm sub-budgets (interconnect, "
+               "newest row)</h2>")
+    ic_rows = [(name, row) for name, row in sorted(
+                   (latest_rows or {}).items())
+               if isinstance((row.get("interconnect") or {})
+                             .get("entries"), list)]
+    if not ic_rows:
+        out.append("<p class='flat'>no interconnect data yet — rows "
+                   "predate schema v3.</p>")
+    else:
+        out.append("<table><tr><th>scenario</th><th>sub-budget</th>"
+                   "<th>comm bucket</th><th>overlapped (est)</th>"
+                   "<th>entries (op[axis] measured / modeled / "
+                   "efficiency)</th></tr>")
+        for name, row in ic_rows:
+            ic = row["interconnect"]
+            entries = ic.get("entries") or []
+            bucket = float(ic.get("comm_bucket_ms") or 0.0)
+            over = ic.get("overlapped_ms")
+            cells = []
+            for e in entries:
+                op = str(e.get("op") or "?")
+                if op == "(unattributed)":
+                    cells.append(
+                        f"(unattributed)="
+                        f"{float(e.get('measured_ms') or 0.0):.2f}ms")
+                    continue
+                label = op + (f"[axis={e['axis']}]"
+                              if e.get("axis") else "")
+                bit = f"{label}={float(e.get('measured_ms') or 0.0):.2f}ms"
+                if isinstance(e.get("modeled_ms"), (int, float)):
+                    bit += f" / {e['modeled_ms']:.3f}ms"
+                if isinstance(e.get("efficiency"), (int, float)):
+                    bit += f" / {e['efficiency']:.0%}"
+                cells.append(bit)
+            flags = []
+            if ic.get("degraded"):
+                flags.append("degraded")
+            if ic.get("injected"):
+                flags.append("injected")
+            name_cell = (f"{_esc(name)} ({_esc(row.get('mode'))})"
+                         + (f" <small class='flat'>"
+                            f"[{', '.join(flags)}]</small>"
+                            if flags else ""))
+            out.append(
+                f"<tr><td>{name_cell}</td>"
+                f"<td>{comm_bar_svg(entries, bucket)}</td>"
+                f"<td class='num'>{bucket:.2f}ms</td>"
+                f"<td class='num'>"
+                + (f"{float(over):.2f}ms"
+                   if isinstance(over, (int, float)) else "—")
+                + f"</td><td><small>{_esc('; '.join(cells) or '—')}"
+                "</small></td></tr>")
+        out.append("</table>")
+        legend = " &middot; ".join(
+            f"<span style='color:{c}'>&#9632;</span> axis={_esc(a)}"
+            for a, c in _AXIS_COLOR.items()) + \
+            " &middot; <span style='color:#a0aec0'>&#9632;</span> " \
+            "(unattributed)"
         out.append(f"<p class='meta'>{legend}</p>")
 
     # regression / event table

@@ -69,6 +69,23 @@ def run_scenario(name: str, mode: str = "smoke",
         (payload.get("extra") or {}).get("padding_frac") or 0.0)
     roof = rw.block(payload["step_times_ms"], phases,
                     padding_frac=padding_frac)
+    from ..observability import interconnect as ic_mod
+    comm_bucket = float(((roof or {}).get("buckets_ms") or {})
+                        .get("comm") or 0.0)
+    try:
+        import jax
+        default_n = jax.device_count()
+    except Exception:
+        default_n = None
+    per_op = payload.get("collective_by_op")
+    if per_op is None:
+        ic = ic_mod.degraded_block(
+            comm_bucket, reason="scenario reports no per-collective "
+                                "deltas")
+    else:
+        ic = ic_mod.build_block(comm_bucket, per_op,
+                                hlo_comm=roof.get("comm_ops"),
+                                default_participants=default_n)
     row = schema.new_row(
         name, mode,
         step_times_ms=payload["step_times_ms"],
@@ -81,6 +98,7 @@ def run_scenario(name: str, mode: str = "smoke",
         peak_hbm_bytes=payload.get("peak_hbm_bytes"),
         fallback_reason=fallback_reason,
         roofline=roof,
+        interconnect=ic,
         extra=payload.get("extra"),
     )
     # mirror the headline figures into the live registry so /statusz and
@@ -106,6 +124,28 @@ def run_scenario(name: str, mode: str = "smoke",
         registry.gauge(
             f"roofline.modeled_step_ms[scenario={name}]").set(
                 rl["modeled_step_ms"])
+    ic_blk = row.get("interconnect") or {}
+    registry.gauge(
+        f"interconnect.comm_bucket_ms[scenario={name}]").set(
+            float(ic_blk.get("comm_bucket_ms") or 0.0))
+    if isinstance(ic_blk.get("overlapped_ms"), (int, float)):
+        registry.gauge(
+            f"interconnect.overlapped_ms[scenario={name}]").set(
+                ic_blk["overlapped_ms"])
+    for e in (ic_blk.get("entries") or []):
+        if e.get("op") == ic_mod.UNATTRIBUTED:
+            registry.gauge(
+                f"interconnect.unattributed_ms[scenario={name}]").set(
+                    float(e.get("measured_ms") or 0.0))
+            continue
+        axis = e.get("axis") or "none"
+        registry.gauge(
+            f"interconnect.entry_ms[scenario={name},op={e['op']},"
+            f"axis={axis}]").set(float(e.get("measured_ms") or 0.0))
+        if isinstance(e.get("efficiency"), (int, float)):
+            registry.gauge(
+                f"interconnect.efficiency[scenario={name},op={e['op']},"
+                f"axis={axis}]").set(e["efficiency"])
     registry.emit("bench.row", scenario=name, mode=mode,
                   step_time_p50_ms=p50, phases_ms=row["phases_ms"],
                   compile_wall_ms=row["compile"].get("wall_ms"),
@@ -120,6 +160,20 @@ def run_scenario(name: str, mode: str = "smoke",
                       "buckets_ms": rl.get("buckets_ms"),
                       "injected": bool(rl.get("injected")),
                       "device_known": (rl.get("device") or {}).get("known"),
+                  },
+                  interconnect={
+                      "comm_bucket_ms": ic_blk.get("comm_bucket_ms"),
+                      "unattributed_ms": ic_blk.get("unattributed_ms"),
+                      "overlapped_ms": ic_blk.get("overlapped_ms"),
+                      "entries": [
+                          {"op": e.get("op"), "axis": e.get("axis"),
+                           "participants": e.get("participants"),
+                           "measured_ms": e.get("measured_ms"),
+                           "modeled_ms": e.get("modeled_ms"),
+                           "efficiency": e.get("efficiency")}
+                          for e in (ic_blk.get("entries") or [])],
+                      "injected": ic_blk.get("injected"),
+                      "degraded": bool(ic_blk.get("degraded")),
                   })
     return row
 

@@ -129,6 +129,7 @@ def _gpt_train_payload(cfg, B: int, S: int, steps: int, warmup: int,
                    "hidden_size": cfg.hidden_size},
         "step_times_ms": m["step_times_ms"],
         "phases_ms": m["phases_ms"],
+        "collective_by_op": m.get("collective_by_op"),
         "tokens_per_sec": tok_s,
         "mfu": mfu(tok_s, flops_tok),
         "peak_hbm_bytes": peak,
@@ -294,6 +295,7 @@ def _vision_train_payload(model, B: int, hw: int, steps: int, warmup: int,
                    "params_m": param_count(trainable) / 1e6},
         "step_times_ms": m["step_times_ms"],
         "phases_ms": m["phases_ms"],
+        "collective_by_op": m.get("collective_by_op"),
         "tokens_per_sec": None,
         "mfu": mfu_val,
         "peak_hbm_bytes": peak,

@@ -32,15 +32,19 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["SCHEMA_VERSION", "KNOWN_SCHEMA_VERSIONS", "PHASES", "METRICS",
-           "CORE_METRICS", "GAP_SINKS", "GAP_METRICS",
+           "CORE_METRICS", "GAP_SINKS", "GAP_METRICS", "COMM_METRICS",
            "fingerprint", "fingerprint_key", "metric_value", "new_row",
            "validate_row"]
 
 # v2 (ISSUE 19): every row carries a ``roofline`` MFU-gap budget block
 # whose buckets (with residual) sum to the measured step p50; v1 rows
-# remain readable — gap axes are simply None on them
-SCHEMA_VERSION = 2
-KNOWN_SCHEMA_VERSIONS = (1, 2)
+# remain readable — gap axes are simply None on them.
+# v3 (ISSUE 20): every row additionally carries an ``interconnect``
+# per-collective sub-budget whose entries (with the signed
+# "(unattributed)" remainder) sum to the roofline ``comm`` bucket
+# exactly; v1/v2 rows remain readable — comm axes are None on them.
+SCHEMA_VERSION = 3
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3)
 
 # the step-time decomposition perfdiff attributes regressions to; every
 # row carries all four (0.0 when a scenario has no such phase)
@@ -62,10 +66,16 @@ CORE_METRICS = ("step_p50", "mfu", "compile_wall_ms", "bytes_on_wire",
 GAP_METRICS = tuple("gap_%s_ms" % s for s in GAP_SINKS if s != "mxu") \
     + ("roofline_coverage",)
 
+# per-collective comm axes (ISSUE 20): the modeled wire time of the
+# attributed entries, the XLA-overlap estimate, and the honesty gauge —
+# how much of the comm bucket no (op, axis) claims
+COMM_METRICS = ("comm_modeled_ms", "comm_overlapped_ms",
+                "comm_unattributed_ms")
+
 # the metric axes the trend engine models as per-scenario series
 # (ISSUE 14); each maps to one numeric field of the row via
 # :func:`metric_value`
-METRICS = CORE_METRICS + GAP_METRICS
+METRICS = CORE_METRICS + GAP_METRICS + COMM_METRICS
 
 _MODES = ("smoke", "full")
 
@@ -85,6 +95,12 @@ def metric_value(row: Dict[str, Any], metric: str) -> Optional[float]:
         v = row.get("peak_hbm_bytes")
     elif metric == "roofline_coverage":
         v = (row.get("roofline") or {}).get("coverage")
+    elif metric == "comm_modeled_ms":
+        v = (row.get("interconnect") or {}).get("modeled_ms_total")
+    elif metric == "comm_overlapped_ms":
+        v = (row.get("interconnect") or {}).get("overlapped_ms")
+    elif metric == "comm_unattributed_ms":
+        v = (row.get("interconnect") or {}).get("unattributed_ms")
     elif metric.startswith("gap_") and metric.endswith("_ms"):
         sink = metric[len("gap_"):-len("_ms")]
         if sink not in GAP_SINKS:
@@ -142,15 +158,19 @@ def new_row(scenario: str, mode: str, *,
             peak_hbm_bytes: Optional[int] = None,
             fallback_reason: Optional[str] = None,
             roofline: Optional[Dict[str, Any]] = None,
+            interconnect: Optional[Dict[str, Any]] = None,
             extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-    """Assemble one schema-v2 row from a scenario's measurements.
+    """Assemble one schema-v3 row from a scenario's measurements.
 
     ``step_times_ms`` is the raw per-step series (percentiles are
     computed here so every scenario uses the same definition);
     ``phases_ms`` maps each :data:`PHASES` entry to its per-step p50.
     ``roofline`` is the MFU-gap budget block from a capture window; when
-    omitted, a degraded phase-only block is synthesized so every v2 row
+    omitted, a degraded phase-only block is synthesized so every row
     still carries buckets that sum to the measured step time.
+    ``interconnect`` is the per-collective sub-budget of the roofline's
+    ``comm`` bucket; when omitted, a degraded all-unattributed block is
+    synthesized so the v3 sum invariant holds for every producer.
     """
     times = sorted(float(t) for t in step_times_ms)
 
@@ -169,6 +189,12 @@ def new_row(scenario: str, mode: str, *,
             {p: float(phases_ms.get(p, 0.0) or 0.0) for p in PHASES},
             padding_frac=float((extra or {}).get("padding_frac") or 0.0),
             reason="producer passed no roofline block")
+    if interconnect is None:
+        from ..observability import interconnect as ic
+        interconnect = ic.degraded_block(
+            float(((roofline or {}).get("buckets_ms") or {}).get("comm")
+                  or 0.0),
+            reason="producer passed no interconnect block")
     row: Dict[str, Any] = {
         "schema_version": SCHEMA_VERSION,
         "scenario": str(scenario),
@@ -193,6 +219,7 @@ def new_row(scenario: str, mode: str, *,
         "peak_hbm_bytes": (None if peak_hbm_bytes is None
                            else int(peak_hbm_bytes)),
         "roofline": roofline,
+        "interconnect": interconnect,
         "extra": dict(extra or {}),
     }
     return row
@@ -254,8 +281,10 @@ def validate_row(row: Any) -> List[str]:
             errors.append(f"{opt_num} must be null or a number")
     if not isinstance(row.get("extra", {}), dict):
         errors.append("extra must be an object")
-    if row.get("schema_version") == 2:
+    if row.get("schema_version") in (2, 3):
         errors.extend(_validate_roofline(row))
+    if row.get("schema_version") == 3:
+        errors.extend(_validate_interconnect(row))
     return errors
 
 
@@ -299,4 +328,54 @@ def _validate_roofline(row: Dict[str, Any]) -> List[str]:
     if not isinstance(dev, dict) or not isinstance(
             dev.get("known"), bool):
         errors.append("roofline.device.known missing/invalid")
+    return errors
+
+
+def _validate_interconnect(row: Dict[str, Any]) -> List[str]:
+    """The v3 contract: a per-collective entry list (with the signed
+    ``"(unattributed)"`` remainder) that sums to the block's comm
+    bucket, which in turn equals the roofline ``comm`` bucket — a
+    sub-budget that doesn't reconcile with its parent must never reach
+    the ledger."""
+    errors: List[str] = []
+    ic = row.get("interconnect")
+    if not isinstance(ic, dict):
+        return ["schema v3 row missing interconnect block"]
+    bucket = ic.get("comm_bucket_ms")
+    if not isinstance(bucket, (int, float)):
+        errors.append("interconnect.comm_bucket_ms missing/invalid")
+        bucket = None
+    entries = ic.get("entries")
+    if not isinstance(entries, list) or not entries:
+        errors.append("interconnect.entries missing/empty")
+    else:
+        total = 0.0
+        complete = True
+        for i, e in enumerate(entries):
+            if not isinstance(e, dict) or not isinstance(
+                    e.get("measured_ms"), (int, float)):
+                errors.append(
+                    f"interconnect.entries[{i}].measured_ms "
+                    f"missing/invalid")
+                complete = False
+                continue
+            total += float(e["measured_ms"])
+        if complete and bucket is not None:
+            tol = max(0.01, 0.005 * abs(float(bucket)))
+            if abs(total - float(bucket)) > tol:
+                errors.append(
+                    "interconnect entries sum %.4fms != comm bucket "
+                    "%.4fms" % (total, float(bucket)))
+    rl_comm = ((row.get("roofline") or {}).get("buckets_ms")
+               or {}).get("comm")
+    if (bucket is not None and isinstance(rl_comm, (int, float))
+            and abs(float(bucket) - float(rl_comm))
+            > max(0.01, 0.005 * abs(float(rl_comm)))):
+        errors.append(
+            "interconnect.comm_bucket_ms %.4fms != roofline comm "
+            "bucket %.4fms" % (float(bucket), float(rl_comm)))
+    dev = ic.get("device")
+    if not isinstance(dev, dict) or not isinstance(
+            dev.get("known"), bool):
+        errors.append("interconnect.device.known missing/invalid")
     return errors

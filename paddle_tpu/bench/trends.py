@@ -407,6 +407,12 @@ _METRIC_FMT.update({
     f"gap_{_s}_ms": (f"gap:{_s}", lambda v: f"{v:.2f}ms")
     for _s in GAP_SINKS if _s != "mxu"
 })
+# interconnect axes (ISSUE 20): the comm sub-budget's headline figures
+_METRIC_FMT.update({
+    "comm_modeled_ms": ("comm:modeled", lambda v: f"{v:.3f}ms"),
+    "comm_overlapped_ms": ("comm:overlapped", lambda v: f"{v:.2f}ms"),
+    "comm_unattributed_ms": ("comm:unattributed", lambda v: f"{v:.2f}ms"),
+})
 
 
 def _fmt_metric(metric: str, v: Optional[float]) -> str:

@@ -17,6 +17,7 @@ The reference's eager tensor-in-place mutation API is reshaped functional:
 from __future__ import annotations
 
 import functools
+import inspect
 import time
 from typing import Optional, Sequence, Union
 
@@ -48,9 +49,26 @@ def _observed(fn):
     ``collective.<op>.calls``.  For ops invoked inside a traced program
     this measures trace/dispatch cost (the wire time lives in the XLA
     schedule); for host-blocking ops — ``barrier`` above all — it is the
-    real wait, which is exactly the number a wedged fleet shows first."""
-    hist_name = f"collective.{fn.__name__}.ms"
-    count_name = f"collective.{fn.__name__}.calls"
+    real wait, which is exactly the number a wedged fleet shows first.
+
+    ISSUE 20: when the call's ``group`` is a mesh-axis name, the
+    instruments carry ``[axis=<group>,n=<participants>]`` labels
+    (name-suffix convention; parse with
+    :func:`~paddle_tpu.observability.registry.split_labels`) plus a
+    ``collective.<op>.bytes[...]`` payload counter, so the interconnect
+    microscope can attribute wire time per (op, axis).  Label
+    extraction is strictly best-effort — any failure falls back to the
+    legacy unlabeled names rather than raising out of a collective."""
+    base = f"collective.{fn.__name__}"
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+        group_idx = next(i for i, p in enumerate(params)
+                         if p.name == "group")
+        group_default = params[group_idx].default
+        if group_default is inspect.Parameter.empty:
+            group_default = None
+    except (StopIteration, TypeError, ValueError):
+        group_idx, group_default = None, None
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
@@ -58,11 +76,30 @@ def _observed(fn):
         try:
             return fn(*args, **kwargs)
         finally:
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            suffix = ""
+            nbytes = 0
+            try:
+                group = kwargs.get("group", group_default)
+                if ("group" not in kwargs and group_idx is not None
+                        and len(args) > group_idx):
+                    group = args[group_idx]
+                if isinstance(group, str):
+                    n = (bound_axis_size(group) if _in_axis(group)
+                         else axis_size(group))
+                    suffix = f"[axis={group},n={int(n)}]"
+                x = args[0] if args else None
+                if (x is not None and hasattr(x, "size")
+                        and hasattr(x, "dtype")):
+                    nbytes = int(x.size) * int(x.dtype.itemsize)
+            except Exception:  # noqa: BLE001 — labels never break a call
+                suffix, nbytes = "", 0
             from ..observability import get_registry
             reg = get_registry()
-            reg.histogram(hist_name).observe(
-                (time.perf_counter() - t0) * 1e3)
-            reg.counter(count_name).inc()
+            reg.histogram(f"{base}.ms{suffix}").observe(dt_ms)
+            reg.counter(f"{base}.calls{suffix}").inc()
+            if nbytes and suffix:
+                reg.counter(f"{base}.bytes{suffix}").inc(nbytes)
     return wrapped
 
 

@@ -24,7 +24,12 @@ residual pytree for a whole gradient tree.
 Byte accounting rides the PR 3 registry at trace time (shapes are
 static): ``comm.bytes`` counts the exact-fp32 schedule,
 ``comm.compressed_bytes`` what this call ships, and
-``comm.compress_ratio`` the running ratio.
+``comm.compress_ratio`` the running ratio.  Since ISSUE 20 the
+counters carry ``[axis=<group>]`` labels (plus ``leg=all_to_all`` /
+``leg=all_gather`` for the int8 two-phase halves, booked separately);
+readers sum the metric *family* via
+:func:`~paddle_tpu.observability.registry.split_labels` so labeled and
+legacy-unlabeled series aggregate without double-counting.
 """
 from __future__ import annotations
 
@@ -63,13 +68,26 @@ def wire_bytes(n_elements: int, cfg: CommConfig, rounds: int = 2) -> int:
     return rounds * n_elements * itemsize
 
 
-def _account(n_elements: int, cfg: CommConfig, rounds: int = 2) -> None:
+def _account(n_elements: int, cfg: CommConfig, rounds: int = 2,
+             group=None, leg: Optional[str] = None) -> None:
+    """Book one schedule's bytes.  ``group`` (a mesh-axis name) and
+    ``leg`` (which half of the int8 two-phase schedule — ``all_to_all``
+    or ``all_gather``) ride as instrument labels (ISSUE 20) so the
+    interconnect microscope attributes wire bytes per axis and
+    compression efficiency per leg; the running ``comm.compress_ratio``
+    gauge stays unlabeled (one headline number)."""
     from ...observability import get_registry
     raw = wire_bytes(n_elements, CommConfig(), rounds)
     wire = wire_bytes(n_elements, cfg, rounds)
+    labels = []
+    if isinstance(group, str):
+        labels.append(f"axis={group}")
+    if leg:
+        labels.append(f"leg={leg}")
+    suffix = "[%s]" % ",".join(labels) if labels else ""
     reg = get_registry()
-    reg.counter("comm.bytes").inc(raw)
-    reg.counter("comm.compressed_bytes").inc(wire)
+    reg.counter("comm.bytes" + suffix).inc(raw)
+    reg.counter("comm.compressed_bytes" + suffix).inc(wire)
     if wire:
         reg.gauge("comm.compress_ratio").set(raw / wire)
 
@@ -125,14 +143,18 @@ def _compressed_all_reduce(x, op: str, group: str, cfg: CommConfig
     flat = x.astype(jnp.float32).reshape(-1)
     size = flat.shape[0]
     if cfg.dtype == "bfloat16":
-        _account(size, cfg, rounds=2)
+        _account(size, cfg, rounds=2, group=group)
         sent = flat.astype(jnp.bfloat16)
         own = sent.astype(jnp.float32)
         out = _avg(lax.psum(sent, group).astype(jnp.float32), op, n)
         return (out.reshape(shape).astype(dtype),
                 own.reshape(shape).astype(dtype))
     flat, pad = pad_to_multiple(flat, n * cfg.block_size)
-    _account(flat.shape[0], cfg, rounds=2)
+    # per-leg wire accounting (ISSUE 20): the two-phase schedule ships
+    # codes+scales once over all_to_all and once over all_gather —
+    # booked separately so compression efficiency is measurable per leg
+    _account(flat.shape[0], cfg, rounds=1, group=group, leg="all_to_all")
+    _account(flat.shape[0], cfg, rounds=1, group=group, leg="all_gather")
     chunk, own = _int8_reduce_scatter_flat(flat, group, cfg, op)
     full = _int8_all_gather_flat(chunk, group, cfg)
     if pad:
@@ -165,7 +187,8 @@ def all_reduce(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
     if not _in_axis(group if isinstance(group, str) else (group or [None])[0]):
         return x
     if not _should_compress(x, cfg, op):
-        _account(x.size, CommConfig(), rounds=2)   # exact: raw == wire
+        _account(x.size, CommConfig(), rounds=2,   # exact: raw == wire
+                 group=group)
         return _exact_all_reduce(x, op, group)
     out, _own = _compressed_all_reduce(x, op, group, cfg)
     return out
@@ -185,11 +208,11 @@ def reduce_scatter(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
             or cfg.dtype == "bfloat16"):
         if cfg.dtype == "bfloat16" and _should_compress(x, cfg, op):
             n = bound_axis_size(group)
-            _account(x.size, cfg, rounds=1)
+            _account(x.size, cfg, rounds=1, group=group)
             out = lax.psum_scatter(x.astype(jnp.bfloat16), group,
                                    scatter_dimension=axis, tiled=True)
             return _avg(out.astype(jnp.float32), op, n).astype(x.dtype)
-        _account(x.size, CommConfig(), rounds=1)
+        _account(x.size, CommConfig(), rounds=1, group=group)
         # the legacy exact surface only sums (reference c_reducescatter);
         # honor AVG here so compressed and exact paths agree on semantics
         out = _exact_reduce_scatter(x, ReduceOp.SUM, group, axis=axis)
@@ -200,7 +223,7 @@ def reduce_scatter(x, op: str = ReduceOp.SUM, group: Optional[str] = "dp",
             f"compressed reduce_scatter needs length divisible by "
             f"group·block_size ({n}·{cfg.block_size}); pad first "
             f"(got {x.shape[0]})")
-    _account(x.shape[0], cfg, rounds=1)
+    _account(x.shape[0], cfg, rounds=1, group=group, leg="all_to_all")
     dtype = x.dtype
     chunk, _own = _int8_reduce_scatter_flat(
         x.astype(jnp.float32), group, cfg, op)
@@ -233,7 +256,8 @@ def sync_gradients(grads, config=None, group: Optional[str] = "dp",
             continue
         g = _arr(g)
         if not _should_compress(g, cfg, op):
-            _account(g.size, CommConfig(), rounds=2)  # exact: raw == wire
+            _account(g.size, CommConfig(), rounds=2,  # exact: raw == wire
+                     group=group)
             out.append(_exact_all_reduce(g, op, group))
             new_res.append(jnp.zeros_like(g) if cfg.error_feedback
                            else None)

@@ -86,7 +86,7 @@ from .monitor import (LiveAggregator, StatusServer,
                       default_monitor_interval, live_status_path,
                       maybe_start_server)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       get_registry)
+                       get_registry, split_labels)
 from .mfu import DEVICE_SPECS, device_spec
 from .requesttrace import (TraceAssembler, assemble_run, component_bucket,
                            mint_trace_id, tail_latency_attribution)
@@ -100,6 +100,7 @@ from .tracing import (export_chrome_trace, reset_tracing, span,
 __all__ = [
     # registry
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "split_labels",
     # tracing
     "span", "span_tree_totals", "export_chrome_trace", "trace_events",
     "reset_tracing",

@@ -30,6 +30,11 @@ evidence lines):
                        more for moving bytes than the overlap can hide —
                        compress the dp sync or shard the weight update
                        (``distributed/comm``, ISSUE 8).
+- ``comm_budget``    — the interconnect microscope's per-collective
+                       sub-budget (bench rows, ISSUE 20) shows the
+                       roofline's exposed-comm bucket dominating the
+                       step; the verdict names the dominant (op, axis)
+                       and its efficiency vs the ICI cost model.
 - ``data_starved``   — data-wait dominates the step-time breakdown.
 - ``perf_trend``     — the ledger *series* for a benched scenario shows
                        an upward step-time changepoint (named by git-sha
@@ -69,6 +74,7 @@ from ..framework.log import vlog
 from ..utils import fsio
 from .aggregate import (SCHEMA_VERSION, aggregate_run, read_worker_stream,
                         straggler_stats, _WORKER_RE)
+from .registry import split_labels
 from .sinks import metrics_dir
 
 __all__ = ["diagnose", "render_report", "main", "check_compilation",
@@ -77,7 +83,7 @@ __all__ = ["diagnose", "render_report", "main", "check_compilation",
            "check_perf_regression", "check_perf_trend", "check_serving",
            "check_fleet", "check_fleet_flapping",
            "check_fleet_slo_burn", "check_tail_latency",
-           "check_mfu_gap"]
+           "check_mfu_gap", "check_comm_budget"]
 
 # tunables: thresholds a finding must clear before it is reported
 RETRACE_WARN = 3            # retraces (not first compiles) per function
@@ -284,12 +290,18 @@ def _collective_skew_evidence(workers, straggler: int) -> List[str]:
                      if r.get("kind") == "metrics.snapshot"), None)
         if not snap:
             continue
+        # aggregate across the label family (ISSUE 20: the histograms
+        # carry [axis=..,n=..] suffixes now) so one op's wait is not
+        # split across its axes — sum the sums, sum the counts
+        sums: Dict[str, List[float]] = {}
         for name, m in (snap.get("snapshot") or {}).items():
-            if (name.startswith("collective.") and name.endswith(".ms")
+            base, _labels = split_labels(name)
+            if (base.startswith("collective.") and base.endswith(".ms")
                     and isinstance(m, dict) and m.get("count")):
-                per_worker.setdefault(wid, {})[name] = (
-                    m["sum"] / m["count"])
-        per_worker.setdefault(wid, {})
+                agg = sums.setdefault(base, [0.0, 0.0])
+                agg[0] += float(m.get("sum") or 0.0)
+                agg[1] += float(m["count"])
+        per_worker[wid] = {op: s / c for op, (s, c) in sums.items() if c}
     if len(per_worker) < 2:
         return []
     ev = []
@@ -384,24 +396,33 @@ def check_comm_bound(workers, frac: Optional[float] = None
         if not step_p50:
             continue
         for name, m in snapshot.items():
-            if not (name.startswith("collective.") and name.endswith(".ms")
+            # ISSUE 20: accept both the labeled family
+            # (collective.<op>.ms[axis=..,n=..]) and the legacy
+            # unlabeled name; each family member is judged on its own
+            # p50 and only the worst per op is kept, so labels never
+            # double-count an op's wait
+            base, labels = split_labels(name)
+            if not (base.startswith("collective.") and base.endswith(".ms")
                     and isinstance(m, dict) and m.get("count")):
                 continue
             p50 = m.get("p50")
             if p50 is None or p50 < frac * step_p50:
                 continue
-            op = name[len("collective."):-len(".ms")]
+            op = base[len("collective."):-len(".ms")]
             cur = worst.get(op)
             if cur is None or p50 / step_p50 > cur["ratio"]:
                 worst[op] = {"worker": wid, "p50_ms": p50,
                              "step_p50_ms": step_p50,
                              "ratio": p50 / step_p50,
-                             "count": int(m["count"])}
+                             "count": int(m["count"]),
+                             "axis": labels.get("axis")}
     for op, info in sorted(worst.items(), key=lambda kv: -kv[1]["ratio"]):
+        axis_note = (f" on axis {info['axis']}" if info.get("axis")
+                     else "")
         findings.append(_finding(
             "comm_bound", 45 + 45 * min(1.0, info["ratio"]),
             f"communication-bound: {op} p50 is {info['ratio']:.0%} of "
-            f"the step time",
+            f"the step time" + axis_note,
             [f"collective.{op}.ms p50 {info['p50_ms']:.1f}ms vs step "
              f"p50 {info['step_p50_ms']:.1f}ms on worker "
              f"{info['worker']} ({info['count']} calls; threshold "
@@ -906,6 +927,96 @@ def check_mfu_gap(workers) -> List[Dict[str, Any]]:
     return findings
 
 
+def check_comm_budget(workers, frac: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+    """Interconnect-microscope verdict (ISSUE 20): ``bench.row`` records
+    carry a slim per-collective sub-budget of the roofline's exposed-comm
+    bucket.  When that bucket eats more than ``PTPU_COMM_BOUND_FRAC``
+    (default 0.25) of the measured step — or a synthetic drill entry was
+    injected — the doctor names the dominant (op, axis) and its
+    efficiency vs the ICI cost model.  When ``(unattributed)`` holds the
+    largest share the wording is honest: the microscope saw exposed comm
+    time it could not pin to a named collective (trace-time observation
+    sees jitted collectives once per trace, not per step)."""
+    if frac is None:
+        frac = float(os.environ.get("PTPU_COMM_BOUND_FRAC",
+                                    COMM_BOUND_FRAC))
+    from .interconnect import UNATTRIBUTED
+    newest: Dict[str, Dict[str, Any]] = {}
+    for records in workers.values():
+        for r in records:
+            if r.get("kind") != "bench.row":
+                continue
+            ic = r.get("interconnect")
+            if not isinstance(ic, dict) or not isinstance(
+                    ic.get("entries"), list):
+                continue
+            name = str(r.get("scenario"))
+            prev = newest.get(name)
+            if prev is None or (r.get("ts") or 0) >= (prev.get("ts") or 0):
+                newest[name] = r
+    findings = []
+    for name in sorted(newest):
+        r = newest[name]
+        ic = r["interconnect"]
+        roof = r.get("roofline") or {}
+        measured = float(roof.get("measured_step_ms") or 0.0)
+        bucket = float(ic.get("comm_bucket_ms") or 0.0)
+        injected = ic.get("injected")
+        share = bucket / measured if measured > 0 else 0.0
+        if not injected and (measured <= 0 or share <= frac):
+            continue
+        entries = [e for e in ic["entries"]
+                   if isinstance(e, dict) and e.get("op")]
+        attributed = [e for e in entries if e["op"] != UNATTRIBUTED]
+        unatt = next((float(e.get("measured_ms") or 0.0) for e in entries
+                      if e["op"] == UNATTRIBUTED), 0.0)
+        dom = max(attributed,
+                  key=lambda e: float(e.get("measured_ms") or 0.0),
+                  default=None)
+        dom_ms = float(dom.get("measured_ms") or 0.0) if dom else 0.0
+        ev = [f"exposed-comm bucket {bucket:.2f}ms of {measured:.2f}ms "
+              f"measured ({share:.0%}, threshold {frac:.0%})"]
+        if dom is not None and dom_ms >= unatt and dom_ms > 0:
+            op = dom["op"]
+            axis = dom.get("axis") or "?"
+            eff = dom.get("efficiency")
+            what = f"{op}[axis={axis}]"
+            line = (f"dominant collective: {what} {dom_ms:.2f}ms "
+                    f"({dom.get('participants') or '?'} participants)")
+            if isinstance(dom.get("modeled_ms"), (int, float)):
+                line += f", ICI-modeled wire time {dom['modeled_ms']:.3f}ms"
+            if isinstance(eff, (int, float)):
+                line += f", efficiency vs modeled {eff:.0%}"
+            ev.append(line)
+            data_op, data_axis, data_eff = op, dom.get("axis"), eff
+        else:
+            what = UNATTRIBUTED
+            ev.append(
+                f"largest share is {UNATTRIBUTED} ({unatt:.2f}ms): comm "
+                "time the per-collective counters did not capture — a "
+                "lower bound on the exposed collectives, not a diagnosis")
+            data_op, data_axis, data_eff = UNATTRIBUTED, None, None
+        if isinstance(ic.get("overlapped_ms"), (int, float)):
+            ev.append(f"estimated overlapped (hidden) comm: "
+                      f"{ic['overlapped_ms']:.2f}ms")
+        if injected:
+            ev.append("NOTE: synthetic drill — this entry was injected "
+                      "via PTPU_INTERCONNECT_TEST_INFLATE")
+        ev.append("full sub-budget: python -m "
+                  "paddle_tpu.observability.interconnect")
+        findings.append(_finding(
+            "comm_budget",
+            25 + 40 * min(1.0, max(0.0, share - frac) / 0.5),
+            f"{name}: exposed comm dominated by {what} "
+            f"({share:.0%} of the step)",
+            ev, scenario=name, op=data_op, axis=data_axis,
+            efficiency=data_eff, share=share, comm_bucket_ms=bucket,
+            unattributed_ms=unatt, injected=injected,
+            degraded=bool(ic.get("degraded"))))
+    return findings
+
+
 def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     """Run every check against ``run_dir``; returns the diagnosis dict
     (findings ranked most-severe first) or ``None`` when the run left no
@@ -939,6 +1050,7 @@ def diagnose(run_dir: str, write: bool = True) -> Optional[Dict[str, Any]]:
     findings += check_fleet_slo_burn(workers)
     findings += check_tail_latency(workers)
     findings += check_mfu_gap(workers)
+    findings += check_comm_budget(workers)
     findings += check_supervisor(events)
     findings.sort(key=lambda f: (-f["severity"], f["kind"]))
     diagnosis = {

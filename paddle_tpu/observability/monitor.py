@@ -427,6 +427,78 @@ class StatusServer:
         except Exception:  # noqa: swallow — statusz must render
             roofline = {}
         status["roofline"] = roofline or None
+        # interconnect microscope (ISSUE 20): the bench runner mirrors
+        # each row's per-collective comm sub-budget into
+        # `interconnect.*` gauges — statusz shows the per-scenario
+        # entries (op, axis, measured, efficiency-vs-modeled) and the
+        # doctor's comm_budget verdict
+        interconnect: Dict[str, Any] = {}
+        try:
+            ic_scen: Dict[str, Dict[str, Any]] = {}
+            for name, m in snap.items():
+                if (not name.startswith("interconnect.")
+                        or m.get("type") != "gauge"
+                        or "[scenario=" not in name):
+                    continue
+                metric, _, rest = name.partition("[scenario=")
+                metric = metric[len("interconnect."):]
+                label = rest[:-1]
+                if "," in label:
+                    sname, _, rest_lbl = label.partition(",")
+                    labels = dict(p.partition("=")[::2]
+                                  for p in rest_lbl.split(","))
+                    entry = ic_scen.setdefault(sname, {}).setdefault(
+                        "by_op", {}).setdefault(
+                        (labels.get("op"), labels.get("axis")), {})
+                    entry[metric] = m["value"]
+                else:
+                    ic_scen.setdefault(label, {})[metric] = m["value"]
+            if ic_scen:
+                scen_out: Dict[str, Any] = {}
+                recs = []
+                for sname, v in sorted(ic_scen.items()):
+                    entries = []
+                    for (op, axis), fields in sorted(
+                            (v.get("by_op") or {}).items()):
+                        entries.append({
+                            "op": op,
+                            "axis": None if axis in (None, "none") else axis,
+                            "measured_ms": fields.get("entry_ms"),
+                            "efficiency": fields.get("efficiency")})
+                    if v.get("unattributed_ms") is not None:
+                        entries.append({"op": "(unattributed)",
+                                        "axis": None,
+                                        "measured_ms": v["unattributed_ms"]})
+                    scen_out[sname] = {
+                        "comm_bucket_ms": v.get("comm_bucket_ms"),
+                        "overlapped_ms": v.get("overlapped_ms"),
+                        "unattributed_ms": v.get("unattributed_ms"),
+                        "entries": entries,
+                    }
+                    recs.append({
+                        "kind": "bench.row", "scenario": sname,
+                        "roofline": {"measured_step_ms": gauge(
+                            f"perf.step_time_ms[scenario={sname}]")},
+                        "interconnect": {
+                            "comm_bucket_ms": v.get("comm_bucket_ms"),
+                            "overlapped_ms": v.get("overlapped_ms"),
+                            "entries": entries}})
+                interconnect["scenarios"] = scen_out
+                try:
+                    from .doctor import check_comm_budget
+                    verdicts = check_comm_budget({0: recs})
+                except Exception:  # noqa: swallow — statusz must render
+                    verdicts = []
+                interconnect["comm_budget"] = ([
+                    {"scenario": f["data"].get("scenario"),
+                     "op": f["data"].get("op"),
+                     "axis": f["data"].get("axis"),
+                     "efficiency": f["data"].get("efficiency"),
+                     "share": f["data"].get("share"),
+                     "title": f["title"]} for f in verdicts] or None)
+        except Exception:  # noqa: swallow — statusz must render
+            interconnect = {}
+        status["interconnect"] = interconnect or None
         if sup is not None:
             if status["step"] is None:
                 status["step"] = sup.gstep
@@ -668,6 +740,7 @@ class LiveAggregator:
         findings += doctor.check_fleet_slo_burn(workers)
         findings += doctor.check_tail_latency(workers)
         findings += doctor.check_mfu_gap(workers)
+        findings += doctor.check_comm_budget(workers)
         findings.sort(key=lambda f: (-f["severity"], f["kind"]))
         return findings
 

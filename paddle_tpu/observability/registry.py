@@ -30,9 +30,34 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry"]
+           "get_registry", "split_labels"]
 
 METRICS_DIR_ENV = "PTPU_METRICS_DIR"
+
+
+def split_labels(name: str) -> "tuple[str, Dict[str, str]]":
+    """Split an instrument name into ``(base, labels)``.
+
+    Labels ride as a name suffix by convention —
+    ``collective.all_reduce.ms[axis=dp,n=8]`` →
+    ``("collective.all_reduce.ms", {"axis": "dp", "n": "8"})`` — so the
+    registry itself stays label-agnostic.  Unlabeled names come back
+    with an empty dict; every reader that aggregates a metric family
+    must parse through this helper so labeled and legacy-unlabeled
+    series sum without double-counting.
+    """
+    if not name.endswith("]"):
+        return name, {}
+    i = name.find("[")
+    if i < 0:
+        return name, {}
+    base, body = name[:i], name[i + 1:-1]
+    labels: Dict[str, str] = {}
+    for part in body.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k.strip()] = v.strip()
+    return base, labels
 
 
 class Counter:

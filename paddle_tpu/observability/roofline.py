@@ -117,6 +117,17 @@ _FREE_OPS = frozenset({
     "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
     "after-all", "partition-id", "replica-id", "opt-barrier"})
 _MXU_CUSTOM_RE = re.compile(r"gemm|matmul|dot|conv|einsum", re.IGNORECASE)
+# `replica_groups={{0,1,2,3},{4,5,6,7}}` — the first group's size is the
+# collective's participant count (groups are uniform by construction)
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{\{([0-9, ]+)\}")
+
+
+def _strip_async(opcode: str) -> str:
+    """Base collective opcode: ``all-reduce-start`` → ``all-reduce``."""
+    for suf in ("-start", "-done", "-update"):
+        if opcode.endswith(suf):
+            return opcode[:-len(suf)]
+    return opcode
 
 
 def _shape_stats(shape_str: str) -> Tuple[Optional[int], int, Optional[str]]:
@@ -314,9 +325,17 @@ def parse_hlo_ops(text: str) -> List[Dict[str, Any]]:
             flops = _dot_flops(rest, operands, out_elems, symtab)
         elif opcode == "convolution":
             flops = _conv_flops(rest, operands, out_elems, symtab)
+        participants = None
+        if klass == "comm":
+            gm = _REPLICA_GROUPS_RE.search(rest)
+            if gm:
+                ids = [t for t in gm.group(1).replace(" ", "").split(",")
+                       if t]
+                participants = len(ids) or None
         ops.append({"name": name, "opcode": opcode, "klass": klass,
                     "bytes": op_bytes, "flops": flops,
-                    "integer": dtype in _INT_DTYPES})
+                    "integer": dtype in _INT_DTYPES,
+                    "participants": participants})
     return ops
 
 
@@ -326,8 +345,8 @@ def parse_hlo_ops(text: str) -> List[Dict[str, Any]]:
 
 def _zero_fit() -> Dict[str, Any]:
     return {"mxu_s": 0.0, "memory_s": 0.0, "flops": 0.0, "bytes": 0.0,
-            "comm_bytes": 0.0, "ops_modeled": 0, "ops_unmodeled": 0,
-            "ops_total": 0}
+            "comm_bytes": 0.0, "comm_ops": {}, "ops_modeled": 0,
+            "ops_unmodeled": 0, "ops_total": 0}
 
 
 def fit_roofline(ops: List[Dict[str, Any]],
@@ -347,6 +366,16 @@ def fit_roofline(ops: List[Dict[str, Any]],
         klass = op["klass"]
         if klass == "comm":
             fit["comm_bytes"] += op["bytes"] or 0.0
+            # per-opcode comm table (ISSUE 20): the interconnect
+            # microscope models each collective opcode separately
+            base = _strip_async(op["opcode"])
+            rec = fit["comm_ops"].setdefault(
+                base, {"count": 0, "bytes": 0.0, "participants": None})
+            rec["count"] += 1
+            rec["bytes"] += op["bytes"] or 0.0
+            if op.get("participants"):
+                rec["participants"] = max(rec["participants"] or 0,
+                                          int(op["participants"]))
             fit["ops_modeled"] += 1
             continue
         if klass == "host":
@@ -482,6 +511,7 @@ def gap_budget(step_p50_ms: float, phases_ms: Dict[str, float], *,
     padding_ms = padding_frac * compute_ms
 
     programs: Dict[str, Any] = {}
+    comm_ops: Dict[str, Dict[str, Any]] = {}
     model_mxu_s = model_mem_s = 0.0
     ops_modeled = ops_unmodeled = 0
     analyses = analyses or {}
@@ -496,6 +526,17 @@ def gap_budget(step_p50_ms: float, phases_ms: Dict[str, float], *,
         model_mem_s += share * fit["memory_s"]
         ops_modeled += fit["ops_modeled"]
         ops_unmodeled += fit["ops_unmodeled"]
+        # call-share-weighted per-opcode comm table (ISSUE 20): bytes a
+        # step ships per HLO collective opcode, for the interconnect
+        # microscope's exposed-vs-overlapped estimate
+        for opcode, rec in (fit.get("comm_ops") or {}).items():
+            agg = comm_ops.setdefault(
+                opcode, {"count": 0, "bytes": 0.0, "participants": None})
+            agg["count"] += int(rec.get("count") or 0)
+            agg["bytes"] += share * float(rec.get("bytes") or 0.0)
+            if rec.get("participants"):
+                agg["participants"] = max(agg["participants"] or 0,
+                                          int(rec["participants"]))
         cost = a.get("cost") or {}
         programs[name] = {
             "calls": c, "share": round(share, 4),
@@ -543,6 +584,7 @@ def gap_budget(step_p50_ms: float, phases_ms: Dict[str, float], *,
         "dominant_sink": dominant,
         "padding_frac": round(padding_frac, 6),
         "ops": {"modeled": ops_modeled, "unmodeled": ops_unmodeled},
+        "comm_ops": comm_ops,
         "programs": programs,
         "injected": injected,
         "degraded": degraded,

@@ -8,7 +8,7 @@ import pytest
 
 from paddle_tpu.bench import diff as perfdiff
 from paddle_tpu.bench import gate, ledger, report, schema, trends
-from paddle_tpu.observability import roofline
+from paddle_tpu.observability import interconnect, roofline
 from paddle_tpu.utils import fsio
 
 _FP = {"platform": "cpu", "device_kind": "cpu", "device_count": 8,
@@ -21,6 +21,9 @@ def _row(scenario="moe", mode="smoke", p50=50.0, phases=None, sha="aaaa1111",
     stamps the real repo sha, which these drills must not depend on)."""
     phases = phases or {"data": 5.0, "compute": p50 - 10.0,
                         "readback": 3.0, "collective": 2.0}
+    roof = roofline.degraded_block(
+        p50, {k: float(v) for k, v in phases.items()},
+        reason="trends drill row")
     return {
         "schema_version": schema.SCHEMA_VERSION,
         "scenario": scenario, "mode": mode, "ts": float(ts),
@@ -34,8 +37,11 @@ def _row(scenario="moe", mode="smoke", p50=50.0, phases=None, sha="aaaa1111",
         "bytes_on_wire": 0, "peak_hbm_bytes": 1 << 20,
         # schema v2: every row carries a gap budget; the degraded
         # phase-only block keeps these drills schema-valid
-        "roofline": roofline.degraded_block(
-            p50, {k: float(v) for k, v in phases.items()},
+        "roofline": roof,
+        # schema v3: every row carries a comm sub-budget; bucket must
+        # match the roofline comm bucket for _validate_interconnect
+        "interconnect": interconnect.degraded_block(
+            float(roof["buckets_ms"].get("comm", 0.0)),
             reason="trends drill row"),
         "extra": {},
     }

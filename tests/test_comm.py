@@ -574,22 +574,60 @@ class TestAliasAndAccounting:
         ratio = wire_bytes(2 ** 20, exact) / wire_bytes(2 ** 20, int8)
         assert ratio > 3.9
 
+    @staticmethod
+    def _family_total(reg, base):
+        # ISSUE 20: the byte counters carry [axis=..,leg=..] labels —
+        # readers sum the whole family, never just the unlabeled name
+        from paddle_tpu.observability.registry import split_labels
+        total = 0.0
+        for name, m in reg.snapshot().items():
+            if m.get("type") == "counter" and split_labels(name)[0] == base:
+                total += float(m.get("value") or 0.0)
+        return total
+
     def test_counters_advance_and_ratio(self):
         from paddle_tpu.observability import get_registry
         reg = get_registry()
-        raw0 = reg.counter("comm.bytes").value
-        wire0 = reg.counter("comm.compressed_bytes").value
+        raw0 = self._family_total(reg, "comm.bytes")
+        wire0 = self._family_total(reg, "comm.compressed_bytes")
         mesh = make_mesh()
         x = jnp.asarray(np.random.RandomState(0).randn(8, 8192), jnp.float32)
         cfg = CommConfig(dtype="int8", min_size_to_compress=0)
         smap(lambda v: comm.all_reduce(v.reshape(-1), group="dp",
                                        config=cfg),
              mesh, P("dp", None), P(None))(x)
-        raw = reg.counter("comm.bytes").value - raw0
-        wire = reg.counter("comm.compressed_bytes").value - wire0
+        raw = self._family_total(reg, "comm.bytes") - raw0
+        wire = self._family_total(reg, "comm.compressed_bytes") - wire0
         assert raw > 0 and wire > 0
         assert raw / wire >= 3.0, raw / wire
         assert reg.gauge("comm.compress_ratio").value >= 3.0
+
+    def test_int8_two_phase_books_per_leg(self):
+        # ISSUE 20 satellite: the int8 schedule's two legs are booked
+        # separately — one all_to_all round, one all_gather round, both
+        # on the dp axis, with equal wire bytes (same codes+scales ship
+        # on each leg)
+        from paddle_tpu.observability import get_registry
+        reg = get_registry()
+
+        def leg_value(base, leg):
+            name = f"{base}[axis=dp,leg={leg}]"
+            m = reg.snapshot().get(name)
+            return float((m or {}).get("value") or 0.0)
+
+        before = {leg: leg_value("comm.compressed_bytes", leg)
+                  for leg in ("all_to_all", "all_gather")}
+        mesh = make_mesh()
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 8192),
+                        jnp.float32)
+        cfg = CommConfig(dtype="int8", min_size_to_compress=0)
+        smap(lambda v: comm.all_reduce(v.reshape(-1), group="dp",
+                                       config=cfg),
+             mesh, P("dp", None), P(None))(x)
+        deltas = {leg: leg_value("comm.compressed_bytes", leg) - before[leg]
+                  for leg in ("all_to_all", "all_gather")}
+        assert deltas["all_to_all"] > 0
+        assert deltas["all_to_all"] == deltas["all_gather"], deltas
 
 
 # ---------------------------------------------------------------------------

@@ -511,6 +511,45 @@ assert finding["data"]["injected"] is True, finding
 print("roofline drill: injected memory_bound gap -> doctor verdict:",
       finding["title"])
 PYEOF
+    # interconnect microscope (ISSUE 20): every smoke row just appended
+    # must carry a comm sub-budget whose entries (with the unattributed
+    # remainder) sum to the roofline's comm bucket — the reconciliation
+    # gate that makes the attribution provable, not decorative
+    JAX_PLATFORMS=cpu python -m paddle_tpu.observability.interconnect \
+        --mode smoke
+    # comm-inflation drill: inflate the comm bucket AND inject a named
+    # (op, axis) into the sub-budget, then assert the doctor names
+    # exactly that collective on exactly that axis — the alarm must fire
+    # for the right reason, not merely fire
+    JAX_PLATFORMS=cpu PTPU_ROOFLINE_TEST_INFLATE=comm:0.5 \
+        PTPU_INTERCONNECT_TEST_INFLATE=all_to_all:ep:0.8 \
+        python - <<'PYEOF'
+from paddle_tpu.bench import runner
+from paddle_tpu.observability import doctor
+row = runner.run_scenario("mnist", mode="smoke")
+ic = row["interconnect"]
+assert ic["injected"] == {"op": "all_to_all", "axis": "ep",
+                          "frac": 0.8}, ic["injected"]
+entries = ic["entries"]
+dom = max((e for e in entries if e["op"] != "(unattributed)"),
+          key=lambda e: e["measured_ms"])
+assert (dom["op"], dom["axis"]) == ("all_to_all", "ep"), dom
+total = sum(e["measured_ms"] for e in entries)
+tol = max(0.01, 0.005 * abs(ic["comm_bucket_ms"]))
+assert abs(total - ic["comm_bucket_ms"]) <= tol, (
+    total, ic["comm_bucket_ms"])
+assert abs(ic["comm_bucket_ms"]
+           - row["roofline"]["buckets_ms"]["comm"]) <= tol
+rec = {"kind": "bench.row", "scenario": row["scenario"], "ts": 0.0,
+       "roofline": {"measured_step_ms":
+                    row["roofline"]["measured_step_ms"]},
+       "interconnect": ic}
+(finding,) = doctor.check_comm_budget({0: [rec]})
+assert finding["data"]["op"] == "all_to_all", finding
+assert finding["data"]["axis"] == "ep", finding
+print("interconnect drill: injected all_to_all[axis=ep] -> doctor "
+      "verdict:", finding["title"])
+PYEOF
     # warm-start drill (ROADMAP 5a): the persistent-compile-cache test is
     # `slow` (two fresh jax processes), so tier-1 skips it — run it here
     python -m pytest -q -m slow tests/test_compile_cache.py
@@ -521,6 +560,7 @@ PYEOF
          "+ comm tier + comm smoke + elastic tier + elastic smoke +" \
          "integrity tier + integrity smoke + integrity overhead +" \
          "bench smoke + perf tier + trends + dashboard + roofline" \
-         "residual bound + roofline drill + warm-start ok"
+         "residual bound + roofline drill + interconnect reconciliation" \
+         "+ interconnect drill + warm-start ok"
 fi
 echo "shard ${SHARD} green"
